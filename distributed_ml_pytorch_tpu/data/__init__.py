@@ -1,0 +1,17 @@
+from distributed_ml_pytorch_tpu.data.cifar10 import (
+    CIFAR10_CLASSES,
+    get_dataset,
+    load_cifar10,
+    synthetic_cifar10,
+    iterate_batches,
+    shard_for_process,
+)
+
+__all__ = [
+    "CIFAR10_CLASSES",
+    "get_dataset",
+    "load_cifar10",
+    "synthetic_cifar10",
+    "iterate_batches",
+    "shard_for_process",
+]

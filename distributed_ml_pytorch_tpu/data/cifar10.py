@@ -1,0 +1,177 @@
+"""C6: CIFAR-10 input pipeline (parity with reference ``example/main.py:23-29,35-38``).
+
+The reference uses torchvision to download CIFAR-10 to ``./data`` and applies
+``Normalize((0.5,0.5,0.5), (0.5,0.5,0.5))``. This module:
+
+- loads the standard ``cifar-10-batches-py`` pickle layout from disk when
+  present (same ``./data`` root convention);
+- otherwise generates a **deterministic synthetic CIFAR-10 stand-in** —
+  class-conditional structured images — so training/eval/benchmarks run in
+  air-gapped environments (this build environment has no network egress).
+  The synthetic set is learnable (distinct per-class statistics), letting
+  loss-decrease and accuracy-improvement tests be meaningful;
+- applies the same (x/255 - 0.5)/0.5 normalization to [-1, 1];
+- provides a batching iterator (shuffle-per-epoch like the reference's
+  ``DataLoader(shuffle=True)``) and per-process sharding for multi-host
+  pods (each controller feeds its addressable devices — the TPU analog of
+  one DataLoader per worker rank).
+
+Layout is NHWC (TPU-native), not the reference's NCHW.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+from typing import Iterator, Tuple
+
+import numpy as np
+
+CIFAR10_CLASSES = (
+    "plane", "car", "bird", "cat", "deer", "dog", "frog", "horse", "ship", "truck",
+)  # reference ``example/main.py:112``
+
+_BATCHES_DIR = "cifar-10-batches-py"
+_TARBALL = "cifar-10-python.tar.gz"
+
+
+def _normalize(images_u8: np.ndarray) -> np.ndarray:
+    """uint8 [0,255] → float32 in [-1,1] (reference Normalize((0.5,)*3,(0.5,)*3))."""
+    return (images_u8.astype(np.float32) / 255.0 - 0.5) / 0.5
+
+
+def _load_pickle_batches(root: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray] | None:
+    d = os.path.join(root, _BATCHES_DIR)
+    if not os.path.isdir(d):
+        tb = os.path.join(root, _TARBALL)
+        if os.path.isfile(tb):
+            with tarfile.open(tb, "r:gz") as tf:
+                tf.extractall(root, filter="data")
+        if not os.path.isdir(d):
+            return None
+
+    def read(name):
+        with open(os.path.join(d, name), "rb") as f:
+            entry = pickle.load(f, encoding="bytes")
+        data = entry[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)  # →NHWC
+        labels = np.asarray(entry[b"labels"], dtype=np.int32)
+        return data, labels
+
+    train = [read(f"data_batch_{i}") for i in range(1, 6)]
+    x_train = np.concatenate([t[0] for t in train])
+    y_train = np.concatenate([t[1] for t in train])
+    x_test, y_test = read("test_batch")
+    return x_train, y_train, x_test, y_test
+
+
+def synthetic_cifar10(
+    n_train: int = 50000, n_test: int = 10000, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Deterministic class-conditional 32×32×3 uint8 images.
+
+    Each class gets a fixed low-frequency template (random sinusoid mixture)
+    plus per-sample noise, so a CNN can separate classes — loss decreases and
+    accuracy climbs well above chance, making the training-parity tests and
+    benchmarks meaningful without the real dataset.
+    """
+    rng = np.random.default_rng(seed)
+    yy, xx = np.meshgrid(np.arange(32), np.arange(32), indexing="ij")
+    templates = []
+    for _ in range(10):
+        img = np.zeros((32, 32, 3), np.float32)
+        for c in range(3):
+            for _k in range(3):
+                fy, fx = rng.uniform(0.5, 3.0, size=2)
+                ph = rng.uniform(0, 2 * np.pi, size=2)
+                img[:, :, c] += rng.uniform(0.3, 1.0) * np.sin(
+                    2 * np.pi * fy * yy / 32 + ph[0]
+                ) * np.cos(2 * np.pi * fx * xx / 32 + ph[1])
+        templates.append(img)
+    templates = np.stack(templates)  # (10,32,32,3)
+    templates = (templates - templates.min()) / (np.ptp(templates) + 1e-6)
+
+    def make(n, split_seed):
+        r = np.random.default_rng(split_seed)
+        labels = r.integers(0, 10, size=n).astype(np.int32)
+        noise = r.normal(0.0, 0.25, size=(n, 32, 32, 3)).astype(np.float32)
+        imgs = np.clip(templates[labels] + noise, 0.0, 1.0)
+        return (imgs * 255).astype(np.uint8), labels
+
+    x_train, y_train = make(n_train, seed + 1)
+    x_test, y_test = make(n_test, seed + 2)
+    return x_train, y_train, x_test, y_test
+
+
+def load_cifar10(
+    root: str = "./data", synthetic: bool | None = None, seed: int = 0,
+    n_train: int = 50000, n_test: int = 10000,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, bool]:
+    """Return ``(x_train, y_train, x_test, y_test, is_synthetic)``, normalized.
+
+    ``synthetic=None`` auto-detects: real data if on disk under ``root``
+    (reference downloads to ``./data``, ``example/main.py:24-25``), else the
+    deterministic stand-in.
+    """
+    loaded = None
+    if synthetic is not True:
+        loaded = _load_pickle_batches(root)
+        if loaded is None and synthetic is False:
+            raise FileNotFoundError(
+                f"CIFAR-10 not found under {root!r} (no {_BATCHES_DIR}/ or {_TARBALL}); "
+                "this environment has no network egress — pass synthetic=True or None"
+            )
+    if loaded is not None:
+        x_train, y_train, x_test, y_test = loaded
+        is_synth = False
+    else:
+        x_train, y_train, x_test, y_test = synthetic_cifar10(n_train, n_test, seed)
+        is_synth = True
+    return _normalize(x_train), y_train, _normalize(x_test), y_test, is_synth
+
+
+def get_dataset(args) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """CLI-facing loader (parity with reference ``get_dataset``, ``example/main.py:23``)."""
+    x_train, y_train, x_test, y_test, _ = load_cifar10(
+        root=getattr(args, "data_root", "./data"),
+        synthetic=True if getattr(args, "synthetic_data", False) else None,
+        n_train=getattr(args, "synthetic_train_size", 50000),
+        n_test=getattr(args, "synthetic_test_size", 10000),
+    )
+    return x_train, y_train, x_test, y_test
+
+
+def shard_for_process(
+    x: np.ndarray, y: np.ndarray, process_index: int, process_count: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Strided (interleaved) per-host shard: rank r takes elements r, r+P,
+    r+2P, … — each controller loads 1/process_count of the data, the pod
+    analog of the reference's one-DataLoader-per-worker-rank."""
+    n = (len(x) // process_count) * process_count
+    return (
+        x[process_index:n:process_count],
+        y[process_index:n:process_count],
+    )
+
+
+def iterate_batches(
+    x: np.ndarray,
+    y: np.ndarray,
+    batch_size: int,
+    *,
+    shuffle: bool = True,
+    seed: int = 0,
+    epoch: int = 0,
+    drop_last: bool = True,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Per-epoch shuffled minibatch iterator (reference DataLoader semantics,
+    ``example/main.py:27``). ``drop_last=True`` keeps shapes static for jit —
+    a ragged final batch would trigger recompilation on TPU."""
+    n = len(x)
+    idx = np.arange(n)
+    if shuffle:
+        np.random.default_rng(seed + epoch).shuffle(idx)
+    limit = (n // batch_size) * batch_size if drop_last else n
+    for start in range(0, limit, batch_size):
+        sel = idx[start : start + batch_size]
+        yield x[sel], y[sel]

@@ -1,0 +1,3 @@
+from distributed_ml_pytorch_tpu.models.cnn import LeNet, AlexNet, get_model
+
+__all__ = ["LeNet", "AlexNet", "get_model"]

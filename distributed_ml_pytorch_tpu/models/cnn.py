@@ -1,0 +1,93 @@
+"""L3 models: LeNet and AlexNet (parity with reference ``example/models.py:5-49``).
+
+Flax ``linen`` modules, NHWC layout (TPU-native: XLA tiles NHWC convs onto the
+MXU directly), architecture matched layer-for-layer to the reference so that
+parameter counts and receptive fields agree:
+
+- ``LeNet`` (reference ``example/models.py:5-23``): conv(3→6,k5,valid) → pool2
+  → relu, conv(6→16,k5,valid) → channel dropout → pool2 → relu, flatten(400)
+  → fc120 → relu → dropout → fc84 → relu → fc10.
+- ``AlexNet`` (reference ``example/models.py:25-49``): five convs
+  (3→64 k11 s4 p5, 64→192 k5 p2, 192→384 k3 p1, 384→256 k3 p1, 256→256 k3 p1)
+  with three 2×2 maxpools, then a single ``Dense(num_classes)`` classifier on
+  the 256-feature map (1×1 spatial at 32×32 input).
+
+Weight init follows the reference's torch defaults (Kaiming-uniform with
+fan_in, uniform bias) closely enough for training parity; compute dtype is
+configurable so the hot path can run bfloat16 on the MXU with float32 params.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class LeNet(nn.Module):
+    """LeNet-5 variant (reference ``example/models.py:5-23``)."""
+
+    num_classes: int = 10
+    dropout_rate: float = 0.5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        # conv1: 3→6 k5 VALID; torch F.max_pool2d(...,2) then relu (:16)
+        x = nn.Conv(6, (5, 5), padding="VALID", dtype=self.dtype, name="conv1")(x)
+        x = nn.relu(nn.max_pool(x, (2, 2), strides=(2, 2)))
+        # conv2: 6→16 k5 VALID; Dropout2d (channel dropout) precedes pool (:17)
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype, name="conv2")(x)
+        # torch Dropout2d zeroes whole channels: broadcast over H,W (NHWC dims 1,2)
+        x = nn.Dropout(self.dropout_rate, broadcast_dims=(1, 2), deterministic=not train)(x)
+        x = nn.relu(nn.max_pool(x, (2, 2), strides=(2, 2)))
+        x = x.reshape((x.shape[0], -1))  # 5*5*16 = 400 (:18)
+        x = nn.relu(nn.Dense(120, dtype=self.dtype, name="fc1")(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(84, dtype=self.dtype, name="fc2")(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc3")(x)
+        return x.astype(jnp.float32)
+
+
+class AlexNet(nn.Module):
+    """CIFAR-sized AlexNet (reference ``example/models.py:25-49``)."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        del train  # no dropout in the reference AlexNet
+        x = x.astype(self.dtype)
+        conv = lambda f, k, s, p, name: nn.Conv(
+            f, (k, k), strides=(s, s), padding=[(p, p), (p, p)], dtype=self.dtype, name=name
+        )
+        x = nn.relu(conv(64, 11, 4, 5, "conv1")(x))      # 32→8
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))        # 8→4
+        x = nn.relu(conv(192, 5, 1, 2, "conv2")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))        # 4→2
+        x = nn.relu(conv(384, 3, 1, 1, "conv3")(x))
+        x = nn.relu(conv(256, 3, 1, 1, "conv4")(x))
+        x = nn.relu(conv(256, 3, 1, 1, "conv5")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))        # 2→1
+        x = x.reshape((x.shape[0], -1))                   # 256 (:47-48)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="classifier")(x)
+        return x.astype(jnp.float32)
+
+
+def get_model(name: str, num_classes: int = 10, dtype: Any = jnp.float32) -> nn.Module:
+    """Model registry keyed by the CLI ``--model`` flag."""
+    name = name.lower()
+    if name == "lenet":
+        return LeNet(num_classes=num_classes, dtype=dtype)
+    if name == "alexnet":
+        return AlexNet(num_classes=num_classes, dtype=dtype)
+    if name in ("resnet18", "resnet50"):
+        try:
+            from distributed_ml_pytorch_tpu.models.resnet import get_resnet
+        except ImportError as e:
+            raise ValueError(f"model {name!r} is not available: {e}") from e
+        return get_resnet(name, num_classes=num_classes, dtype=dtype)
+    raise ValueError(f"unknown model {name!r}")

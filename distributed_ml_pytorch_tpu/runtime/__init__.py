@@ -1,0 +1,19 @@
+from distributed_ml_pytorch_tpu.runtime.mesh import (
+    initialize_distributed,
+    data_mesh,
+    make_mesh,
+    simulate_cpu_devices,
+    local_device_count,
+    process_rank,
+    world_size,
+)
+
+__all__ = [
+    "initialize_distributed",
+    "data_mesh",
+    "make_mesh",
+    "simulate_cpu_devices",
+    "local_device_count",
+    "process_rank",
+    "world_size",
+]

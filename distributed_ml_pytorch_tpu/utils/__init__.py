@@ -1,0 +1,19 @@
+from distributed_ml_pytorch_tpu.utils.serialization import (
+    ravel_model_params,
+    unravel_model_params,
+    make_unraveler,
+)
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    MessageCode,
+    MessageListener,
+    send_message,
+)
+
+__all__ = [
+    "ravel_model_params",
+    "unravel_model_params",
+    "make_unraveler",
+    "MessageCode",
+    "MessageListener",
+    "send_message",
+]

@@ -1,0 +1,283 @@
+"""M2: tagged-tensor messaging layer (SURVEY.md §2.3, reference contract
+recovered from ``asgd/optim/Asynchronous.py:5,9-18,34,37-38,49,59``).
+
+The reference's missing ``asgd.utils.messaging`` module defines the wire API
+of the DownPour parameter-server path:
+
+- ``MessageCode`` enum ⊇ {ParameterUpdate, ParameterRequest, GradientUpdate},
+- ``send_message(code, payload)`` — fire-and-forget tagged flat-tensor send
+  toward the server (rank 0),
+- ``MessageListener(model)`` — background thread looping on receive and
+  dispatching to ``.receive(sender, message_code, parameter)``.
+
+Here the same API sits on a pluggable :class:`Transport`:
+
+- :class:`InProcessTransport` — queue-based, many "ranks" in one process; used
+  by unit tests the way the reference smoke-tests on localhost (SURVEY.md §4).
+- :class:`TCPTransport` — framed messages over sockets between controller
+  processes in a star topology (workers ↔ server), replacing the reference's
+  gloo send/recv. On a TPU pod these are *host-side* control-plane transfers
+  between JAX controllers; the data-plane (sync DP) rides compiled ICI
+  collectives instead (``parallel/sync.py``).
+
+Wire format (TCP): little-endian header ``(sender:i32, code:i32, nbytes:i64)``
+followed by a float32 payload — the flat raveled model vector, fixed size per
+model, exactly the implied reference format (SURVEY.md §2.3 M2).
+"""
+
+from __future__ import annotations
+
+import enum
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+_HEADER = struct.Struct("<iiq")
+
+SERVER_RANK = 0  # reference convention: rank 0 is the parameter server
+
+
+class MessageCode(enum.IntEnum):
+    """Message tags (reference ``Asynchronous.py:17,34,49,59``)."""
+
+    ParameterUpdate = 0
+    ParameterRequest = 1
+    GradientUpdate = 2
+
+
+Message = Tuple[int, MessageCode, np.ndarray]
+
+
+class Transport:
+    """Point-to-point tagged-tensor channel for one rank."""
+
+    rank: int = 0
+
+    def send(self, code: MessageCode, payload: np.ndarray, dst: int = SERVER_RANK) -> None:
+        raise NotImplementedError
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[Message]:
+        """Blocking receive; returns ``None`` on timeout or closed transport."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InProcessTransport(Transport):
+    """Queue-based transport: a whole world inside one process (for tests and
+    single-host simulation of the PS topology)."""
+
+    def __init__(self, rank: int, mailboxes: Dict[int, "queue.Queue[Message]"]):
+        self.rank = rank
+        self._boxes = mailboxes
+        self._closed = False
+
+    @classmethod
+    def create_world(cls, world_size: int) -> Dict[int, "InProcessTransport"]:
+        boxes: Dict[int, queue.Queue] = {r: queue.Queue() for r in range(world_size)}
+        return {r: cls(r, boxes) for r in range(world_size)}
+
+    def send(self, code: MessageCode, payload: np.ndarray, dst: int = SERVER_RANK) -> None:
+        arr = np.asarray(payload, dtype=np.float32).ravel()
+        self._boxes[dst].put((self.rank, MessageCode(code), arr))
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[Message]:
+        if self._closed:
+            return None
+        try:
+            return self._boxes[self.rank].get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        self._closed = True
+
+
+def _send_frame(sock: socket.socket, sender: int, code: int, payload: np.ndarray) -> None:
+    buf = payload.tobytes()
+    sock.sendall(_HEADER.pack(sender, code, len(buf)) + buf)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    while n:
+        try:
+            b = sock.recv(min(n, 1 << 20))
+        except (OSError, ValueError):
+            return None
+        if not b:
+            return None
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[Message]:
+    hdr = _recv_exact(sock, _HEADER.size)
+    if hdr is None:
+        return None
+    sender, code, nbytes = _HEADER.unpack(hdr)
+    body = _recv_exact(sock, nbytes)
+    if body is None:
+        return None
+    return sender, MessageCode(code), np.frombuffer(body, dtype=np.float32).copy()
+
+
+class TCPTransport(Transport):
+    """Star-topology socket transport (replaces the reference's gloo rendezvous
+    at ``example/main.py:163-165`` for the async control plane).
+
+    Rank 0 (the server) binds ``master:port`` and accepts ``world_size - 1``
+    worker connections; workers dial in and identify themselves with a hello
+    frame. Workers send to the server; the server replies to any worker.
+    Incoming frames are pumped into a local queue by reader threads so
+    :meth:`recv` has the same blocking-queue semantics as the in-process
+    transport.
+    """
+
+    def __init__(self, rank: int, world_size: int, master: str = "localhost", port: int = 29500):
+        self.rank = rank
+        self.world_size = world_size
+        self._inbox: "queue.Queue[Message]" = queue.Queue()
+        self._peers: Dict[int, socket.socket] = {}
+        self._threads = []
+        self._closed = False
+        if rank == SERVER_RANK:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind((master if master != "localhost" else "", int(port)))
+            srv.listen(world_size)
+            self._server_sock = srv
+            for _ in range(world_size - 1):
+                conn, _addr = srv.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                hello = _recv_frame(conn)
+                if hello is None:
+                    raise ConnectionError("worker handshake failed")
+                peer_rank = hello[0]
+                self._peers[peer_rank] = conn
+                self._spawn_reader(conn)
+        else:
+            sock = socket.create_connection((master, int(port)), timeout=60)
+            sock.settimeout(None)  # connect timeout only; reads must block indefinitely
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _send_frame(sock, rank, int(MessageCode.ParameterRequest), np.zeros(0, np.float32))
+            self._peers[SERVER_RANK] = sock
+            self._server_sock = None
+            self._spawn_reader(sock)
+
+    def _spawn_reader(self, sock: socket.socket) -> None:
+        def pump():
+            while not self._closed:
+                msg = _recv_frame(sock)
+                if msg is None:
+                    break
+                self._inbox.put(msg)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def send(self, code: MessageCode, payload: np.ndarray, dst: int = SERVER_RANK) -> None:
+        arr = np.asarray(payload, dtype=np.float32).ravel()
+        _send_frame(self._peers[dst], self.rank, int(code), arr)
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[Message]:
+        # Poll in short slices so a blocking recv() still returns None once the
+        # transport is closed (the documented Transport contract).
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._closed:
+                return None
+            slice_t = 0.1 if deadline is None else max(0.0, min(0.1, deadline - time.monotonic()))
+            try:
+                return self._inbox.get(timeout=slice_t)
+            except queue.Empty:
+                if deadline is not None and time.monotonic() >= deadline:
+                    return None
+
+    def close(self) -> None:
+        self._closed = True
+        for s in self._peers.values():
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            s.close()
+        if self._server_sock is not None:
+            self._server_sock.close()
+
+
+# --- module-level default transport -----------------------------------------
+# The reference's send_message has no transport argument — the gloo process
+# group is ambient global state. We keep that call-site parity via a default
+# transport installed at bootstrap.
+
+_default_transport: Optional[Transport] = None
+
+
+def set_default_transport(t: Optional[Transport]) -> None:
+    global _default_transport
+    _default_transport = t
+
+
+def get_default_transport() -> Transport:
+    if _default_transport is None:
+        raise RuntimeError(
+            "no default transport installed — call set_default_transport() "
+            "(the analog of the reference's dist.init_process_group, "
+            "example/main.py:165)"
+        )
+    return _default_transport
+
+
+def send_message(
+    message_code: MessageCode,
+    payload,
+    dst: int = SERVER_RANK,
+    transport: Optional[Transport] = None,
+) -> None:
+    """Fire-and-forget tagged tensor send (reference ``Asynchronous.py:34,49,59``).
+
+    ``payload`` may be a numpy array or a JAX array (device→host transfer
+    happens here, outside any jitted computation).
+    """
+    t = transport or get_default_transport()
+    t.send(MessageCode(message_code), np.asarray(payload, dtype=np.float32), dst=dst)
+
+
+class MessageListener(threading.Thread):
+    """Background receive loop (reference contract ``Asynchronous.py:9-18,37-38``).
+
+    Subclasses override :meth:`receive`. Unlike the reference — whose listener
+    mutates live model tensors mid-step (the deliberate DownPour data race,
+    SURVEY.md §5.2) — subclasses here deposit results for the training loop to
+    swap in *between* jitted steps (see ``parallel/async_ps.py``).
+    """
+
+    def __init__(self, model=None, transport: Optional[Transport] = None):
+        super().__init__(daemon=True)
+        self.model = model
+        self.transport = transport or get_default_transport()
+        self._running = threading.Event()
+        self._running.set()
+
+    def receive(self, sender: int, message_code: MessageCode, parameter: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def run(self) -> None:
+        while self._running.is_set():
+            msg = self.transport.recv(timeout=0.1)
+            if msg is None:
+                continue
+            sender, code, payload = msg
+            self.receive(sender, code, payload)
+
+    def stop(self) -> None:
+        self._running.clear()

@@ -1,0 +1,78 @@
+"""Flat-parameter serialization (M3 contract, SURVEY.md §2.3).
+
+The reference's missing ``asgd/utils/serialization`` module — whose API is
+recovered from call sites at ``asgd/optim/Asynchronous.py:4,18,27,34,54`` —
+provides two functions:
+
+- ``ravel_model_params(model, grads=False)`` → one flat 1-D tensor
+  concatenating every parameter (or every gradient when ``grads=True``).
+- ``unravel_model_params(model, flat)`` → scatter a flat vector back into the
+  model's parameters (in-place in the reference).
+
+Here the same API is expressed over JAX pytrees. JAX parameters are immutable,
+so ``unravel_model_params`` returns a *new* pytree instead of mutating — which
+is exactly what makes the reference's Listener-thread data race
+(``Asynchronous.py:17-18``) disappear: installing pulled parameters is a pure
+pytree swap between steps.
+
+Both functions are jit-compatible: under ``jax.jit`` the ravel lowers to a
+single fused concatenate and the unravel to slices+reshapes, so the per-step
+O(|θ|) flatten in the hot loop (reference ``Asynchronous.py:54``) costs one
+HBM pass, fused by XLA with its producer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+Pytree = Any
+
+
+def ravel_model_params(params: Pytree, grads: Pytree | None = None) -> jax.Array:
+    """Flatten a parameter pytree into a single 1-D array.
+
+    Parity with the reference's ``ravel_model_params(model, grads=False)``
+    (call sites ``Asynchronous.py:27,34,54``): pass ``grads=<grad pytree>`` to
+    ravel gradients laid out in the same order as the parameters, so a server
+    applying a flat gradient vector lines up element-for-element with a flat
+    parameter vector.
+    """
+    tree = params if grads is None else grads
+    flat, _ = ravel_pytree(tree)
+    return flat
+
+
+def make_unraveler(params: Pytree) -> Callable[[jax.Array], Pytree]:
+    """Return a function mapping a flat vector back to ``params``' structure.
+
+    Cache this once per model instead of re-deriving the structure every
+    message, the way the reference re-walks ``model.parameters()`` on every
+    ``unravel_model_params`` call (``Asynchronous.py:18``).
+    """
+    _, unravel = ravel_pytree(params)
+    return unravel
+
+
+def unravel_model_params(params: Pytree, flat: jax.Array) -> Pytree:
+    """Rebuild a pytree with ``params``' structure from flat vector ``flat``.
+
+    Functional analog of the reference's in-place
+    ``unravel_model_params(model, tensor)`` (``Asynchronous.py:18``): returns
+    the new pytree; the caller swaps it in between steps.
+    """
+    return make_unraveler(params)(flat)
+
+
+def flat_size(params: Pytree) -> int:
+    """Total element count of a pytree — the accumulator allocation size used
+    at reference ``Asynchronous.py:27`` (``torch.zeros(ravel(...).size())``)."""
+    return sum(int(jnp.size(leaf)) for leaf in jax.tree.leaves(params))
+
+
+def zeros_like_flat(params: Pytree, dtype=jnp.float32) -> jax.Array:
+    """Flat zero accumulator sized to ``params`` (reference ``Asynchronous.py:27``)."""
+    return jnp.zeros((flat_size(params),), dtype=dtype)

@@ -1,0 +1,45 @@
+"""Test bootstrap: run everything on an 8-device virtual CPU mesh.
+
+The framework's distributed paths (psum allreduce, ppermute p2p, sharded
+train steps) are unit-tested on virtual CPU devices — the single-host
+cluster simulation recommended in SURVEY.md §4, replacing the reference's
+localhost multi-process smoke topology (``Makefile:13-20``).
+
+This environment's sitecustomize registers and initializes a TPU PJRT
+plugin at interpreter boot, so by the time conftest runs the backend is
+already locked to one TPU device. We clear JAX's backend caches and
+re-initialize on the CPU platform with 8 virtual devices.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["TPU_DISTBELIEF_TEST_ENV"] = "1"
+
+import jax  # noqa: E402
+
+N_DEVICES = 8
+
+if len(jax.devices()) != N_DEVICES or jax.devices()[0].platform != "cpu":
+    from jax._src import xla_bridge
+
+    xla_bridge._clear_backends()
+    xla_bridge.get_backend.cache_clear()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", N_DEVICES)
+
+assert len(jax.devices()) == N_DEVICES and jax.devices()[0].platform == "cpu", (
+    f"expected {N_DEVICES} virtual CPU devices, got {jax.devices()}"
+)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from distributed_ml_pytorch_tpu.runtime import data_mesh
+
+    return data_mesh(8)

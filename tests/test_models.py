@@ -1,0 +1,85 @@
+"""C7 parity tests: LeNet/AlexNet shapes and parameter counts vs the
+reference architectures (``example/models.py:5-49``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.models import AlexNet, LeNet, get_model
+
+
+def test_lenet_output_shape():
+    model = LeNet()
+    x = jnp.zeros((4, 32, 32, 3))
+    params = model.init(jax.random.key(0), x)["params"]
+    out = model.apply({"params": params}, x)
+    assert out.shape == (4, 10)
+
+
+def test_lenet_param_count_matches_reference():
+    # torch LeNet (example/models.py:5-23):
+    # conv1 3*6*25+6=456; conv2 6*16*25+16=2416; fc1 400*120+120=48120;
+    # fc2 120*84+84=10164; fc3 84*10+10=850  → 61,006? compute: 456+2416+48120+10164+850
+    expected = 456 + 2416 + 48120 + 10164 + 850
+    model = LeNet()
+    params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))["params"]
+    total = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    assert total == expected
+
+
+def test_alexnet_param_count_matches_reference():
+    # torch AlexNet (example/models.py:25-49):
+    # conv1 3*64*121+64; conv2 64*192*25+192; conv3 192*384*9+384;
+    # conv4 384*256*9+256; conv5 256*256*9+256; fc 256*10+10
+    expected = (
+        (3 * 64 * 121 + 64)
+        + (64 * 192 * 25 + 192)
+        + (192 * 384 * 9 + 384)
+        + (384 * 256 * 9 + 256)
+        + (256 * 256 * 9 + 256)
+        + (256 * 10 + 10)
+    )
+    model = AlexNet()
+    params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))["params"]
+    total = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    assert total == expected
+
+
+def test_alexnet_feature_map_is_256():
+    """The classifier sees exactly 256 features at 32×32 input (the reference's
+    single Linear(256, num_classes), example/models.py:43)."""
+    model = AlexNet()
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x)
+    kernel = variables["params"]["classifier"]["kernel"]
+    assert kernel.shape == (256, 10)
+    assert model.apply(variables, x).shape == (2, 10)
+
+
+def test_lenet_dropout_train_vs_eval():
+    model = LeNet()
+    x = jnp.ones((8, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x)
+    eval1 = model.apply(variables, x, train=False)
+    eval2 = model.apply(variables, x, train=False)
+    np.testing.assert_array_equal(np.asarray(eval1), np.asarray(eval2))
+    train_out = model.apply(
+        variables, x, train=True, rngs={"dropout": jax.random.key(1)}
+    )
+    assert not np.allclose(np.asarray(train_out), np.asarray(eval1))
+
+
+def test_get_model_registry():
+    assert isinstance(get_model("lenet"), LeNet)
+    assert isinstance(get_model("alexnet"), AlexNet)
+    with pytest.raises(ValueError):
+        get_model("nope")
+
+
+def test_bfloat16_compute_dtype():
+    model = AlexNet(dtype=jnp.bfloat16)
+    x = jnp.zeros((2, 32, 32, 3))
+    params = model.init(jax.random.key(0), x)["params"]
+    out = model.apply({"params": params}, x)
+    assert out.dtype == jnp.float32  # logits promoted back for a stable loss

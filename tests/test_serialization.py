@@ -1,0 +1,75 @@
+"""M3 contract tests: ravel/unravel round-trip (SURVEY.md §4 gap-closing)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.utils.serialization import (
+    flat_size,
+    make_unraveler,
+    ravel_model_params,
+    unravel_model_params,
+    zeros_like_flat,
+)
+
+
+def _params():
+    from distributed_ml_pytorch_tpu.models import LeNet
+
+    model = LeNet()
+    return model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))["params"]
+
+
+def test_ravel_is_flat_and_sized():
+    params = _params()
+    flat = ravel_model_params(params)
+    assert flat.ndim == 1
+    assert flat.shape[0] == flat_size(params)
+    assert zeros_like_flat(params).shape == flat.shape
+
+
+def test_round_trip_exact():
+    params = _params()
+    flat = ravel_model_params(params)
+    rebuilt = unravel_model_params(params, flat)
+    assert jax.tree.structure(rebuilt) == jax.tree.structure(params)
+    for a, b in zip(jax.tree.leaves(rebuilt), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_grads_ravel_aligns_with_params():
+    """A flat gradient vector must line up element-for-element with the flat
+    parameter vector (server applies flat grads to flat params)."""
+    params = _params()
+    grads = jax.tree.map(jnp.ones_like, params)
+    flat_p = ravel_model_params(params)
+    flat_g = ravel_model_params(params, grads=grads)
+    assert flat_g.shape == flat_p.shape
+    stepped = unravel_model_params(params, flat_p - 0.1 * flat_g)
+    for s, p in zip(jax.tree.leaves(stepped), jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(s), np.asarray(p) - 0.1, rtol=1e-6)
+
+
+def test_unraveler_cache_matches():
+    params = _params()
+    unravel = make_unraveler(params)
+    flat = ravel_model_params(params)
+    a = unravel(flat)
+    b = unravel_model_params(params, flat)
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_jit_compatible():
+    params = _params()
+    unravel = make_unraveler(params)
+
+    @jax.jit
+    def step(p):
+        flat = ravel_model_params(p)
+        return unravel(flat * 2.0)
+
+    doubled = step(params)
+    for d, p in zip(jax.tree.leaves(doubled), jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(d), 2 * np.asarray(p), rtol=1e-6)
