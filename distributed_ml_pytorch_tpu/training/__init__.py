@@ -1,0 +1,17 @@
+from distributed_ml_pytorch_tpu.training.trainer import (
+    TrainState,
+    create_train_state,
+    make_train_step,
+    make_eval_fn,
+    evaluate,
+    train_single,
+)
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "make_train_step",
+    "make_eval_fn",
+    "evaluate",
+    "train_single",
+]

@@ -1,0 +1,152 @@
+"""C8: CLI + process bootstrap (parity with reference ``example/main.py:140-168``).
+
+Reproduces the reference's 15-flag surface (``example/main.py:142-155``) and
+adds the TPU-era flags (``--backend``, ``--model``, ``--mode``, data options).
+Flag-mapping notes:
+
+- ``--cuda`` (reference: move model to GPU) → alias for ``--backend=tpu``:
+  "put compute on the accelerator". On this hardware that is the TPU chip,
+  and it is also the default, so the flag is accepted for script parity.
+- ``--rank``/``--world-size``/``--master``/``--port`` configure either the
+  async-PS control plane (TCP star, ``utils/messaging.py``) or multi-host
+  JAX (``runtime/mesh.py``), replacing MASTER_ADDR/MASTER_PORT + gloo
+  (``example/main.py:163-165``).
+- ``--server`` turns this process into the parameter server
+  (``example/main.py:166-167`` → ``init_server`` parity). Unlike the
+  reference — where ``main(args)`` still runs after ``server.run()`` returns,
+  a structural quirk (SURVEY.md §3.2) — the server process exits cleanly.
+- ``--mode`` selects the parallelism strategy for distributed runs:
+  ``ps`` (async parameter server, the reference's core), ``sync``
+  (per-step psum allreduce over the device mesh — BASELINE.json's
+  ``--backend=tpu`` north-star path), ``local-sgd`` (compiled periodic
+  averaging, the idiomatic reformulation of push/pull cadence).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Distbelief training example (TPU-native)")
+    # --- reference 15-flag surface (example/main.py:142-155) ---
+    p.add_argument("--batch-size", type=int, default=64, metavar="N",
+                   help="input batch size for training (default: 64)")
+    p.add_argument("--test-batch-size", type=int, default=10000, metavar="N",
+                   help="input batch size for testing (default: 10000)")
+    p.add_argument("--epochs", type=int, default=20, metavar="N",
+                   help="number of epochs to train (default: 20)")
+    p.add_argument("--lr", type=float, default=0.008, metavar="LR",
+                   help="learning rate (default: 0.008)")
+    p.add_argument("--num-pull", type=int, default=10, metavar="N",
+                   help="how often to pull params (default: 10)")
+    p.add_argument("--num-push", type=int, default=10, metavar="N",
+                   help="how often to push grads (default: 10)")
+    p.add_argument("--cuda", action="store_true", default=False,
+                   help="use the accelerator (alias for --backend=tpu on this hardware)")
+    p.add_argument("--log-interval", type=int, default=100, metavar="N",
+                   help="how often to evaluate and print out")
+    p.add_argument("--no-distributed", action="store_true", default=False,
+                   help="run the single-process baseline instead of distributed training")
+    p.add_argument("--rank", type=int, metavar="N",
+                   help="rank of current process (0 is server, 1+ is training node)")
+    p.add_argument("--world-size", type=int, default=3, metavar="N",
+                   help="size of the world")
+    p.add_argument("--server", action="store_true", default=False,
+                   help="server node?")
+    p.add_argument("--master", type=str, default="localhost",
+                   help="ip address of the master (server) node")
+    p.add_argument("--port", type=str, default="29500",
+                   help="port on master node to communicate with")
+    # --- TPU-era extensions ---
+    p.add_argument("--backend", type=str, default="auto", choices=["auto", "tpu", "cpu"],
+                   help="compute backend (auto = jax default platform)")
+    p.add_argument("--mode", type=str, default="ps", choices=["ps", "sync", "local-sgd"],
+                   help="distributed strategy: async parameter server (reference core), "
+                        "sync psum allreduce, or compiled local-SGD averaging")
+    p.add_argument("--model", type=str, default="alexnet",
+                   choices=["alexnet", "lenet", "resnet18", "resnet50"],
+                   help="model architecture (reference hardcodes AlexNet, example/main.py:41)")
+    p.add_argument("--dtype", type=str, default="float32", choices=["float32", "bfloat16"],
+                   help="compute dtype (bfloat16 feeds the MXU natively)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--data-root", type=str, default="./data",
+                   help="CIFAR-10 location (reference downloads here, example/main.py:24)")
+    p.add_argument("--synthetic-data", action="store_true", default=False,
+                   help="force the deterministic synthetic dataset")
+    p.add_argument("--synthetic-train-size", type=int, default=50000)
+    p.add_argument("--synthetic-test-size", type=int, default=10000)
+    p.add_argument("--log-dir", type=str, default="log")
+    p.add_argument("--sync-every", type=int, default=0, metavar="K",
+                   help="local-sgd mode: average params every K steps "
+                        "(default 0 = use --num-push)")
+    return p
+
+
+def _apply_backend(args) -> None:
+    if args.cuda and args.backend == "auto":
+        args.backend = "tpu"
+    if args.backend == "cpu":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from distributed_ml_pytorch_tpu.runtime.mesh import force_cpu_devices
+
+        force_cpu_devices(int(os.environ.get("DMT_CPU_DEVICES", "1")))
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    print(args)
+    _apply_backend(args)
+
+    import jax
+
+    if args.no_distributed:
+        # reference `make single` / `make gpu` path (SURVEY.md §3.5)
+        from distributed_ml_pytorch_tpu.training.trainer import train_single
+
+        _announce_dataset(args)
+        _state, logger = train_single(args)
+        name = "single.csv" if jax.devices()[0].platform == "cpu" else "tpu.csv"
+        path = logger.to_csv(name)
+        print("wrote", path)
+        print("Finished Training")
+        return 0
+
+    if args.mode == "ps":
+        try:
+            from distributed_ml_pytorch_tpu.parallel.async_ps import run_ps_process
+        except ImportError as e:
+            print(f"error: --mode ps is unavailable in this build: {e}", file=sys.stderr)
+            return 2
+        return run_ps_process(args)
+    elif args.mode == "sync":
+        from distributed_ml_pytorch_tpu.parallel.sync import train_sync
+
+        _announce_dataset(args)
+        _state, logger = train_sync(args)
+        path = logger.to_csv("node{}.csv".format(jax.process_index()))
+        print("wrote", path)
+        print("Finished Training")
+        return 0
+    else:
+        from distributed_ml_pytorch_tpu.parallel.local_sgd import train_local_sgd
+
+        _announce_dataset(args)
+        _state, logger = train_local_sgd(args)
+        path = logger.to_csv("node{}.csv".format(jax.process_index()))
+        print("wrote", path)
+        print("Finished Training")
+        return 0
+
+
+def _announce_dataset(args) -> None:
+    from distributed_ml_pytorch_tpu.data.cifar10 import _load_pickle_batches
+
+    real = (not args.synthetic_data) and _load_pickle_batches(args.data_root) is not None
+    print("dataset: {} CIFAR-10".format("real" if real else "synthetic"))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,208 @@
+"""C4/C5: the trainer and evaluator (parity with reference ``example/main.py:31-133``).
+
+The reference's hot loop (``example/main.py:57-91``) is zero_grad → forward →
+cross_entropy → backward → step with periodic eval. Here the whole step —
+forward, loss, backward, SGD update — is one jitted function: XLA fuses the
+elementwise chain into the conv/matmul kernels on the MXU, and the only
+host↔device traffic per step is the input batch in and a scalar loss out.
+
+Parity decisions (SURVEY.md §7 "reproduce the intent, not the defect"):
+
+- plain SGD, ``momentum=0.0`` (reference ``example/main.py:44``);
+- eval every ``log_interval`` batches with ``i > 0`` (``:83-84``) and a
+  verbose eval each epoch end (``:93``);
+- ``test_loss`` is the *sum* of per-batch mean losses (``:125`` semantics —
+  identical to a single number when ``test_batch_size`` covers the whole
+  set, the reference default of 10000);
+- accuracy over the **full** test set (the reference scores only its final
+  batch with swapped args — a defect, not copied);
+- no eval-mode leak: dropout is controlled per-call by ``train=``, unlike the
+  reference whose ``net.eval()`` at ``:113`` permanently disables dropout
+  after the first mid-epoch eval;
+- the never-stepped LambdaLR scheduler (``:47-48``) is intentionally not
+  reproduced — lr stays constant, which is the reference's *effective*
+  behavior.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+
+from distributed_ml_pytorch_tpu.data import CIFAR10_CLASSES, iterate_batches
+from distributed_ml_pytorch_tpu.utils.metrics import (
+    MetricsLogger,
+    print_classification_report,
+    print_eval_line,
+)
+
+Pytree = Any
+
+
+class TrainState(struct.PyTreeNode):
+    """Minimal functional train state: params + optimizer state + step count."""
+
+    params: Pytree
+    opt_state: optax.OptState
+    step: jnp.ndarray
+
+    @classmethod
+    def create(cls, params: Pytree, tx: optax.GradientTransformation) -> "TrainState":
+        return cls(params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32))
+
+
+def create_train_state(
+    model, rng: jax.Array, lr: float, momentum: float = 0.0, sample_shape=(1, 32, 32, 3)
+) -> Tuple[TrainState, optax.GradientTransformation]:
+    """Initialize params + plain SGD (reference ``optim.SGD(lr, momentum=0.0)``,
+    ``example/main.py:44``)."""
+    params = model.init(rng, jnp.zeros(sample_shape))["params"]
+    tx = optax.sgd(lr, momentum=momentum if momentum else None)
+    return TrainState.create(params, tx), tx
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy (reference ``F.cross_entropy``, ``example/main.py:71``)."""
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def make_train_step(model, tx: optax.GradientTransformation) -> Callable:
+    """One fully-jitted SGD step: forward + loss + backward + update."""
+
+    @jax.jit
+    def train_step(state: TrainState, images, labels, dropout_rng) -> Tuple[TrainState, jnp.ndarray]:
+        rng = jax.random.fold_in(dropout_rng, state.step)
+
+        def loss_fn(params):
+            logits = model.apply(
+                {"params": params}, images, train=True, rngs={"dropout": rng}
+            )
+            return cross_entropy_loss(logits, labels)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(params=params, opt_state=opt_state, step=state.step + 1), loss
+
+    return train_step
+
+
+def make_eval_fn(model) -> Callable:
+    """Jitted per-batch eval: (summed-mean loss contribution, predictions)."""
+
+    @jax.jit
+    def eval_step(params, images, labels):
+        logits = model.apply({"params": params}, images, train=False)
+        loss = cross_entropy_loss(logits, labels)
+        preds = jnp.argmax(logits, axis=-1)
+        return loss, preds
+
+    return eval_step
+
+
+def evaluate(
+    eval_step: Callable,
+    params: Pytree,
+    x_test: np.ndarray,
+    y_test: np.ndarray,
+    test_batch_size: int,
+    verbose: bool = False,
+) -> Tuple[float, float]:
+    """Full test-set pass (reference ``evaluate``, ``example/main.py:110-133``).
+
+    Returns ``(test_loss, test_accuracy)`` where ``test_loss`` accumulates
+    per-batch mean losses (reference ``:125`` summed semantics) and accuracy
+    covers the whole test set.
+    """
+    total_loss = 0.0
+    preds_all = []
+    labels_all = []
+    for bx, by in iterate_batches(
+        x_test, y_test, min(test_batch_size, len(x_test)), shuffle=False, drop_last=False
+    ):
+        loss, preds = eval_step(params, bx, by)
+        total_loss += float(loss)
+        preds_all.append(np.asarray(preds))
+        labels_all.append(by)
+    y_pred = np.concatenate(preds_all)
+    y_true = np.concatenate(labels_all)
+    accuracy = float((y_pred == y_true).mean())
+    if verbose:
+        print_classification_report(y_true, y_pred, CIFAR10_CLASSES, total_loss, accuracy)
+    return total_loss, accuracy
+
+
+def run_training_loop(
+    *,
+    model,
+    state: TrainState,
+    train_step: Callable,
+    eval_step: Callable,
+    data,
+    args,
+    logger: MetricsLogger,
+    on_step: Optional[Callable] = None,
+) -> TrainState:
+    """Shared epoch/batch loop (reference ``example/main.py:57-93`` shape).
+
+    ``on_step(state, epoch, i) -> state`` lets parallel strategies hook the
+    between-steps boundary (e.g. the async-PS param swap) without forking the
+    trainer — the backend-agnosticism SURVEY.md §7 calls for.
+    """
+    x_train, y_train, x_test, y_test = data
+    dropout_rng = jax.random.key(getattr(args, "seed", 0) + 1)
+    for epoch in range(args.epochs):
+        print("Training for epoch {}".format(epoch))
+        for i, (bx, by) in enumerate(
+            iterate_batches(x_train, y_train, args.batch_size, seed=getattr(args, "seed", 0), epoch=epoch)
+        ):
+            if on_step is not None:
+                state = on_step(state, epoch, i)
+            state, loss = train_step(state, bx, by, dropout_rng)
+            rec_extra = {}
+            if i % args.log_interval == 0 and i > 0:  # reference :83-84
+                test_loss, test_acc = evaluate(
+                    eval_step, state.params, x_test, y_test, args.test_batch_size
+                )
+                rec_extra = {"test_loss": test_loss, "test_accuracy": test_acc}
+            rec = logger.log_step(i, float(loss), **rec_extra)
+            if rec_extra:
+                print_eval_line(rec)
+        evaluate(eval_step, state.params, x_test, y_test, args.test_batch_size, verbose=True)
+    return state
+
+
+def train_single(args) -> Tuple[TrainState, MetricsLogger]:
+    """Single-process baseline training (reference ``make single``/``make gpu``,
+    SURVEY.md §3.5). Runs on whatever backend jax selected — the TPU chip by
+    default here, CPU under ``--backend=cpu``."""
+    from distributed_ml_pytorch_tpu.data import get_dataset
+    from distributed_ml_pytorch_tpu.models import get_model
+
+    x_train, y_train, x_test, y_test = get_dataset(args)
+    model = get_model(
+        getattr(args, "model", "alexnet"),
+        dtype=jnp.bfloat16 if getattr(args, "dtype", "float32") == "bfloat16" else jnp.float32,
+    )
+    state, tx = create_train_state(model, jax.random.key(getattr(args, "seed", 0)), args.lr)
+    train_step = make_train_step(model, tx)
+    eval_step = make_eval_fn(model)
+    logger = MetricsLogger(getattr(args, "log_dir", "log"))
+    t0 = time.time()
+    state = run_training_loop(
+        model=model,
+        state=state,
+        train_step=train_step,
+        eval_step=eval_step,
+        data=(x_train, y_train, x_test, y_test),
+        args=args,
+        logger=logger,
+    )
+    print("Finished Training ({:.1f}s)".format(time.time() - t0))
+    return state, logger

@@ -1,0 +1,81 @@
+"""C4/C5 observability: telemetry prints, structured per-iteration records, and
+per-rank CSV dumps (parity with reference ``example/main.py:33,76-105``).
+
+Log record schema matches the reference exactly: ``timestamp, iteration,
+training_loss`` every step, plus ``test_loss, test_accuracy`` on eval
+iterations (``example/main.py:76-84``); CSVs are written with an ``index``
+label column via pandas (``:97-105``).
+"""
+
+from __future__ import annotations
+
+import os
+from datetime import datetime
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class MetricsLogger:
+    """Accumulates per-iteration log records and dumps one CSV per rank."""
+
+    def __init__(self, log_dir: str = "log"):
+        self.log_dir = log_dir
+        self.records: List[Dict] = []
+
+    def log_step(self, iteration: int, training_loss: float, **extra) -> Dict:
+        rec = {
+            "timestamp": datetime.now(),
+            "iteration": iteration,
+            "training_loss": float(training_loss),
+        }
+        rec.update(extra)
+        self.records.append(rec)
+        return rec
+
+    def to_csv(self, filename: str) -> str:
+        """Dump accumulated records (reference ``example/main.py:97-105``).
+
+        ``filename`` examples: ``single.csv``, ``tpu.csv`` (the reference's
+        ``gpu.csv`` renamed for this hardware), ``node{rank}.csv``.
+        """
+        import pandas as pd
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        path = os.path.join(self.log_dir, filename)
+        df = pd.DataFrame(self.records)
+        df.to_csv(path, index_label="index")
+        return path
+
+
+def print_eval_line(rec: Dict) -> None:
+    """Per-interval telemetry line (format parity with ``example/main.py:85-89``)."""
+    print(
+        "Timestamp: {timestamp} | "
+        "Iteration: {iteration:6} | "
+        "Loss: {training_loss:6.4f} | "
+        "Test Loss: {test_loss:6.4f} | "
+        "Test Accuracy: {test_accuracy:6.4f}".format(**rec)
+    )
+
+
+def print_classification_report(
+    y_true: np.ndarray, y_pred: np.ndarray, class_names, test_loss: float, accuracy: float
+) -> None:
+    """Verbose per-epoch eval report (reference ``example/main.py:128-131``).
+
+    Unlike the reference — which scores only the final test batch and passes
+    ``(predicted, labels)`` to sklearn in swapped order (a defect SURVEY.md §7
+    says not to copy) — this reports over the full test set with ``y_true``
+    first.
+    """
+    from sklearn.metrics import classification_report
+
+    print("Loss: {:.3f}".format(test_loss))
+    print("Accuracy: {:.3f}".format(accuracy))
+    print(
+        classification_report(
+            np.asarray(y_true), np.asarray(y_pred), target_names=list(class_names),
+            labels=list(range(len(class_names))), zero_division=0,
+        )
+    )
