@@ -1,0 +1,14 @@
+from distributed_ml_pytorch_tpu.parallel.sync import (
+    make_sync_train_step,
+    shard_batch,
+    train_sync,
+)
+from distributed_ml_pytorch_tpu.parallel.p2p import p2p_shift, p2p_send_recv
+
+__all__ = [
+    "make_sync_train_step",
+    "shard_batch",
+    "train_sync",
+    "p2p_shift",
+    "p2p_send_recv",
+]

@@ -1,0 +1,172 @@
+"""Synchronous data parallelism over the device mesh (BASELINE.json north star).
+
+The reference has **no** synchronous allreduce path (SURVEY.md §2.4) — its only
+collective usage is PS messaging plus a p2p demo — but the driver's north star
+requires the TPU backend to train with per-step gradient allreduce over ICI,
+replacing what a NCCL/gloo DDP run does on GPU clusters.
+
+Design: one jitted step under ``jax.shard_map``. Each device computes the
+loss/grads of its batch shard; an explicit ``lax.pmean`` over the ``data``
+mesh axis is the gradient allreduce — compiled by XLA into ICI collectives on
+a TPU slice (DCN across slices on multi-host meshes), overlapping with
+backprop where the scheduler allows. Parameters and optimizer state are
+replicated; the update is computed identically on every device, so no
+broadcast is needed (the DDP invariant).
+
+The same code runs single-host (one controller, all local devices) or
+multi-host SPMD (every controller runs this same program after
+``runtime.initialize_distributed``) — mesh construction is the only
+difference, which keeps the trainer backend-agnostic per SURVEY.md §7.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_ml_pytorch_tpu.training.trainer import (
+    TrainState,
+    create_train_state,
+    cross_entropy_loss,
+    evaluate,
+    make_eval_fn,
+)
+from distributed_ml_pytorch_tpu.utils.metrics import MetricsLogger, print_eval_line
+
+Pytree = Any
+
+
+def shard_batch(mesh: Mesh, *arrays: np.ndarray, axis: str = "data"):
+    """Place host arrays on the mesh, sharded along the leading (batch) dim.
+
+    Single-controller: a plain ``device_put``. Multi-host: each controller
+    passes its *process-local* slice of the global batch and the global array
+    is assembled across hosts via ``make_array_from_process_local_data`` —
+    each host only ever touches the data its own devices consume (per-host
+    sharded loading, SURVEY.md §7 input-pipeline note).
+    """
+    out = tuple(
+        put_sharded(mesh, a, P(axis, *([None] * (a.ndim - 1)))) for a in arrays
+    )
+    return out if len(out) > 1 else out[0]
+
+
+def put_sharded(mesh: Mesh, array: np.ndarray, spec: P):
+    """Place one host array on the mesh under ``spec`` — ``device_put`` on a
+    single controller, cross-host assembly from per-process slices otherwise."""
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(sharding, array)
+    return jax.device_put(array, sharding)
+
+
+def replicate(mesh: Mesh, tree: Pytree) -> Pytree:
+    """Replicate a pytree across the mesh (params/opt state)."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def make_sync_train_step(
+    model, tx: optax.GradientTransformation, mesh: Mesh, axis: str = "data"
+) -> Callable:
+    """Build the jitted DDP step: local grads + ``pmean`` allreduce + SGD."""
+
+    def shard_fn(state: TrainState, images, labels, rng):
+        step_rng = jax.random.fold_in(
+            jax.random.fold_in(rng, state.step), jax.lax.axis_index(axis)
+        )
+
+        def loss_fn(params):
+            logits = model.apply(
+                {"params": params}, images, train=True, rngs={"dropout": step_rng}
+            )
+            return cross_entropy_loss(logits, labels)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        # THE allreduce. Params enter replicated (invariant over the mesh) and
+        # data enters sharded, so differentiation itself inserts the cross-
+        # device psum of gradients — the transpose of the implicit pvary under
+        # shard_map's varying-axes tracking. That psum IS the DDP allreduce,
+        # compiled to an ICI collective (the reference's out-of-tree gloo C++
+        # transport re-expressed as an XLA collective — SURVEY.md §2.2).
+        # Normalize the sum of per-shard means into the global-batch mean:
+        n = jax.lax.psum(1, axis)
+        grads = jax.tree.map(lambda g: g / n, grads)
+        loss = jax.lax.pmean(loss, axis)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(params=params, opt_state=opt_state, step=state.step + 1), loss
+
+    sharded = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P()),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded)
+
+
+def train_sync(args, mesh: Mesh | None = None) -> Tuple[TrainState, MetricsLogger]:
+    """Synchronous data-parallel training loop.
+
+    ``--batch-size`` is the **per-device** batch (matching the reference's
+    per-worker batch of 64, ``example/main.py:142``); the global batch is
+    ``batch_size × mesh size``. Each epoch reshuffles; on multi-host meshes
+    every controller loads only its strided shard of the training set and
+    feeds its per-process slice of each global batch.
+    """
+    from distributed_ml_pytorch_tpu.data import get_dataset, iterate_batches, shard_for_process
+    from distributed_ml_pytorch_tpu.models import get_model
+    from distributed_ml_pytorch_tpu.runtime import data_mesh
+
+    mesh = mesh or data_mesh()
+    n_dev = mesh.devices.size
+    global_batch = args.batch_size * n_dev
+
+    x_train, y_train, x_test, y_test = get_dataset(args)
+    n_proc = jax.process_count()
+    if n_proc > 1:
+        x_train, y_train = shard_for_process(x_train, y_train, jax.process_index(), n_proc)
+    model = get_model(
+        getattr(args, "model", "alexnet"),
+        dtype=jnp.bfloat16 if getattr(args, "dtype", "float32") == "bfloat16" else jnp.float32,
+    )
+    state, tx = create_train_state(model, jax.random.key(getattr(args, "seed", 0)), args.lr)
+    state = replicate(mesh, state)
+    train_step = make_sync_train_step(model, tx, mesh)
+    eval_step = make_eval_fn(model)
+    logger = MetricsLogger(getattr(args, "log_dir", "log"))
+    rng = replicate(mesh, jax.random.key(getattr(args, "seed", 0) + 1))
+
+    t0 = time.time()
+    for epoch in range(args.epochs):
+        print("Training for epoch {}".format(epoch))
+        for i, (bx, by) in enumerate(
+            iterate_batches(
+                x_train,
+                y_train,
+                global_batch // n_proc,  # per-process slice of the global batch
+                seed=getattr(args, "seed", 0),
+                epoch=epoch,
+            )
+        ):
+            bx, by = shard_batch(mesh, bx, by)
+            state, loss = train_step(state, bx, by, rng)
+            rec_extra = {}
+            if i % args.log_interval == 0 and i > 0:
+                test_loss, test_acc = evaluate(
+                    eval_step, state.params, x_test, y_test, args.test_batch_size
+                )
+                rec_extra = {"test_loss": test_loss, "test_accuracy": test_acc}
+            rec = logger.log_step(i, float(loss), **rec_extra)
+            if rec_extra:
+                print_eval_line(rec)
+        evaluate(eval_step, state.params, x_test, y_test, args.test_batch_size, verbose=True)
+    print("Finished sync-DP training ({:.1f}s, {} devices)".format(time.time() - t0, n_dev))
+    return state, logger

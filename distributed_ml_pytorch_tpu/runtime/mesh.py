@@ -68,6 +68,27 @@ def simulate_cpu_devices(n: int = 8) -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+def force_cpu_devices(n: int = 8) -> None:
+    """Re-initialize jax on the CPU platform with ``n`` virtual devices, even if
+    a backend is already live (this environment's sitecustomize initializes a
+    TPU backend at interpreter boot). Used by tests and the localhost demos to
+    simulate a multi-chip mesh on one host — the framework's analog of the
+    reference's localhost multi-process smoke topology (SURVEY.md §4).
+    """
+    import jax as _jax
+
+    devs = _jax.devices()
+    if len(devs) >= n and devs[0].platform == "cpu":
+        return
+    from jax._src import xla_bridge
+
+    xla_bridge._clear_backends()
+    xla_bridge.get_backend.cache_clear()
+    _jax.config.update("jax_platforms", "cpu")
+    _jax.config.update("jax_num_cpu_devices", n)
+    assert len(_jax.devices()) == n, _jax.devices()
+
+
 def make_mesh(
     axis_sizes: Mapping[str, int] | None = None,
     *,

@@ -21,15 +21,11 @@ os.environ["TPU_DISTBELIEF_TEST_ENV"] = "1"
 
 import jax  # noqa: E402
 
+from distributed_ml_pytorch_tpu.runtime.mesh import force_cpu_devices  # noqa: E402
+
 N_DEVICES = 8
 
-if len(jax.devices()) != N_DEVICES or jax.devices()[0].platform != "cpu":
-    from jax._src import xla_bridge
-
-    xla_bridge._clear_backends()
-    xla_bridge.get_backend.cache_clear()
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", N_DEVICES)
+force_cpu_devices(N_DEVICES)
 
 assert len(jax.devices()) == N_DEVICES and jax.devices()[0].platform == "cpu", (
     f"expected {N_DEVICES} virtual CPU devices, got {jax.devices()}"
