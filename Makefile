@@ -1,0 +1,58 @@
+# C11/C13 parity: canned topologies and dev targets (reference Makefile:1-38).
+# The reference's 3-process PS topology on localhost keeps the same names:
+#   make server / make first / make second  (world-size 3, rank 0 = server)
+# plus `make launch` which runs all three in one command.
+
+PY ?= python
+
+# --- canned PS topology (reference Makefile:13-20) ---
+first:
+	$(PY) -m distributed_ml_pytorch_tpu.training.cli --mode ps --rank 1 --world-size 3
+
+second:
+	$(PY) -m distributed_ml_pytorch_tpu.training.cli --mode ps --rank 2 --world-size 3
+
+server:
+	$(PY) -m distributed_ml_pytorch_tpu.training.cli --mode ps --rank 0 --world-size 3 --server
+
+launch:
+	$(PY) -m distributed_ml_pytorch_tpu.launch --world-size 3
+
+# --- single-process baselines (reference Makefile:22-26; `gpu` → `tpu`) ---
+single:
+	$(PY) -m distributed_ml_pytorch_tpu.training.cli --no-distributed --backend cpu
+
+tpu:
+	$(PY) -m distributed_ml_pytorch_tpu.training.cli --no-distributed
+
+gpu: tpu
+
+# --- TPU-native extras ---
+sync:
+	$(PY) -m distributed_ml_pytorch_tpu.training.cli --mode sync
+
+local-sgd:
+	$(PY) -m distributed_ml_pytorch_tpu.training.cli --mode local-sgd
+
+p2p:
+	$(PY) -m distributed_ml_pytorch_tpu.parallel.p2p
+
+bench:
+	$(PY) bench.py
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+# --- plots (reference Makefile:8-11) ---
+graph:
+	$(PY) -m distributed_ml_pytorch_tpu.graph
+	mkdir -p docs && mv train_time.png test_time.png docs/
+
+# --- packaging (reference Makefile:28-38) ---
+install:
+	pip install .
+
+dist:
+	$(PY) setup.py sdist bdist_wheel
+
+.PHONY: first second server launch single tpu gpu sync local-sgd p2p bench test graph install dist

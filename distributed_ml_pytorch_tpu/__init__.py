@@ -32,6 +32,17 @@ from distributed_ml_pytorch_tpu.utils.messaging import (
 )
 from distributed_ml_pytorch_tpu.models import LeNet, AlexNet
 
+
+def __getattr__(name):
+    # contractual PS symbols (M1/M4/C1) — lazy to keep `import
+    # distributed_ml_pytorch_tpu` light
+    if name in ("ParameterServer", "Asynchronous", "DownpourSGD", "Listener"):
+        from distributed_ml_pytorch_tpu.parallel import async_ps
+
+        return getattr(async_ps, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "__version__",
     "ravel_model_params",
@@ -42,4 +53,7 @@ __all__ = [
     "send_message",
     "LeNet",
     "AlexNet",
+    "ParameterServer",
+    "Asynchronous",
+    "DownpourSGD",
 ]

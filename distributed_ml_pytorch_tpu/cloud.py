@@ -1,0 +1,99 @@
+"""C12 parity: cloud job submission.
+
+The reference submits ``example/main.py`` to an AzureML compute target and
+prints the portal URL (``run-pytorch.py:7-19``). The TPU-native analog targets
+Cloud TPU VMs. With no cloud SDK/credentials in the environment, this module
+always *builds* the full submission spec; it submits when the ``gcloud`` CLI
+is available and otherwise prints the exact commands to run (a dry-run, which
+in an air-gapped build environment is the whole behavior — the reference's
+observable contract is "submit and print how to watch the run").
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import shutil
+import subprocess
+import sys
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class TPUJobSpec:
+    """Submission spec (the ScriptRunConfig analog, ``run-pytorch.py:10-12``)."""
+
+    name: str = "single-cpu"                # reference experiment name (:9)
+    compute_target: str = "distbelief-single"  # reference target name (:12)
+    accelerator_type: str = "v5litepod-1"
+    zone: str = "us-central1-a"
+    runtime_version: str = "tpu-ubuntu2204-base"
+    script: str = "distributed_ml_pytorch_tpu.training.cli"
+    script_args: List[str] = field(default_factory=list)
+
+    def create_command(self) -> List[str]:
+        return [
+            "gcloud", "compute", "tpus", "tpu-vm", "create", self.compute_target,
+            f"--zone={self.zone}",
+            f"--accelerator-type={self.accelerator_type}",
+            f"--version={self.runtime_version}",
+        ]
+
+    def run_command(self) -> List[str]:
+        inner = "python -m {} {}".format(
+            self.script, " ".join(shlex.quote(a) for a in self.script_args)
+        )
+        return [
+            "gcloud", "compute", "tpus", "tpu-vm", "ssh", self.compute_target,
+            f"--zone={self.zone}",
+            "--worker=all",
+            f"--command={inner}",
+        ]
+
+    def portal_url(self) -> str:
+        return (
+            "https://console.cloud.google.com/compute/tpus/details/"
+            f"{self.zone}/{self.compute_target}"
+        )
+
+
+def submit(spec: TPUJobSpec, dry_run: bool = False) -> str:
+    """Submit (or print) the job; returns the portal URL (parity with
+    ``run.get_portal_url()``, ``run-pytorch.py:18-19``)."""
+    cmds = [spec.create_command(), spec.run_command()]
+    if dry_run or shutil.which("gcloud") is None:
+        print("# no gcloud available — dry run; execute these to submit:")
+        for cmd in cmds:
+            print(" ".join(shlex.quote(c) for c in cmd))
+    else:
+        for cmd in cmds:
+            subprocess.run(cmd, check=True)
+    url = spec.portal_url()
+    print(url)
+    return url
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="Submit a training job to a Cloud TPU VM")
+    p.add_argument("--name", default="single-cpu")
+    p.add_argument("--compute-target", default="distbelief-single")
+    p.add_argument("--accelerator-type", default="v5litepod-1")
+    p.add_argument("--zone", default="us-central1-a")
+    p.add_argument("--dry-run", action="store_true")
+    args, extra = p.parse_known_args(argv)
+    if extra and extra[0] == "--":
+        extra = extra[1:]
+    spec = TPUJobSpec(
+        name=args.name,
+        compute_target=args.compute_target,
+        accelerator_type=args.accelerator_type,
+        zone=args.zone,
+        script_args=extra,
+    )
+    submit(spec, dry_run=args.dry_run)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

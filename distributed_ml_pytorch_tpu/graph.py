@@ -1,0 +1,96 @@
+"""M5 parity: plot training/eval curves from the per-rank CSV logs.
+
+The reference's ``make graph`` invokes a missing ``example/graph.py`` and
+moves ``train_time.png`` and ``test_time.png`` into ``docs/``
+(``Makefile:9-11``) — the files plotted from the CSV schema written at
+``example/main.py:97-105``. This module produces those two figures from any
+CSVs found in the log directory:
+
+- ``train_time.png`` — training loss vs wall-clock seconds since each run's
+  first record, one series per CSV (rank/run);
+- ``test_time.png`` — test accuracy and test loss vs wall-clock seconds,
+  eval-iteration records only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+
+def _load_runs(log_dir: str):
+    import pandas as pd
+
+    runs = {}
+    for path in sorted(glob.glob(os.path.join(log_dir, "*.csv"))):
+        df = pd.read_csv(path)
+        # skip CSVs without the trainer schema (e.g. an empty zero-epoch run)
+        if len(df) == 0 or not {"timestamp", "training_loss"} <= set(df.columns):
+            continue
+        df["timestamp"] = pd.to_datetime(df["timestamp"])
+        df["seconds"] = (df["timestamp"] - df["timestamp"].iloc[0]).dt.total_seconds()
+        runs[os.path.splitext(os.path.basename(path))[0]] = df
+    return runs
+
+
+def make_graphs(log_dir: str = "log", out_dir: str = ".") -> list:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    runs = _load_runs(log_dir)
+    if not runs:
+        raise FileNotFoundError(f"no CSV logs found under {log_dir!r}")
+    written = []
+
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for name, df in runs.items():
+        ax.plot(df["seconds"], df["training_loss"], label=name, linewidth=1)
+    ax.set_xlabel("seconds")
+    ax.set_ylabel("training loss")
+    ax.set_title("Training loss over time")
+    ax.legend()
+    path = os.path.join(out_dir, "train_time.png")
+    fig.savefig(path, dpi=120, bbox_inches="tight")
+    plt.close(fig)
+    written.append(path)
+
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(12, 5))
+    plotted = False
+    for name, df in runs.items():
+        if "test_accuracy" not in df.columns:
+            continue
+        ev = df.dropna(subset=["test_accuracy"])
+        if len(ev) == 0:
+            continue
+        ax1.plot(ev["seconds"], ev["test_accuracy"], marker="o", label=name)
+        ax2.plot(ev["seconds"], ev["test_loss"], marker="o", label=name)
+        plotted = True
+    ax1.set_xlabel("seconds"); ax1.set_ylabel("test accuracy")
+    ax2.set_xlabel("seconds"); ax2.set_ylabel("test loss")
+    if plotted:
+        ax1.legend()
+        ax2.legend()
+    fig.suptitle("Evaluation over time")
+    path = os.path.join(out_dir, "test_time.png")
+    fig.savefig(path, dpi=120, bbox_inches="tight")
+    plt.close(fig)
+    written.append(path)
+    return written
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="Plot train/test curves from CSV logs")
+    p.add_argument("--log-dir", default="log")
+    p.add_argument("--out-dir", default=".")
+    args = p.parse_args(argv)
+    for path in make_graphs(args.log_dir, args.out_dir):
+        print("wrote", path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

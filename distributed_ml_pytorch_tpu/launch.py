@@ -1,0 +1,114 @@
+"""C11 parity: localhost multi-process launcher.
+
+The reference launches its 3-process PS topology by hand from three shells
+(``Makefile:13-20``) and its p2p demo with ``torch.multiprocessing`` spawn
+(``pytorch_p2p_ex.py:26-36``). This module does both in one command::
+
+    python -m distributed_ml_pytorch_tpu.launch --world-size 3 -- \
+        --model lenet --epochs 1 --synthetic-data
+
+spawning rank 0 as the parameter server and ranks 1..N-1 as workers, all
+against a TCP rendezvous on localhost. Everything after ``--`` is forwarded to
+the trainer CLI verbatim. On a real TPU pod this launcher is unnecessary —
+the pod runtime starts one controller per host and ``runtime.mesh`` handles
+rendezvous — so this exists for the single-host smoke topology the reference
+relies on (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import List
+
+
+def _free_port() -> str:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return str(s.getsockname()[1])
+
+
+def cpu_platform_env(base: dict | None = None, n_devices: int = 1) -> dict:
+    """Env for running a process on the CPU platform with ``n_devices`` virtual
+    devices (shared by the launcher and the integration tests): the PS path is
+    a host-side topology, so N local processes must not fight over one TPU
+    chip, and the boot-time TPU plugin registration is skipped."""
+    env = dict(base if base is not None else os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS=env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_devices}",
+    )
+    return env
+
+
+def launch_world(
+    world_size: int,
+    extra_args: List[str],
+    *,
+    port: str | None = None,
+    cpu: bool = True,
+    poll_interval: float = 0.2,
+) -> int:
+    """Spawn 1 server + (world_size-1) workers; returns the worst exit code.
+
+    Children are monitored: if any process exits nonzero while others are
+    still running, the rest are killed — a crashed worker must not leave the
+    server blocked in accept()/run() forever.
+    """
+    port = port or _free_port()
+    env = cpu_platform_env() if cpu else dict(os.environ)
+    common = [
+        sys.executable, "-m", "distributed_ml_pytorch_tpu.training.cli",
+        "--mode", "ps", "--world-size", str(world_size), "--port", port,
+    ] + list(extra_args)
+    procs = [
+        subprocess.Popen(common + ["--rank", "0", "--server"], env=env)
+    ]
+    for rank in range(1, world_size):
+        procs.append(subprocess.Popen(common + ["--rank", str(rank)], env=env))
+    try:
+        while True:
+            codes = [p.poll() for p in procs]
+            if all(c is not None for c in codes):
+                return max(codes)
+            if any(c not in (None, 0) for c in codes):
+                bad = next(c for c in codes if c not in (None, 0))
+                print(
+                    f"launch: a process exited with code {bad}; terminating the rest",
+                    file=sys.stderr,
+                )
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                for p in procs:
+                    p.wait()
+                return bad
+            time.sleep(poll_interval)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Launch the PS topology on localhost (server + workers)"
+    )
+    parser.add_argument("--world-size", type=int, default=3)
+    parser.add_argument("--port", type=str, default=None)
+    parser.add_argument("--tpu", action="store_true",
+                        help="let processes use the default (TPU) platform instead of CPU")
+    args, extra = parser.parse_known_args(argv)
+    if extra and extra[0] == "--":
+        extra = extra[1:]
+    return launch_world(args.world_size, extra, port=args.port, cpu=not args.tpu)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,6 +4,16 @@ from distributed_ml_pytorch_tpu.parallel.sync import (
     train_sync,
 )
 from distributed_ml_pytorch_tpu.parallel.p2p import p2p_shift, p2p_send_recv
+from distributed_ml_pytorch_tpu.parallel.async_ps import (
+    Asynchronous,
+    DownpourSGD,
+    Listener,
+    ParameterServer,
+)
+from distributed_ml_pytorch_tpu.parallel.local_sgd import (
+    make_local_sgd_round,
+    train_local_sgd,
+)
 
 __all__ = [
     "make_sync_train_step",
@@ -11,4 +21,10 @@ __all__ = [
     "train_sync",
     "p2p_shift",
     "p2p_send_recv",
+    "Asynchronous",
+    "DownpourSGD",
+    "Listener",
+    "ParameterServer",
+    "make_local_sgd_round",
+    "train_local_sgd",
 ]

@@ -1,0 +1,328 @@
+"""Async DownPour-SGD parameter server (C1/C2/M1 parity — the reference's core).
+
+Reference behavior being reproduced (``asgd/optim/Asynchronous.py:20-71``,
+``example/main.py:135-138``, SURVEY.md §2.3):
+
+- Workers train locally with plain SGD and keep a flat accumulator of
+  lr-pre-scaled gradients: ``accum -= lr * grads`` every step (``:54-55``).
+- Every ``n_pull`` steps a worker sends **ParameterRequest**; the server
+  replies with **ParameterUpdate** carrying the current central params
+  (``:48-49``).
+- Every ``n_push`` steps the worker sends **GradientUpdate** with the
+  accumulator, then zeroes it (``:58-60``); the server *adds* the payload to
+  its central params (pre-scaled by ``-lr``, so addition is the update).
+- At construction each worker sends one **ParameterUpdate** installing its
+  initial params as the central params (``:34``).
+- A listener thread receives server pushes concurrently with training
+  (``:9-18``).
+
+TPU-native re-design (SURVEY.md §7 hard part (a)): training steps stay fully
+jitted on-device; the push/pull control plane runs host-side between steps
+over the M2 messaging transports. The reference's deliberate data race — the
+listener writing tensors into a model mid-backprop — becomes a race-free
+**between-steps pytree swap**: the listener deposits the newest flat vector in
+a mailbox, and the optimizer installs it at the next step boundary. Staleness
+semantics (params may be replaced between any two steps, at pull cadence) are
+preserved; torn reads are not.
+
+The worker's per-step device work (local SGD + accumulator update) is one
+fused jitted program; device↔host transfers happen only at push/pull
+boundaries (the flat vector in/out), every ``n_push``/``n_pull`` steps.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    SERVER_RANK,
+    MessageCode,
+    MessageListener,
+    Transport,
+    send_message,
+)
+from distributed_ml_pytorch_tpu.utils.serialization import (
+    make_unraveler,
+    ravel_model_params,
+)
+
+_LOGGER = logging.getLogger(__name__)
+
+Pytree = Any
+
+
+class ParameterServer:
+    """Central parameter holder (M1 contract, ``example/main.py:137-138``).
+
+    ``run()`` blocks serving messages until every worker has sent
+    ``WorkerDone`` (an extension code — the reference server blocks forever,
+    SURVEY.md §3.2 notes its post-``run()`` code is dead; a clean shutdown is
+    the intent-preserving improvement).
+    """
+
+    def __init__(
+        self,
+        model: Pytree = None,
+        *,
+        params: Optional[np.ndarray] = None,
+        transport: Optional[Transport] = None,
+        n_workers: Optional[int] = None,
+    ):
+        if params is not None:
+            self.central = np.asarray(params, dtype=np.float32).copy()
+        elif model is not None:
+            self.central = np.asarray(ravel_model_params(model), dtype=np.float32).copy()
+        else:
+            raise ValueError("ParameterServer needs a model pytree or a flat params vector")
+        self.transport = transport
+        self.n_workers = n_workers
+        self.message_counts = {code: 0 for code in MessageCode}
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def handle(self, sender: int, code: MessageCode, payload: np.ndarray) -> None:
+        _LOGGER.info("Processing message: %s", code.name)
+        self.message_counts[code] = self.message_counts.get(code, 0) + 1
+        if code == MessageCode.GradientUpdate:
+            # workers pre-scale by -lr (Asynchronous.py:55) → server-side add
+            self.central += payload
+        elif code == MessageCode.ParameterRequest:
+            send_message(
+                MessageCode.ParameterUpdate, self.central, dst=sender, transport=self.transport
+            )
+        elif code == MessageCode.ParameterUpdate:
+            self.central = payload.astype(np.float32).copy()
+
+    def run(self, timeout: Optional[float] = None) -> None:
+        """Serve until all workers finish (or ``stop()``/``timeout``)."""
+        done_workers = set()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._stop.is_set():
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            msg = self.transport.recv(timeout=0.2)
+            if msg is None:
+                continue
+            sender, code, payload = msg
+            if code == MessageCode.WorkerDone:
+                done_workers.add(sender)
+                if self.n_workers is not None and len(done_workers) >= self.n_workers:
+                    break
+                continue
+            self.handle(sender, code, payload)
+
+
+class Listener(MessageListener):
+    """C2 parity (``Asynchronous.py:9-18``): receives ParameterUpdate pushes.
+
+    Instead of writing into live parameters mid-step (the reference's
+    lock-free race), deposits the newest flat vector into a mailbox for the
+    optimizer to swap in between steps.
+    """
+
+    def __init__(self, transport: Optional[Transport] = None):
+        super().__init__(transport=transport)
+        self._lock = threading.Lock()
+        self._latest: Optional[np.ndarray] = None
+
+    def receive(self, sender: int, message_code: MessageCode, parameter: np.ndarray) -> None:
+        _LOGGER.info("Processing message: %s", message_code.name)
+        if message_code == MessageCode.ParameterUpdate:
+            with self._lock:
+                self._latest = parameter
+
+    def take_latest(self) -> Optional[np.ndarray]:
+        with self._lock:
+            latest, self._latest = self._latest, None
+        return latest
+
+
+class Asynchronous:
+    """DownPour-SGD client optimizer (C1 parity, ``Asynchronous.py:20-71``).
+
+    Functional step API: ``params = opt.step(params, grads)``. Keeps the
+    reference's cadence semantics exactly — including firing both the pull
+    request and the push on step index 0, as the reference's ``idx % n == 0``
+    tests do (``:48,58``).
+    """
+
+    def __init__(
+        self,
+        params: Pytree,
+        lr: float,
+        n_push: int,
+        n_pull: int,
+        *,
+        transport: Optional[Transport] = None,
+    ):
+        if lr < 0.0:
+            raise ValueError("Invalid learning rate: {}".format(lr))
+        self.lr = float(lr)
+        self.n_push = int(n_push)
+        self.n_pull = int(n_pull)
+        self.transport = transport
+        self.idx = 0
+        self.unravel = make_unraveler(params)
+        # accumulator allocation parity: zeros sized like the raveled model
+        # (Asynchronous.py:27)
+        self.accum = jnp.zeros_like(ravel_model_params(params))
+        # install this worker's initial params as the central params (:34)
+        send_message(
+            MessageCode.ParameterUpdate, ravel_model_params(params), transport=transport
+        )
+        self.listener = Listener(transport=transport)
+        self.listener.start()
+
+        lr_const = self.lr
+
+        @jax.jit
+        def _device_step(params, grads, accum):
+            flat_grads = ravel_model_params(params, grads=grads)
+            accum = accum - lr_const * flat_grads  # lr-pre-scaled accumulation (:55)
+            new_params = jax.tree.map(lambda p, g: p - lr_const * g, params, grads)  # local SGD (:63-68)
+            return new_params, accum
+
+        self._device_step = _device_step
+
+    def step(self, params: Pytree, grads: Pytree) -> Pytree:
+        # install the freshest server push at the step boundary (race-free
+        # version of the reference's mid-step unravel, Asynchronous.py:17-18)
+        latest = self.listener.take_latest()
+        if latest is not None:
+            params = self.unravel(jnp.asarray(latest))
+
+        # request fresh params every n_pull steps (:48-49); the reference
+        # ships the accumulator as a dummy payload — an empty payload is the
+        # intent (the request carries no information)
+        if self.idx % self.n_pull == 0:
+            send_message(
+                MessageCode.ParameterRequest, np.zeros(0, np.float32), transport=self.transport
+            )
+
+        params, self.accum = self._device_step(params, grads, self.accum)
+
+        # push the accumulated (lr-scaled) gradients every n_push steps (:58-60)
+        if self.idx % self.n_push == 0:
+            send_message(MessageCode.GradientUpdate, np.asarray(self.accum), transport=self.transport)
+            self.accum = jnp.zeros_like(self.accum)
+
+        self.idx += 1
+        return params
+
+    def finish(self) -> None:
+        """Flush a final push, notify the server, stop the listener."""
+        send_message(MessageCode.GradientUpdate, np.asarray(self.accum), transport=self.transport)
+        send_message(MessageCode.WorkerDone, np.zeros(0, np.float32), transport=self.transport)
+        self.listener.stop()
+
+
+# M4 contract parity: the same optimizer under its original DownPour name
+# (asgd/optim/__init__.py:1 re-exports `DownpourSGD`; the reference's rename
+# left a dangling super(DownpourSGD, ...) at Asynchronous.py:40).
+DownpourSGD = Asynchronous
+
+
+def train_worker(args, transport: Transport) -> Tuple[Pytree, "MetricsLogger"]:
+    """Worker-side training loop (reference ``main(args)`` distributed branch,
+    ``example/main.py:31-105``)."""
+    from distributed_ml_pytorch_tpu.data import get_dataset, iterate_batches
+    from distributed_ml_pytorch_tpu.models import get_model
+    from distributed_ml_pytorch_tpu.training.trainer import (
+        cross_entropy_loss,
+        evaluate,
+        make_eval_fn,
+    )
+    from distributed_ml_pytorch_tpu.utils.metrics import MetricsLogger, print_eval_line
+
+    x_train, y_train, x_test, y_test = get_dataset(args)
+    model = get_model(getattr(args, "model", "alexnet"))
+    seed = getattr(args, "seed", 0)
+    params = model.init(jax.random.key(seed), jnp.zeros((1, 32, 32, 3)))["params"]
+    opt = Asynchronous(
+        params, lr=args.lr, n_push=args.num_push, n_pull=args.num_pull, transport=transport
+    )
+    dropout_rng = jax.random.key(seed + 1 + transport.rank)
+
+    @jax.jit
+    def grad_fn(p, images, labels, rng, step):
+        def loss_fn(q):
+            logits = model.apply(
+                {"params": q}, images, train=True,
+                rngs={"dropout": jax.random.fold_in(rng, step)},
+            )
+            return cross_entropy_loss(logits, labels)
+
+        return jax.value_and_grad(loss_fn)(p)
+
+    eval_step = make_eval_fn(model)
+    logger = MetricsLogger(getattr(args, "log_dir", "log"))
+    # each worker shuffles with its own seed — the reference's per-worker
+    # DataLoader(shuffle=True) gives independent streams (example/main.py:27)
+    for epoch in range(args.epochs):
+        print("Training for epoch {}".format(epoch))
+        for i, (bx, by) in enumerate(
+            iterate_batches(
+                x_train, y_train, args.batch_size, seed=seed + 1000 * transport.rank, epoch=epoch
+            )
+        ):
+            loss, grads = grad_fn(params, bx, by, dropout_rng, opt.idx)
+            params = opt.step(params, grads)
+            rec_extra = {}
+            if i % args.log_interval == 0 and i > 0:
+                test_loss, test_acc = evaluate(
+                    eval_step, params, x_test, y_test, args.test_batch_size
+                )
+                rec_extra = {"test_loss": test_loss, "test_accuracy": test_acc}
+            rec = logger.log_step(i, float(loss), **rec_extra)
+            if rec_extra:
+                print_eval_line(rec)
+        evaluate(eval_step, params, x_test, y_test, args.test_batch_size, verbose=True)
+    opt.finish()
+    return params, logger
+
+
+def run_server(args, transport: Transport) -> ParameterServer:
+    """Server-side entry (reference ``init_server``, ``example/main.py:135-138``)."""
+    from distributed_ml_pytorch_tpu.models import get_model
+
+    model = get_model(getattr(args, "model", "alexnet"))
+    params = model.init(
+        jax.random.key(getattr(args, "seed", 0)), jnp.zeros((1, 32, 32, 3))
+    )["params"]
+    server = ParameterServer(
+        params, transport=transport, n_workers=args.world_size - 1
+    )
+    server.run()
+    return server
+
+
+def run_ps_process(args) -> int:
+    """CLI entry for one PS-topology process (rank 0 = server, 1+ = workers) —
+    replaces the reference's gloo rendezvous + role dispatch
+    (``example/main.py:163-168``)."""
+    from distributed_ml_pytorch_tpu.utils.messaging import TCPTransport
+
+    if args.rank is None:
+        raise SystemExit("--rank is required for distributed --mode ps runs")
+    transport = TCPTransport(args.rank, args.world_size, args.master, int(args.port))
+    try:
+        if args.server or args.rank == SERVER_RANK:
+            run_server(args, transport)
+            print("parameter server: all workers done")
+        else:
+            _params, logger = train_worker(args, transport)
+            path = logger.to_csv("node{}.csv".format(args.rank))
+            print("wrote", path)
+            print("Finished Training")
+    finally:
+        transport.close()
+    return 0

@@ -43,11 +43,18 @@ SERVER_RANK = 0  # reference convention: rank 0 is the parameter server
 
 
 class MessageCode(enum.IntEnum):
-    """Message tags (reference ``Asynchronous.py:17,34,49,59``)."""
+    """Message tags (reference ``Asynchronous.py:17,34,49,59``).
+
+    ``WorkerDone`` is an extension beyond the reference's three codes: it lets
+    the server terminate cleanly once every worker finishes, instead of
+    blocking forever (SURVEY.md §3.2 notes the reference server never
+    returns).
+    """
 
     ParameterUpdate = 0
     ParameterRequest = 1
     GradientUpdate = 2
+    WorkerDone = 3
 
 
 Message = Tuple[int, MessageCode, np.ndarray]
@@ -84,7 +91,10 @@ class InProcessTransport(Transport):
         return {r: cls(r, boxes) for r in range(world_size)}
 
     def send(self, code: MessageCode, payload: np.ndarray, dst: int = SERVER_RANK) -> None:
-        arr = np.asarray(payload, dtype=np.float32).ravel()
+        # Copy: the receiver must never alias the sender's live buffer (e.g.
+        # the server's central params, which it keeps updating in place) — the
+        # TCP transport serializes and gets this isolation for free.
+        arr = np.array(payload, dtype=np.float32, copy=True).ravel()
         self._boxes[dst].put((self.rank, MessageCode(code), arr))
 
     def recv(self, timeout: Optional[float] = None) -> Optional[Message]:
@@ -141,7 +151,14 @@ class TCPTransport(Transport):
     transport.
     """
 
-    def __init__(self, rank: int, world_size: int, master: str = "localhost", port: int = 29500):
+    def __init__(
+        self,
+        rank: int,
+        world_size: int,
+        master: str = "localhost",
+        port: int = 29500,
+        connect_timeout: float = 60.0,
+    ):
         self.rank = rank
         self.world_size = world_size
         self._inbox: "queue.Queue[Message]" = queue.Queue()
@@ -164,7 +181,19 @@ class TCPTransport(Transport):
                 self._peers[peer_rank] = conn
                 self._spawn_reader(conn)
         else:
-            sock = socket.create_connection((master, int(port)), timeout=60)
+            # Retry refused dials until the server is listening — rendezvous
+            # blocks until all ranks join, like the reference's
+            # init_process_group (example/main.py:165), so worker processes
+            # may start before the server.
+            deadline = time.monotonic() + connect_timeout
+            while True:
+                try:
+                    sock = socket.create_connection((master, int(port)), timeout=5)
+                    break
+                except OSError:
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.3)
             sock.settimeout(None)  # connect timeout only; reads must block indefinitely
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             _send_frame(sock, rank, int(MessageCode.ParameterRequest), np.zeros(0, np.float32))

@@ -1,0 +1,50 @@
+"""C11/C12/M5 launcher-layer tests: graph plotting and cloud submission spec."""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.cloud import TPUJobSpec, submit
+from distributed_ml_pytorch_tpu.graph import make_graphs
+from distributed_ml_pytorch_tpu.utils.metrics import MetricsLogger
+
+
+def test_make_graphs_from_csv(tmp_path):
+    logger = MetricsLogger(str(tmp_path / "log"))
+    for i in range(10):
+        extra = {"test_loss": 2.0 - i * 0.1, "test_accuracy": 0.1 * i} if i % 4 == 0 else {}
+        logger.log_step(i, 2.3 - 0.05 * i, **extra)
+    logger.to_csv("node1.csv")
+    written = make_graphs(str(tmp_path / "log"), str(tmp_path))
+    assert sorted(os.path.basename(w) for w in written) == ["test_time.png", "train_time.png"]
+    for w in written:
+        assert os.path.getsize(w) > 1000
+
+
+def test_make_graphs_skips_schemaless_csv(tmp_path):
+    """A zero-epoch run writes a CSV with no schema columns — must be skipped,
+    not crash the plotter."""
+    log_dir = tmp_path / "log"
+    logger = MetricsLogger(str(log_dir))
+    logger.to_csv("empty.csv")  # no records → headerless frame
+    logger2 = MetricsLogger(str(log_dir))
+    logger2.log_step(0, 2.0)
+    logger2.to_csv("real.csv")
+    written = make_graphs(str(log_dir), str(tmp_path))
+    assert len(written) == 2
+
+
+def test_make_graphs_no_logs(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        make_graphs(str(tmp_path), str(tmp_path))
+
+
+def test_cloud_dry_run_prints_commands(capsys):
+    spec = TPUJobSpec(script_args=["--no-distributed", "--epochs", "1"])
+    url = submit(spec, dry_run=True)
+    out = capsys.readouterr().out
+    assert "gcloud compute tpus tpu-vm create distbelief-single" in out
+    assert "--no-distributed --epochs 1" in out
+    assert url.startswith("https://console.cloud.google.com/")
+    assert url in out

@@ -1,0 +1,59 @@
+"""Integration: the full 3-process PS topology over TCP on localhost — the
+reference's `make server` + `make first` + `make second` smoke pattern
+(Makefile:13-20), driven through the real CLI."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributed_ml_pytorch_tpu.launch import _free_port, cpu_platform_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_three_process_ps_topology(tmp_path):
+    port = _free_port()
+    env = cpu_platform_env()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    common = [
+        sys.executable, "-m", "distributed_ml_pytorch_tpu.training.cli",
+        "--mode", "ps", "--model", "lenet", "--epochs", "1",
+        "--batch-size", "16", "--test-batch-size", "64", "--lr", "0.05",
+        "--num-push", "4", "--num-pull", "4", "--log-interval", "4",
+        "--synthetic-data", "--synthetic-train-size", "128",
+        "--synthetic-test-size", "64",
+        "--world-size", "3", "--port", port,
+        "--log-dir", str(tmp_path),
+    ]
+    procs = [
+        subprocess.Popen(
+            common + ["--rank", "0", "--server"],
+            env=env, cwd=str(tmp_path),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+    ]
+    for rank in ("1", "2"):
+        procs.append(
+            subprocess.Popen(
+                common + ["--rank", rank],
+                env=env, cwd=str(tmp_path),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                outs.append(p.communicate()[0])
+    assert all(p.returncode == 0 for p in procs), "\n\n".join(outs)
+    assert "parameter server: all workers done" in outs[0], outs[0]
+    for rank in (1, 2):
+        assert "Finished Training" in outs[rank], outs[rank]
+        assert os.path.exists(os.path.join(str(tmp_path), f"node{rank}.csv"))
