@@ -6,14 +6,19 @@
 PY ?= python
 
 # --- canned PS topology (reference Makefile:13-20) ---
+# The PS topology is host-side: N local processes must not fight over the one
+# TPU chip, so the hand-launched ranks run on the CPU platform (same env that
+# distributed_ml_pytorch_tpu.launch forces for `make launch`).
+PS_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS=
+
 first:
-	$(PY) -m distributed_ml_pytorch_tpu.training.cli --mode ps --rank 1 --world-size 3
+	$(PS_ENV) $(PY) -m distributed_ml_pytorch_tpu.training.cli --mode ps --rank 1 --world-size 3
 
 second:
-	$(PY) -m distributed_ml_pytorch_tpu.training.cli --mode ps --rank 2 --world-size 3
+	$(PS_ENV) $(PY) -m distributed_ml_pytorch_tpu.training.cli --mode ps --rank 2 --world-size 3
 
 server:
-	$(PY) -m distributed_ml_pytorch_tpu.training.cli --mode ps --rank 0 --world-size 3 --server
+	$(PS_ENV) $(PY) -m distributed_ml_pytorch_tpu.training.cli --mode ps --rank 0 --world-size 3 --server
 
 launch:
 	$(PY) -m distributed_ml_pytorch_tpu.launch --world-size 3

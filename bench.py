@@ -1,0 +1,142 @@
+"""Benchmark harness (BASELINE.md config #1, the reference's headline workload).
+
+Measures steady-state training throughput (images/sec/chip) of the flagship
+AlexNet on CIFAR-10-shaped data with the reference training recipe — batch 64,
+SGD lr 0.008 (reference ``example/main.py:142,144-145``) — on the default jax
+device (the TPU chip under the driver; CPU elsewhere).
+
+``vs_baseline`` is measured, not assumed: the same workload (same architecture,
+same batch, same optimizer) is timed in torch on CPU — the reference's own
+``make single`` configuration (reference ``Makefile:23``; the reference
+publishes no numbers, BASELINE.md, so its baseline must be produced). The
+printed ratio is TPU-images/sec over torch-CPU-images/sec.
+
+Prints exactly ONE JSON line on stdout; all narration goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BATCH = 64
+LR = 0.008
+WARMUP = 10
+STEPS = 100
+BASELINE_STEPS = 12
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_batch(batch: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    images = rng.normal(size=(batch, 32, 32, 3)).astype(np.float32)
+    labels = (np.arange(batch) % 10).astype(np.int32)
+    return images, labels
+
+
+def bench_jax(batch: int = BATCH, steps: int = STEPS, warmup: int = WARMUP) -> float:
+    """images/sec of the jitted AlexNet train step on the default device."""
+    import jax
+
+    from distributed_ml_pytorch_tpu.models import AlexNet
+    from distributed_ml_pytorch_tpu.training.trainer import (
+        create_train_state,
+        make_train_step,
+    )
+
+    model = AlexNet(num_classes=10)
+    state, tx = create_train_state(model, jax.random.key(0), lr=LR)
+    train_step = make_train_step(model, tx)
+    images, labels = make_batch(batch)
+    images = jax.device_put(images)
+    labels = jax.device_put(labels)
+    rng = jax.random.key(1)
+
+    for _ in range(warmup):
+        state, loss = train_step(state, images, labels, rng)
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = train_step(state, images, labels, rng)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+    dev = jax.devices()[0]
+    log(f"jax [{dev.platform}]: {steps} steps of batch {batch} in {dt:.3f}s "
+        f"→ {steps * batch / dt:.1f} img/s, final loss {float(loss):.4f}")
+    return steps * batch / dt
+
+
+def bench_torch_cpu(batch: int = BATCH, steps: int = BASELINE_STEPS) -> float | None:
+    """images/sec of the reference workload (torch CPU, same recipe).
+
+    The model is the reference's CIFAR AlexNet re-stated from its architecture
+    spec (SURVEY.md C7: five convs 3→64 k11 s4 p5 / 64→192 k5 p2 / 192→384 k3
+    p1 / 384→256 k3 p1 / 256→256 k3 p1, three 2×2 maxpools, Linear(256, 10)).
+    """
+    try:
+        import torch
+        import torch.nn as tnn
+        import torch.nn.functional as F
+    except Exception as e:  # torch unavailable: no measured baseline
+        log(f"torch baseline unavailable: {e}")
+        return None
+
+    torch.manual_seed(0)
+    torch.set_num_threads(max(1, (torch.get_num_threads() or 1)))
+
+    model = tnn.Sequential(
+        tnn.Conv2d(3, 64, 11, stride=4, padding=5), tnn.ReLU(),
+        tnn.MaxPool2d(2, 2),
+        tnn.Conv2d(64, 192, 5, padding=2), tnn.ReLU(),
+        tnn.MaxPool2d(2, 2),
+        tnn.Conv2d(192, 384, 3, padding=1), tnn.ReLU(),
+        tnn.Conv2d(384, 256, 3, padding=1), tnn.ReLU(),
+        tnn.Conv2d(256, 256, 3, padding=1), tnn.ReLU(),
+        tnn.MaxPool2d(2, 2),
+        tnn.Flatten(),
+        tnn.Linear(256, 10),
+    )
+    opt = torch.optim.SGD(model.parameters(), lr=LR, momentum=0.0)
+    images_np, labels_np = make_batch(batch)
+    images = torch.from_numpy(images_np.transpose(0, 3, 1, 2).copy())  # NCHW
+    labels = torch.from_numpy(labels_np.astype(np.int64))
+
+    def step():
+        opt.zero_grad()
+        loss = F.cross_entropy(model(images), labels)
+        loss.backward()
+        opt.step()
+        return loss.detach()
+
+    for _ in range(2):
+        step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step()
+    dt = time.perf_counter() - t0
+    log(f"torch [cpu]: {steps} steps of batch {batch} in {dt:.3f}s "
+        f"→ {steps * batch / dt:.1f} img/s, final loss {float(loss):.4f}")
+    return steps * batch / dt
+
+
+def main() -> None:
+    ips = bench_jax()
+    base = bench_torch_cpu()
+    vs = (ips / base) if base else 0.0
+    print(json.dumps({
+        "metric": "alexnet_cifar10_train_throughput_per_chip",
+        "value": round(ips, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 2),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,7 @@ class TPUJobSpec:
             f"--zone={self.zone}",
             f"--accelerator-type={self.accelerator_type}",
             f"--version={self.runtime_version}",
+            f"--labels=experiment={self.name}",  # experiment name (run-pytorch.py:9)
         ]
 
     def run_command(self) -> List[str]:
@@ -63,12 +64,16 @@ def submit(spec: TPUJobSpec, dry_run: bool = False) -> str:
     ``run.get_portal_url()``, ``run-pytorch.py:18-19``)."""
     cmds = [spec.create_command(), spec.run_command()]
     if dry_run or shutil.which("gcloud") is None:
-        print("# no gcloud available — dry run; execute these to submit:")
+        reason = "dry run" if dry_run else "no gcloud available — dry run"
+        print(f"# {reason}; execute these to submit:")
         for cmd in cmds:
             print(" ".join(shlex.quote(c) for c in cmd))
     else:
-        for cmd in cmds:
-            subprocess.run(cmd, check=True)
+        # create is idempotent: an already-existing compute target is fine
+        # (resubmission to the same target, like the reference's reuse of its
+        # AzureML compute target), so only the run command is checked.
+        subprocess.run(spec.create_command(), check=False)
+        subprocess.run(spec.run_command(), check=True)
     url = spec.portal_url()
     print(url)
     return url

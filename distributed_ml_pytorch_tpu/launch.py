@@ -76,7 +76,8 @@ def launch_world(
         while True:
             codes = [p.poll() for p in procs]
             if all(c is not None for c in codes):
-                return max(codes)
+                # any nonzero (including negative signal codes) is a failure
+                return next((c for c in codes if c != 0), 0)
             if any(c not in (None, 0) for c in codes):
                 bad = next(c for c in codes if c not in (None, 0))
                 print(

@@ -166,6 +166,10 @@ class Asynchronous:
     ):
         if lr < 0.0:
             raise ValueError("Invalid learning rate: {}".format(lr))
+        if int(n_push) < 1 or int(n_pull) < 1:
+            raise ValueError(
+                "Invalid cadence: n_push={}, n_pull={} (both must be >= 1)".format(n_push, n_pull)
+            )
         self.lr = float(lr)
         self.n_push = int(n_push)
         self.n_pull = int(n_pull)

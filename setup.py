@@ -1,11 +1,16 @@
 """M6 parity: packaging (the reference Makefile installs a missing setup.py as
 ``pytorch-distbelief``, Makefile:4,29,38)."""
 
+import re
+
 from setuptools import find_packages, setup
+
+with open("distributed_ml_pytorch_tpu/version.py") as f:
+    VERSION = re.search(r'__version__ = "([^"]+)"', f.read()).group(1)
 
 setup(
     name="tpu-distbelief",
-    version="0.1.0",
+    version=VERSION,
     description=(
         "TPU-native distributed training framework with DownPour-SGD "
         "parameter-server, sync data-parallel, and local-SGD strategies"
