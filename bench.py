@@ -89,7 +89,6 @@ def bench_torch_cpu(batch: int = BATCH, steps: int = BASELINE_STEPS) -> float | 
         return None
 
     torch.manual_seed(0)
-    torch.set_num_threads(max(1, (torch.get_num_threads() or 1)))
 
     model = tnn.Sequential(
         tnn.Conv2d(3, 64, 11, stride=4, padding=5), tnn.ReLU(),
@@ -129,12 +128,12 @@ def bench_torch_cpu(batch: int = BATCH, steps: int = BASELINE_STEPS) -> float | 
 def main() -> None:
     ips = bench_jax()
     base = bench_torch_cpu()
-    vs = (ips / base) if base else 0.0
+    vs = round(ips / base, 2) if base else None  # null = baseline not measurable here
     print(json.dumps({
         "metric": "alexnet_cifar10_train_throughput_per_chip",
         "value": round(ips, 1),
         "unit": "images/sec/chip",
-        "vs_baseline": round(vs, 2),
+        "vs_baseline": vs,
     }), flush=True)
 
 

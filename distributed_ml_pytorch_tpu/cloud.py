@@ -20,6 +20,13 @@ from dataclasses import dataclass, field
 from typing import List
 
 
+def _label_value(name: str) -> str:
+    """Sanitize a free-form experiment name into a valid GCP label value."""
+    import re
+
+    return re.sub(r"[^a-z0-9_-]", "-", name.lower())[:63] or "experiment"
+
+
 @dataclass
 class TPUJobSpec:
     """Submission spec (the ScriptRunConfig analog, ``run-pytorch.py:10-12``)."""
@@ -38,7 +45,9 @@ class TPUJobSpec:
             f"--zone={self.zone}",
             f"--accelerator-type={self.accelerator_type}",
             f"--version={self.runtime_version}",
-            f"--labels=experiment={self.name}",  # experiment name (run-pytorch.py:9)
+            # experiment name (run-pytorch.py:9); GCP label values must be
+            # lowercase [a-z0-9_-], <=63 chars
+            f"--labels=experiment={_label_value(self.name)}",
         ]
 
     def run_command(self) -> List[str]:
@@ -71,8 +80,15 @@ def submit(spec: TPUJobSpec, dry_run: bool = False) -> str:
     else:
         # create is idempotent: an already-existing compute target is fine
         # (resubmission to the same target, like the reference's reuse of its
-        # AzureML compute target), so only the run command is checked.
-        subprocess.run(spec.create_command(), check=False)
+        # AzureML compute target); any other create failure is fatal.
+        create = subprocess.run(spec.create_command(), capture_output=True, text=True)
+        if create.returncode != 0:
+            err = (create.stderr or "") + (create.stdout or "")
+            if "already exists" not in err.lower() and "ALREADY_EXISTS" not in err:
+                sys.stderr.write(err)
+                raise subprocess.CalledProcessError(
+                    create.returncode, spec.create_command(), output=err
+                )
         subprocess.run(spec.run_command(), check=True)
     url = spec.portal_url()
     print(url)

@@ -1,3 +1,4 @@
 from distributed_ml_pytorch_tpu.models.cnn import LeNet, AlexNet, get_model
+from distributed_ml_pytorch_tpu.models.resnet import ResNet, get_resnet
 
-__all__ = ["LeNet", "AlexNet", "get_model"]
+__all__ = ["LeNet", "AlexNet", "ResNet", "get_model", "get_resnet"]

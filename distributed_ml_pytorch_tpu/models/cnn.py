@@ -84,10 +84,8 @@ def get_model(name: str, num_classes: int = 10, dtype: Any = jnp.float32) -> nn.
         return LeNet(num_classes=num_classes, dtype=dtype)
     if name == "alexnet":
         return AlexNet(num_classes=num_classes, dtype=dtype)
-    if name in ("resnet18", "resnet50"):
-        try:
-            from distributed_ml_pytorch_tpu.models.resnet import get_resnet
-        except ImportError as e:
-            raise ValueError(f"model {name!r} is not available: {e}") from e
+    if name.startswith("resnet"):
+        from distributed_ml_pytorch_tpu.models.resnet import get_resnet
+
         return get_resnet(name, num_classes=num_classes, dtype=dtype)
     raise ValueError(f"unknown model {name!r}")

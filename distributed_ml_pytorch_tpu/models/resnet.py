@@ -1,0 +1,151 @@
+"""ResNet-18 / ResNet-50 (BASELINE.md configs #4 and #5).
+
+The reference has no ResNet — ``BASELINE.json`` config #4 is explicitly "ResNet-18
+swapped into models.py" (an *extension* of reference ``example/models.py``) and
+config #5 is ResNet-50 at pod scale. These are standard He et al. residual
+networks with one TPU-native design decision:
+
+**GroupNorm instead of BatchNorm.** BatchNorm carries mutable running
+statistics (a second variable collection threaded through every train/eval
+step) and, under data parallelism, either desyncs per replica or needs a
+cross-replica ``pmean`` of batch stats each step. GroupNorm is stateless —
+the whole model stays a pure function of ``params``, which keeps every
+parallel strategy in this framework (sync ``psum`` DP, async parameter
+server, local-SGD) working on the same flat-parameter contract
+(``utils/serialization.py``) with zero special cases, and it matches BN's
+accuracy at the batch sizes used here. XLA fuses the normalization chain into
+the surrounding convs either way.
+
+Stems: the ImageNet stem (7×7/2 conv + 3×3/2 maxpool) shrinks a 32×32 CIFAR
+image to 8×8 before the first block, so for small inputs the standard CIFAR
+stem (3×3/1, no pool) is used. ``stem="auto"`` picks by input size at call
+time (shapes are static under jit, so this is a trace-time branch).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def _norm(dtype: Any) -> Callable:
+    # 32 channels/group is the GN paper's default; min() guards thin stems.
+    def make(num_features: int, name: str):
+        return nn.GroupNorm(
+            num_groups=None,
+            group_size=min(32, num_features),
+            dtype=dtype,
+            name=name,
+        )
+
+    return make
+
+
+class BasicBlock(nn.Module):
+    """2×3×3 residual block (ResNet-18/34)."""
+
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        norm = _norm(self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.features, (3, 3), strides=(self.strides, self.strides),
+                 padding=[(1, 1), (1, 1)], name="conv1")(x)
+        y = nn.relu(norm(self.features, "norm1")(y))
+        y = conv(self.features, (3, 3), padding=[(1, 1), (1, 1)], name="conv2")(y)
+        y = norm(self.features, "norm2")(y)
+        if residual.shape != y.shape:
+            residual = conv(self.features, (1, 1),
+                            strides=(self.strides, self.strides), name="downsample")(residual)
+            residual = norm(self.features, "norm_down")(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    """1×1 → 3×3 → 1×1 bottleneck with 4× expansion (ResNet-50/101/152)."""
+
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        norm = _norm(self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        out_features = self.features * self.expansion
+        residual = x
+        y = conv(self.features, (1, 1), name="conv1")(x)
+        y = nn.relu(norm(self.features, "norm1")(y))
+        y = conv(self.features, (3, 3), strides=(self.strides, self.strides),
+                 padding=[(1, 1), (1, 1)], name="conv2")(y)
+        y = nn.relu(norm(self.features, "norm2")(y))
+        y = conv(out_features, (1, 1), name="conv3")(y)
+        y = norm(out_features, "norm3")(y)
+        if residual.shape != y.shape:
+            residual = conv(out_features, (1, 1),
+                            strides=(self.strides, self.strides), name="downsample")(residual)
+            residual = norm(out_features, "norm_down")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """Residual network over NHWC inputs.
+
+    ``stage_sizes`` is blocks-per-stage, e.g. (2, 2, 2, 2) for ResNet-18 or
+    (3, 4, 6, 3) for ResNet-50; stage widths are 64·2^i.
+    """
+
+    stage_sizes: Sequence[int]
+    block: type = BasicBlock
+    num_classes: int = 10
+    stem: str = "auto"  # "imagenet" | "cifar" | "auto"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        del train  # stateless norm: identical train/eval graphs
+        x = x.astype(self.dtype)
+        norm = _norm(self.dtype)
+        stem = self.stem
+        if stem == "auto":
+            stem = "cifar" if x.shape[1] <= 64 else "imagenet"
+        if stem == "imagenet":
+            x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                        use_bias=False, dtype=self.dtype, name="stem_conv")(x)
+            x = nn.relu(norm(64, "stem_norm")(x))
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        else:
+            x = nn.Conv(64, (3, 3), padding=[(1, 1), (1, 1)],
+                        use_bias=False, dtype=self.dtype, name="stem_conv")(x)
+            x = nn.relu(norm(64, "stem_norm")(x))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if (i > 0 and j == 0) else 1
+                x = self.block(
+                    features=64 * 2 ** i, strides=strides, dtype=self.dtype,
+                    name=f"stage{i + 1}_block{j + 1}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="classifier")(x)
+        return x.astype(jnp.float32)
+
+
+def get_resnet(name: str, num_classes: int = 10, dtype: Any = jnp.float32,
+               stem: str = "auto") -> ResNet:
+    configs = {
+        "resnet18": dict(stage_sizes=(2, 2, 2, 2), block=BasicBlock),
+        "resnet34": dict(stage_sizes=(3, 4, 6, 3), block=BasicBlock),
+        "resnet50": dict(stage_sizes=(3, 4, 6, 3), block=BottleneckBlock),
+        "resnet101": dict(stage_sizes=(3, 4, 23, 3), block=BottleneckBlock),
+    }
+    if name not in configs:
+        raise ValueError(f"unknown resnet {name!r} (have {sorted(configs)})")
+    return ResNet(num_classes=num_classes, dtype=dtype, stem=stem, **configs[name])

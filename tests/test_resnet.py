@@ -1,0 +1,57 @@
+"""ResNet-18/50 (BASELINE.md configs #4/#5 — no reference counterpart; the
+reference's models stop at LeNet/AlexNet, ``example/models.py:5-49``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.models import get_model
+from distributed_ml_pytorch_tpu.training.trainer import create_train_state, make_train_step
+
+
+def _n_params(params):
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+
+@pytest.mark.parametrize("name,expected_m", [("resnet18", 11.2), ("resnet50", 23.5)])
+def test_forward_shape_and_param_count(name, expected_m):
+    model = get_model(name)
+    x = jnp.zeros((2, 32, 32, 3))
+    params = model.init(jax.random.key(0), x)["params"]
+    logits = model.apply({"params": params}, x)
+    assert logits.shape == (2, 10)
+    # within 5% of the canonical torchvision count (GN vs BN shifts it slightly)
+    assert abs(_n_params(params) / 1e6 - expected_m) / expected_m < 0.05
+
+
+def test_imagenet_stem_selected_for_large_inputs():
+    model = get_model("resnet18")
+    x = jnp.zeros((1, 224, 224, 3))
+    params = model.init(jax.random.key(0), x)["params"]
+    assert model.apply({"params": params}, x).shape == (1, 10)
+    # imagenet stem: 7x7 conv kernel
+    assert params["stem_conv"]["kernel"].shape[:2] == (7, 7)
+    # cifar stem on 32x32: 3x3
+    p32 = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))["params"]
+    assert p32["stem_conv"]["kernel"].shape[:2] == (3, 3)
+
+
+def test_resnet18_train_step_decreases_loss():
+    model = get_model("resnet18")
+    state, tx = create_train_state(model, jax.random.key(0), lr=0.01)
+    step = make_train_step(model, tx)
+    rng = jax.random.key(1)
+    x = np.random.default_rng(0).normal(size=(16, 32, 32, 3)).astype(np.float32)
+    y = (np.arange(16) % 10).astype(np.int32)
+    losses = []
+    for _ in range(10):
+        state, loss = step(state, x, y, rng)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_unknown_resnet_rejected():
+    with pytest.raises(ValueError):
+        get_model("resnet1000")
