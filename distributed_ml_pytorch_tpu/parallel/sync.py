@@ -67,9 +67,15 @@ def put_sharded(mesh: Mesh, array: np.ndarray, spec: P):
 
 
 def replicate(mesh: Mesh, tree: Pytree) -> Pytree:
-    """Replicate a pytree across the mesh (params/opt state)."""
+    """Replicate a pytree across the mesh (params/opt state).
+
+    A jitted identity rather than ``device_put``: ``device_put`` returns the
+    *same* buffer when the array already has the target sharding, and the
+    train steps donate their state — two states replicated from one source
+    must not alias or donating one deletes the other.
+    """
     sharding = NamedSharding(mesh, P())
-    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+    return jax.jit(lambda t: t, out_shardings=sharding)(tree)
 
 
 def make_sync_train_step(
@@ -109,7 +115,8 @@ def make_sync_train_step(
         in_specs=(P(), P(axis), P(axis), P()),
         out_specs=(P(), P()),
     )
-    return jax.jit(sharded)
+    # Donate the state so params/opt-state update in place in HBM.
+    return jax.jit(sharded, donate_argnums=(0,))
 
 
 def train_sync(args, mesh: Mesh | None = None) -> Tuple[TrainState, MetricsLogger]:
