@@ -1,0 +1,202 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's communication layer bottoms out in native code out-of-tree —
+torch.distributed's gloo C++ transport (``example/main.py:165``; SURVEY.md
+§2.2). This package is the framework's in-tree native analog for the host-side
+control plane: :class:`NativeTCPTransport` speaks the exact wire format of
+``utils/messaging.TCPTransport`` (little-endian ``<iiq`` header + float32
+payload) from a C++ shared library, so native and Python endpoints
+interoperate in one world. The TPU data plane is separate — compiled XLA
+collectives over ICI (``parallel/sync.py``) — exactly as gloo (control/CPU)
+and NCCL (data/GPU) split roles in torch.
+
+The library is compiled on demand with ``g++`` (``ensure_built``); environments
+without a toolchain fall back to the pure-Python transport transparently via
+:func:`native_available` / :func:`make_transport` in ``utils/messaging``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    SERVER_RANK,
+    Message,
+    MessageCode,
+    Transport,
+)
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libdmt_transport.so")
+_SRC_PATH = os.path.join(_NATIVE_DIR, "transport.cpp")
+
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_error: Optional[str] = None
+
+
+def ensure_built() -> str:
+    """Compile the shared library if missing or stale; return its path.
+
+    Builds through the shipped Makefile (single source of truth for flags)
+    into a per-process temp name, then atomically renames into place — so
+    N ranks launched simultaneously on one fresh host (the launcher's normal
+    topology) never dlopen a partially written library, and a crashed build
+    never leaves a truncated file that passes the staleness check.
+    """
+    with _build_lock:
+        if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(
+            _SRC_PATH
+        ):
+            return _LIB_PATH
+        tmp_name = f".libdmt_transport.{os.getpid()}.so"
+        tmp_path = os.path.join(_NATIVE_DIR, tmp_name)
+        try:
+            try:
+                subprocess.run(
+                    ["make", "-s", "-C", _NATIVE_DIR, f"LIB={tmp_name}"],
+                    check=True, capture_output=True,
+                )
+            except FileNotFoundError:  # no `make` — fall back to a direct g++
+                cxx = os.environ.get("CXX", "g++")
+                subprocess.run(
+                    [cxx, "-O2", "-std=c++17", "-fPIC", "-Wall", "-Wextra",
+                     "-shared", "-pthread", "-o", tmp_path, _SRC_PATH],
+                    check=True, capture_output=True, cwd=_NATIVE_DIR,
+                )
+            os.replace(tmp_path, _LIB_PATH)
+        finally:
+            if os.path.exists(tmp_path):
+                os.remove(tmp_path)
+        return _LIB_PATH
+
+
+def _load() -> ctypes.CDLL:
+    global _lib, _load_error
+    if _lib is not None:
+        return _lib
+    path = ensure_built()
+    lib = ctypes.CDLL(path)
+    lib.tpt_create.restype = ctypes.c_void_p
+    lib.tpt_create.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_double,
+    ]
+    lib.tpt_send.restype = ctypes.c_int
+    lib.tpt_send.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+    ]
+    lib.tpt_recv.restype = ctypes.c_void_p
+    lib.tpt_recv.argtypes = [ctypes.c_void_p, ctypes.c_double]
+    lib.tpt_rank.restype = ctypes.c_int
+    lib.tpt_rank.argtypes = [ctypes.c_void_p]
+    lib.tpt_msg_sender.restype = ctypes.c_int
+    lib.tpt_msg_sender.argtypes = [ctypes.c_void_p]
+    lib.tpt_msg_code.restype = ctypes.c_int
+    lib.tpt_msg_code.argtypes = [ctypes.c_void_p]
+    lib.tpt_msg_size.restype = ctypes.c_int64
+    lib.tpt_msg_size.argtypes = [ctypes.c_void_p]
+    lib.tpt_msg_data.restype = ctypes.POINTER(ctypes.c_float)
+    lib.tpt_msg_data.argtypes = [ctypes.c_void_p]
+    lib.tpt_msg_free.restype = None
+    lib.tpt_msg_free.argtypes = [ctypes.c_void_p]
+    lib.tpt_close.restype = None
+    lib.tpt_close.argtypes = [ctypes.c_void_p]
+    lib.tpt_free.restype = None
+    lib.tpt_free.argtypes = [ctypes.c_void_p]
+    lib.tpt_last_error.restype = ctypes.c_char_p
+    lib.tpt_last_error.argtypes = []
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    """True if the native library is (or can be) built and loaded."""
+    global _load_error
+    try:
+        _load()
+        return True
+    except (OSError, subprocess.CalledProcessError, FileNotFoundError) as e:
+        _load_error = str(e)
+        return False
+
+
+def native_load_error() -> Optional[str]:
+    return _load_error
+
+
+class NativeTCPTransport(Transport):
+    """C++-backed star-topology transport (drop-in for ``TCPTransport``).
+
+    Frame pumping, queueing, and blocking receive all run in native threads —
+    no GIL contention with the training loop, which matters when large flat
+    parameter vectors stream in at pull cadence while jitted steps dispatch.
+    """
+
+    def __init__(
+        self,
+        rank: int,
+        world_size: int,
+        master: str = "localhost",
+        port: int = 29500,
+        connect_timeout: float = 60.0,
+    ):
+        self._lib = _load()
+        self.rank = rank
+        self.world_size = world_size
+        self._closed = False
+        handle = self._lib.tpt_create(
+            rank, world_size, master.encode(), int(port), float(connect_timeout)
+        )
+        if not handle:
+            err = self._lib.tpt_last_error().decode()
+            raise ConnectionError(f"native transport rendezvous failed: {err}")
+        self._handle = handle
+
+    def send(self, code: MessageCode, payload: np.ndarray, dst: int = SERVER_RANK) -> None:
+        arr = np.ascontiguousarray(np.asarray(payload, dtype=np.float32).ravel())
+        ptr = arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        rc = self._lib.tpt_send(self._handle, int(dst), int(code), ptr, arr.size)
+        if rc != 0:
+            err = self._lib.tpt_last_error().decode()
+            raise ConnectionError(f"native transport send failed: {err}")
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[Message]:
+        msg = self._lib.tpt_recv(self._handle, -1.0 if timeout is None else float(timeout))
+        if not msg:
+            return None
+        try:
+            sender = self._lib.tpt_msg_sender(msg)
+            code = MessageCode(self._lib.tpt_msg_code(msg))
+            n = self._lib.tpt_msg_size(msg)
+            if n:
+                data = np.ctypeslib.as_array(self._lib.tpt_msg_data(msg), shape=(n,)).copy()
+            else:
+                data = np.zeros(0, dtype=np.float32)
+            return sender, code, data
+        finally:
+            self._lib.tpt_msg_free(msg)
+
+    def close(self) -> None:
+        # Shut down only (idempotent in C): wakes any thread blocked in recv
+        # and joins the native reader threads. The handle itself is freed in
+        # __del__, so a receiver racing with close never touches freed memory.
+        if self._closed:
+            return
+        self._closed = True
+        self._lib.tpt_close(self._handle)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_handle", None):
+                self.close()
+                self._lib.tpt_free(self._handle)
+                self._handle = None
+        except Exception:
+            pass

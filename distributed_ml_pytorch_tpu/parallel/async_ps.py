@@ -313,11 +313,17 @@ def run_ps_process(args) -> int:
     """CLI entry for one PS-topology process (rank 0 = server, 1+ = workers) —
     replaces the reference's gloo rendezvous + role dispatch
     (``example/main.py:163-168``)."""
-    from distributed_ml_pytorch_tpu.utils.messaging import TCPTransport
+    from distributed_ml_pytorch_tpu.utils.messaging import make_transport
 
     if args.rank is None:
         raise SystemExit("--rank is required for distributed --mode ps runs")
-    transport = TCPTransport(args.rank, args.world_size, args.master, int(args.port))
+    transport = make_transport(
+        args.rank,
+        args.world_size,
+        args.master,
+        int(args.port),
+        kind=getattr(args, "transport", "auto"),
+    )
     try:
         if args.server or args.rank == SERVER_RANK:
             run_server(args, transport)

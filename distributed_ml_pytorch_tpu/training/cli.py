@@ -79,6 +79,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--synthetic-train-size", type=int, default=50000)
     p.add_argument("--synthetic-test-size", type=int, default=10000)
     p.add_argument("--log-dir", type=str, default="log")
+    p.add_argument("--transport", type=str, default="auto",
+                   choices=["auto", "native", "python"],
+                   help="PS control-plane transport: C++ library "
+                        "(native/transport.cpp), pure Python, or auto-detect")
     p.add_argument("--sync-every", type=int, default=0, metavar="K",
                    help="local-sgd mode: average params every K steps "
                         "(default 0 = use --num-push)")

@@ -243,6 +243,38 @@ class TCPTransport(Transport):
             self._server_sock.close()
 
 
+def make_transport(
+    rank: int,
+    world_size: int,
+    master: str = "localhost",
+    port: int = 29500,
+    kind: str = "auto",
+    connect_timeout: float = 60.0,
+) -> Transport:
+    """Transport factory for the PS control plane.
+
+    ``kind``: ``"native"`` (C++ library, ``native/transport.cpp``),
+    ``"python"`` (this module's :class:`TCPTransport`), or ``"auto"`` —
+    native when the library builds/loads, Python otherwise. Both speak the
+    same wire format, so mixed worlds (e.g. a native server with Python
+    workers) interoperate.
+    """
+    if kind not in ("auto", "native", "python"):
+        raise ValueError(f"unknown transport kind: {kind!r}")
+    if kind in ("auto", "native"):
+        from distributed_ml_pytorch_tpu import native
+
+        if native.native_available():
+            return native.NativeTCPTransport(
+                rank, world_size, master, int(port), connect_timeout
+            )
+        if kind == "native":
+            raise RuntimeError(
+                f"native transport requested but unavailable: {native.native_load_error()}"
+            )
+    return TCPTransport(rank, world_size, master, int(port), connect_timeout)
+
+
 # --- module-level default transport -----------------------------------------
 # The reference's send_message has no transport argument — the gloo process
 # group is ambient global state. We keep that call-site parity via a default

@@ -16,6 +16,9 @@ setup(
         "parameter-server, sync data-parallel, and local-SGD strategies"
     ),
     packages=find_packages(include=["distributed_ml_pytorch_tpu*"]),
+    # ship the native transport source so installs can build it on demand
+    # (native/__init__.py ensure_built compiles with the local g++)
+    package_data={"distributed_ml_pytorch_tpu.native": ["transport.cpp", "Makefile"]},
     python_requires=">=3.10",
     install_requires=[
         "jax",

@@ -1,0 +1,235 @@
+"""Native C++ transport tests: build/load, round-trip, wire-format interop
+with the pure-Python TCPTransport, and an end-to-end async-PS world running
+over the native control plane (native analog of the reference's gloo C++
+backend, SURVEY.md §2.2)."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu import native
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    MessageCode,
+    TCPTransport,
+    make_transport,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(),
+    reason=f"native transport unavailable: {native.native_load_error()}",
+)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _world(port, server_cls, worker_cls, n_workers=1):
+    """Start a server transport in a thread plus worker transports; returns
+    (server_transport_future, workers)."""
+    out = {}
+
+    def serve():
+        out["server"] = server_cls(0, n_workers + 1, "localhost", port)
+
+    st = threading.Thread(target=serve)
+    st.start()
+    workers = [worker_cls(r, n_workers + 1, "localhost", port) for r in range(1, n_workers + 1)]
+    st.join(timeout=30)
+    assert "server" in out, "server rendezvous did not complete"
+    return out["server"], workers
+
+
+@pytest.mark.parametrize(
+    "server_cls,worker_cls",
+    [
+        (native.NativeTCPTransport, native.NativeTCPTransport),
+        (TCPTransport, native.NativeTCPTransport),  # python server, native worker
+        (native.NativeTCPTransport, TCPTransport),  # native server, python worker
+    ],
+    ids=["native-native", "py-server", "native-server"],
+)
+def test_round_trip_and_interop(server_cls, worker_cls):
+    port = _free_port()
+    server, (worker,) = _world(port, server_cls, worker_cls)
+    try:
+        payload = np.arange(5, dtype=np.float32) * 1.5
+        worker.send(MessageCode.GradientUpdate, payload)
+        msg = server.recv(timeout=10)
+        assert msg is not None
+        sender, code, got = msg
+        assert sender == 1 and code == MessageCode.GradientUpdate
+        np.testing.assert_array_equal(got, payload)
+
+        server.send(MessageCode.ParameterUpdate, np.full(7, 3.0, np.float32), dst=1)
+        reply = worker.recv(timeout=10)
+        assert reply is not None
+        assert reply[0] == 0 and reply[1] == MessageCode.ParameterUpdate
+        np.testing.assert_array_equal(reply[2], np.full(7, 3.0, np.float32))
+
+        # empty payloads (ParameterRequest carries no data)
+        worker.send(MessageCode.ParameterRequest, np.zeros(0, np.float32))
+        req = server.recv(timeout=10)
+        assert req is not None and req[1] == MessageCode.ParameterRequest
+        assert req[2].size == 0
+    finally:
+        server.close()
+        worker.close()
+
+
+def test_recv_timeout_and_close_unblocks():
+    port = _free_port()
+    server, (worker,) = _world(port, native.NativeTCPTransport, native.NativeTCPTransport)
+    try:
+        t0 = time.monotonic()
+        assert server.recv(timeout=0.2) is None
+        assert time.monotonic() - t0 < 5.0
+
+        # a blocking recv must return None once the transport is closed
+        got = {}
+
+        def blocked():
+            got["msg"] = worker.recv(timeout=None)
+
+        th = threading.Thread(target=blocked)
+        th.start()
+        time.sleep(0.2)
+        worker.close()
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert got["msg"] is None
+    finally:
+        server.close()
+        worker.close()
+
+
+def test_large_payload():
+    # a ResNet-50-sized flat vector (~25M floats = 100MB) in one frame
+    port = _free_port()
+    server, (worker,) = _world(port, native.NativeTCPTransport, native.NativeTCPTransport)
+    try:
+        n = 5_000_000  # 20MB — large enough to exercise multi-chunk send/recv
+        payload = np.random.default_rng(0).normal(size=n).astype(np.float32)
+        worker.send(MessageCode.GradientUpdate, payload)
+        msg = server.recv(timeout=30)
+        assert msg is not None
+        np.testing.assert_array_equal(msg[2], payload)
+    finally:
+        server.close()
+        worker.close()
+
+
+def test_rendezvous_failure_raises_cleanly():
+    """A malformed handshake after a good one must raise ConnectionError —
+    not abort the process (the error path tears down already-spawned reader
+    threads before destroying the transport)."""
+    import struct
+
+    port = _free_port()
+    out = {}
+
+    def serve():
+        try:
+            out["server"] = native.NativeTCPTransport(0, 3, "localhost", port, connect_timeout=10)
+        except ConnectionError as e:
+            out["error"] = e
+
+    st = threading.Thread(target=serve)
+    st.start()
+    time.sleep(0.2)
+    # first worker: valid hello (rank 1, code 1, empty payload) → reader spawned
+    s1 = socket.create_connection(("localhost", port), timeout=5)
+    s1.sendall(struct.pack("<iiq", 1, 1, 0))
+    time.sleep(0.2)
+    # second worker: malformed hello (nonzero payload length) → rendezvous fails
+    s2 = socket.create_connection(("localhost", port), timeout=5)
+    s2.sendall(struct.pack("<iiq", 2, 1, 4))
+    st.join(timeout=20)
+    s1.close()
+    s2.close()
+    assert not st.is_alive()
+    assert "error" in out and "handshake" in str(out["error"])
+
+
+def test_make_transport_factory():
+    port = _free_port()
+    server, (worker,) = _world(
+        port,
+        lambda *a: make_transport(*a, kind="native"),
+        lambda *a: make_transport(*a, kind="auto"),
+    )
+    try:
+        assert isinstance(server, native.NativeTCPTransport)
+        worker.send(MessageCode.GradientUpdate, np.ones(3, np.float32))
+        msg = server.recv(timeout=10)
+        assert msg is not None and msg[1] == MessageCode.GradientUpdate
+    finally:
+        server.close()
+        worker.close()
+    with pytest.raises(ValueError):
+        make_transport(0, 1, kind="bogus")
+
+
+def test_async_ps_world_over_native_transport():
+    """Full DownPour world (1 server + 2 workers) on the native control plane."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ml_pytorch_tpu.models import LeNet
+    from distributed_ml_pytorch_tpu.parallel.async_ps import Asynchronous, ParameterServer
+    from distributed_ml_pytorch_tpu.training.trainer import cross_entropy_loss
+    from distributed_ml_pytorch_tpu.utils.serialization import ravel_model_params
+
+    port = _free_port()
+    model = LeNet()
+    params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))["params"]
+
+    server_out = {}
+
+    def serve():
+        t = native.NativeTCPTransport(0, 3, "localhost", port)
+        srv = ParameterServer(params, transport=t, n_workers=2)
+        srv.run(timeout=60)
+        server_out["srv"] = srv
+        t.close()
+
+    st = threading.Thread(target=serve)
+    st.start()
+
+    def work(rank, seed):
+        t = native.NativeTCPTransport(rank, 3, "localhost", port)
+        opt = Asynchronous(params, lr=0.01, n_push=2, n_pull=2, transport=t)
+        rng = jax.random.key(seed)
+        p = params
+        x = jax.random.normal(jax.random.fold_in(rng, 1), (4, 32, 32, 3))
+        y = jnp.zeros(4, jnp.int32)
+
+        def loss_fn(q):
+            return cross_entropy_loss(model.apply({"params": q}, x, train=False), y)
+
+        for _ in range(6):
+            _, grads = jax.value_and_grad(loss_fn)(p)
+            p = opt.step(p, grads)
+        opt.finish()
+        time.sleep(0.2)
+        t.close()
+
+    ws = [threading.Thread(target=work, args=(r, r)) for r in (1, 2)]
+    for w in ws:
+        w.start()
+    for w in ws:
+        w.join(timeout=120)
+    st.join(timeout=120)
+    assert not st.is_alive(), "server did not terminate after WorkerDone x2"
+
+    srv = server_out["srv"]
+    assert srv.message_counts[MessageCode.GradientUpdate] >= 2
+    assert srv.message_counts[MessageCode.ParameterRequest] >= 2
+    # central params must have moved away from init (gradient pushes applied)
+    init_flat = np.asarray(ravel_model_params(params))
+    assert not np.allclose(srv.central, init_flat)
