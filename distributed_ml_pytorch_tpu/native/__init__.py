@@ -41,6 +41,19 @@ _lib: Optional[ctypes.CDLL] = None
 _load_error: Optional[str] = None
 
 
+def _makefile_cxxflags() -> list:
+    """Read ``CXXFLAGS ?=`` out of the shipped Makefile so the no-``make``
+    g++ fallback compiles with the same flags (single source of truth)."""
+    try:
+        with open(os.path.join(_NATIVE_DIR, "Makefile")) as f:
+            for line in f:
+                if line.startswith("CXXFLAGS"):
+                    return line.split("=", 1)[1].split()
+    except OSError:
+        pass
+    return ["-O2", "-std=c++17", "-fPIC"]
+
+
 def ensure_built() -> str:
     """Compile the shared library if missing or stale; return its path.
 
@@ -66,7 +79,7 @@ def ensure_built() -> str:
             except FileNotFoundError:  # no `make` — fall back to a direct g++
                 cxx = os.environ.get("CXX", "g++")
                 subprocess.run(
-                    [cxx, "-O2", "-std=c++17", "-fPIC", "-Wall", "-Wextra",
+                    [cxx, *_makefile_cxxflags(),
                      "-shared", "-pthread", "-o", tmp_path, _SRC_PATH],
                     check=True, capture_output=True, cwd=_NATIVE_DIR,
                 )

@@ -225,6 +225,17 @@ void* tpt_create(int rank, int world, const char* master, int port, double timeo
         ::close(conn);
         return nullptr;
       }
+      // Reject misconfigured workers (out-of-range or duplicate --rank):
+      // overwriting an existing peer fd would orphan its reader thread's
+      // socket and deadlock shutdown_all's join. The unique_ptr destructor
+      // tears down the already-accepted peers cleanly.
+      if (hello.sender < 1 || hello.sender >= world ||
+          t->peer_fds.count(hello.sender) != 0) {
+        set_error("invalid or duplicate worker rank in hello: " +
+                  std::to_string(hello.sender));
+        ::close(conn);
+        return nullptr;
+      }
       t->peer_fds[hello.sender] = conn;
       t->send_mu[hello.sender] = std::make_unique<std::mutex>();
       TptTransport* tp = t.get();

@@ -86,6 +86,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sync-every", type=int, default=0, metavar="K",
                    help="local-sgd mode: average params every K steps "
                         "(default 0 = use --num-push)")
+    p.add_argument("--ckpt-dir", type=str, default="",
+                   help="checkpoint directory (empty = checkpointing off; "
+                        "reference has no checkpointing at all, SURVEY.md §5.4)")
+    p.add_argument("--ckpt-every", type=int, default=500, metavar="N",
+                   help="save a checkpoint every N global steps")
+    p.add_argument("--ckpt-keep", type=int, default=3, metavar="N",
+                   help="retain the newest N checkpoints")
+    p.add_argument("--resume", action="store_true", default=False,
+                   help="resume from the latest checkpoint in --ckpt-dir")
     return p
 
 

@@ -152,23 +152,37 @@ def run_training_loop(
     args,
     logger: MetricsLogger,
     on_step: Optional[Callable] = None,
+    ckpt=None,
+    start_epoch: int = 0,
+    start_iter: int = 0,
 ) -> TrainState:
     """Shared epoch/batch loop (reference ``example/main.py:57-93`` shape).
 
     ``on_step(state, epoch, i) -> state`` lets parallel strategies hook the
     between-steps boundary (e.g. the async-PS param swap) without forking the
     trainer — the backend-agnosticism SURVEY.md §7 calls for.
+
+    ``ckpt`` (a ``utils.checkpoint.Checkpointer``) is offered every step after
+    the update; its ``save_interval_steps`` decides which are accepted, and the
+    saves are async so the next step launches while bytes drain to disk.
+    ``start_epoch``/``start_iter`` fast-forward a resumed run to the exact
+    batch (the shuffle order is a pure function of ``(seed, epoch)``).
     """
     x_train, y_train, x_test, y_test = data
     dropout_rng = jax.random.key(getattr(args, "seed", 0) + 1)
-    for epoch in range(args.epochs):
+    for epoch in range(start_epoch, args.epochs):
         print("Training for epoch {}".format(epoch))
+        skip = start_iter if epoch == start_epoch else 0
         for i, (bx, by) in enumerate(
             iterate_batches(x_train, y_train, args.batch_size, seed=getattr(args, "seed", 0), epoch=epoch)
         ):
+            if i < skip:
+                continue
             if on_step is not None:
                 state = on_step(state, epoch, i)
             state, loss = train_step(state, bx, by, dropout_rng)
+            if ckpt is not None:
+                ckpt.save(int(state.step), state)
             rec_extra = {}
             if i % args.log_interval == 0 and i > 0:  # reference :83-84
                 test_loss, test_acc = evaluate(
@@ -179,6 +193,9 @@ def run_training_loop(
             if rec_extra:
                 print_eval_line(rec)
         evaluate(eval_step, state.params, x_test, y_test, args.test_batch_size, verbose=True)
+    if ckpt is not None:
+        ckpt.save(int(state.step), state, force=True)
+        ckpt.wait()
     return state
 
 
@@ -198,6 +215,31 @@ def train_single(args) -> Tuple[TrainState, MetricsLogger]:
     train_step = make_train_step(model, tx)
     eval_step = make_eval_fn(model)
     logger = MetricsLogger(getattr(args, "log_dir", "log"))
+
+    ckpt, start_epoch, start_iter = None, 0, 0
+    if getattr(args, "ckpt_dir", None):
+        from distributed_ml_pytorch_tpu.utils.checkpoint import (
+            Checkpointer,
+            maybe_restore,
+            resume_position,
+        )
+
+        ckpt = Checkpointer(
+            args.ckpt_dir,
+            max_to_keep=getattr(args, "ckpt_keep", 3),
+            save_interval_steps=getattr(args, "ckpt_every", 500),
+        )
+        if getattr(args, "resume", False):
+            state, resume_step = maybe_restore(ckpt, state)
+            if resume_step:
+                steps_per_epoch = len(x_train) // args.batch_size
+                start_epoch, start_iter = resume_position(resume_step, steps_per_epoch)
+                print(
+                    "resumed from step {} → epoch {} iter {}".format(
+                        resume_step, start_epoch, start_iter
+                    )
+                )
+
     t0 = time.time()
     state = run_training_loop(
         model=model,
@@ -207,6 +249,11 @@ def train_single(args) -> Tuple[TrainState, MetricsLogger]:
         data=(x_train, y_train, x_test, y_test),
         args=args,
         logger=logger,
+        ckpt=ckpt,
+        start_epoch=start_epoch,
+        start_iter=start_iter,
     )
+    if ckpt is not None:
+        ckpt.close()
     print("Finished Training ({:.1f}s)".format(time.time() - t0))
     return state, logger

@@ -8,8 +8,16 @@ from distributed_ml_pytorch_tpu.utils.messaging import (
     MessageListener,
     send_message,
 )
+from distributed_ml_pytorch_tpu.utils.checkpoint import (
+    Checkpointer,
+    maybe_restore,
+    resume_position,
+)
 
 __all__ = [
+    "Checkpointer",
+    "maybe_restore",
+    "resume_position",
     "ravel_model_params",
     "unravel_model_params",
     "make_unraveler",

@@ -30,6 +30,8 @@ setup(
         # (trainer.evaluate verbose) and the graph plotter
         "scikit-learn",
         "matplotlib",
+        # checkpoint/resume subsystem (utils/checkpoint.py)
+        "orbax-checkpoint",
     ],
     extras_require={
         "dev": ["pytest"],

@@ -1,0 +1,139 @@
+"""Checkpoint/resume subsystem tests (utils/checkpoint.py).
+
+The reference has no persistence at all (SURVEY.md §5.4), so these tests
+define the contract fresh: state round-trips bit-exactly (including sharded
+arrays), retention honors max_to_keep, save_interval_steps gates saves, and a
+resumed run continues from the exact batch and reaches the same final state
+as an uninterrupted run (determinism of the (seed, epoch)-keyed data order).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.utils.checkpoint import (
+    Checkpointer,
+    maybe_restore,
+    resume_position,
+)
+
+
+def _tiny_state():
+    from distributed_ml_pytorch_tpu.models import get_model
+    from distributed_ml_pytorch_tpu.training.trainer import create_train_state
+
+    model = get_model("lenet")
+    state, tx = create_train_state(model, jax.random.key(0), lr=0.05)
+    return model, state, tx
+
+
+def test_round_trip_exact(tmp_path):
+    _, state, _ = _tiny_state()
+    with Checkpointer(str(tmp_path / "ckpt")) as ckpt:
+        assert ckpt.save(3, state)
+        ckpt.wait()
+        restored, step = ckpt.restore(state)
+    assert step == 3
+    leaves_a = jax.tree_util.tree_leaves(state)
+    leaves_b = jax.tree_util.tree_leaves(restored)
+    assert len(leaves_a) == len(leaves_b)
+    for a, b in zip(leaves_a, leaves_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restore_missing_raises(tmp_path):
+    _, state, _ = _tiny_state()
+    with Checkpointer(str(tmp_path / "empty")) as ckpt:
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore(state)
+        st, step = maybe_restore(ckpt, state)
+        assert step == 0 and st is state
+
+
+def test_retention_and_interval(tmp_path):
+    _, state, _ = _tiny_state()
+    with Checkpointer(
+        str(tmp_path / "ckpt"), max_to_keep=2, save_interval_steps=10
+    ) as ckpt:
+        assert ckpt.save(0, state)
+        assert not ckpt.save(5, state)  # below interval → rejected
+        assert ckpt.save(10, state)
+        assert ckpt.save(20, state)
+        ckpt.wait()
+        assert ckpt.latest_step() == 20
+    # re-open fresh and confirm only the newest 2 survive
+    with Checkpointer(str(tmp_path / "ckpt")) as ckpt2:
+        assert ckpt2.latest_step() == 20
+        restored, step = ckpt2.restore(state, step=10)
+        assert step == 10
+        with pytest.raises(Exception):
+            ckpt2.restore(state, step=0)
+
+
+def test_sharded_round_trip(tmp_path, mesh8):
+    """A state sharded over the 8-device mesh restores with its sharding."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh8, P("data"))
+    x = jax.device_put(jnp.arange(32, dtype=jnp.float32).reshape(8, 4), sharding)
+    state = {"w": x, "step": jnp.int32(7)}
+    with Checkpointer(str(tmp_path / "ckpt")) as ckpt:
+        ckpt.save(1, state)
+        ckpt.wait()
+        template = {
+            "w": jax.ShapeDtypeStruct((8, 4), jnp.float32, sharding=sharding),
+            "step": jax.ShapeDtypeStruct((), jnp.int32),
+        }
+        restored, _ = ckpt.restore(template)
+    assert restored["w"].sharding == sharding
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(x))
+
+
+def test_resume_position():
+    assert resume_position(0, 100) == (0, 0)
+    assert resume_position(99, 100) == (0, 99)
+    assert resume_position(100, 100) == (1, 0)
+    assert resume_position(250, 100) == (2, 50)
+    with pytest.raises(ValueError):
+        resume_position(5, 0)
+
+
+def _args(tmp_path, epochs, **over):
+    import argparse
+
+    d = dict(
+        batch_size=16,
+        test_batch_size=64,
+        epochs=epochs,
+        lr=0.05,
+        log_interval=1000,
+        seed=3,
+        synthetic_data=True,
+        synthetic_train_size=64,
+        synthetic_test_size=64,
+        model="lenet",
+        log_dir=str(tmp_path / "log"),
+        ckpt_dir=str(tmp_path / "ckpt"),
+        ckpt_every=1,
+        ckpt_keep=10,
+        resume=False,
+    )
+    d.update(over)
+    return argparse.Namespace(**d)
+
+
+def test_resume_matches_uninterrupted(tmp_path):
+    """Train 2 epochs straight vs. 1 epoch + resume for the 2nd: same params."""
+    from distributed_ml_pytorch_tpu.training.trainer import train_single
+
+    straight, _ = train_single(_args(tmp_path, 2, ckpt_dir=str(tmp_path / "a")))
+
+    interrupted, _ = train_single(_args(tmp_path, 1, ckpt_dir=str(tmp_path / "b")))
+    resumed, _ = train_single(_args(tmp_path, 2, ckpt_dir=str(tmp_path / "b"), resume=True))
+
+    assert int(resumed.step) == int(straight.step)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(straight.params), jax.tree_util.tree_leaves(resumed.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6)
