@@ -163,15 +163,22 @@ def iterate_batches(
     seed: int = 0,
     epoch: int = 0,
     drop_last: bool = True,
+    start_iter: int = 0,
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Per-epoch shuffled minibatch iterator (reference DataLoader semantics,
     ``example/main.py:27``). ``drop_last=True`` keeps shapes static for jit —
-    a ragged final batch would trigger recompilation on TPU."""
+    a ragged final batch would trigger recompilation on TPU.
+
+    ``start_iter`` fast-forwards a resumed run without materializing the
+    skipped batches (the permutation is a pure function of ``(seed, epoch)``,
+    so skipping is just an offset into it); yielded pairs are
+    ``(i, (bx, by))``-compatible via ``enumerate(..., start=start_iter)``.
+    """
     n = len(x)
     idx = np.arange(n)
     if shuffle:
         np.random.default_rng(seed + epoch).shuffle(idx)
     limit = (n // batch_size) * batch_size if drop_last else n
-    for start in range(0, limit, batch_size):
+    for start in range(start_iter * batch_size, limit, batch_size):
         sel = idx[start : start + batch_size]
         yield x[sel], y[sel]

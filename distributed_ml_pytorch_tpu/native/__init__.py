@@ -42,8 +42,12 @@ _load_error: Optional[str] = None
 
 
 def _makefile_cxxflags() -> list:
-    """Read ``CXXFLAGS ?=`` out of the shipped Makefile so the no-``make``
-    g++ fallback compiles with the same flags (single source of truth)."""
+    """Flags for the no-``make`` g++ fallback: an environment ``CXXFLAGS``
+    wins (mirroring make's ``?=`` semantics), else the shipped Makefile's
+    default (single source of truth)."""
+    env = os.environ.get("CXXFLAGS")
+    if env:
+        return env.split()
     try:
         with open(os.path.join(_NATIVE_DIR, "Makefile")) as f:
             for line in f:

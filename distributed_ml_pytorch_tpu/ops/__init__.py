@@ -1,0 +1,30 @@
+"""TPU kernels for the framework's hot ops (Pallas + compiled-JAX fallbacks).
+
+The reference's only in-tree "kernel" work is the per-step O(|θ|) flat
+accumulate / SGD apply on the raveled model (``asgd/optim/Asynchronous.py:
+54-55,68``); everything else lives in libtorch. Here those flat-vector ops are
+Pallas TPU kernels (``fused_update``), and the attention stack that the
+long-context path needs (``attention``) provides a Pallas flash-attention
+forward plus a differentiable blockwise (online-softmax) formulation used by
+ring attention (``parallel/ring.py``).
+"""
+
+from distributed_ml_pytorch_tpu.ops.fused_update import (
+    downpour_accumulate,
+    flat_axpy,
+)
+from distributed_ml_pytorch_tpu.ops.attention import (
+    attention_reference,
+    blockwise_attention,
+    finalize_attention,
+    flash_attention,
+)
+
+__all__ = [
+    "flat_axpy",
+    "downpour_accumulate",
+    "flash_attention",
+    "blockwise_attention",
+    "finalize_attention",
+    "attention_reference",
+]

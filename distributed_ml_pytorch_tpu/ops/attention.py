@@ -1,0 +1,248 @@
+"""Attention kernels: Pallas flash-attention forward + differentiable blockwise.
+
+The reference has no attention at all (image CNNs only, SURVEY.md §5.7); this
+module is the long-context foundation the TPU framework adds as first-class:
+
+- ``flash_attention`` — a Pallas TPU kernel: the O(S²) score matrix never
+  touches HBM. Grid over (batch·heads, query blocks, key blocks); each K/V
+  block is DMA'd HBM→VMEM on its own grid step, so VMEM holds only
+  (block_q + 2·block_k)·d floats regardless of sequence length, with the
+  online-softmax statistics carried across key steps in VMEM scratch and the
+  QKᵀ / PV products on the MXU. Causally-dead key blocks are skipped.
+- ``blockwise_attention`` — the same online-softmax recurrence written as a
+  ``lax.scan`` over key blocks in plain JAX: differentiable (used in training
+  steps and as the per-chunk compute inside ring attention,
+  ``parallel/ring.py``), compiled by XLA, numerically identical.
+- ``attention_reference`` — the naive softmax(QKᵀ)V for tests.
+
+All take ``(batch, heads, seq, head_dim)`` and an optional causal mask.
+``NEG_INF`` is a large-finite mask value rather than ``-inf`` so fully-masked
+rows (which ring attention produces on not-yet-arrived chunks) stay NaN-free;
+masked probabilities are explicitly zeroed so a fully-masked row yields
+``acc = 0, l = 0`` (callers detect empty rows by ``l == 0``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def attention_reference(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False
+) -> jax.Array:
+    """Naive softmax(QKᵀ/√d)V — the ground truth for kernel tests."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+
+
+def init_softmax_state(q: jax.Array):
+    """Empty online-softmax state ``(m, l, acc)`` for queries ``q``, in f32.
+
+    Derived from ``q`` rather than built as fresh constants so the arrays
+    carry ``q``'s device-varying type when traced inside ``shard_map`` (a
+    constant init would fail lax.scan's carry-type check there).
+    """
+    l0 = (q[..., :1] * 0.0).astype(jnp.float32)
+    m0 = l0 + NEG_INF
+    acc0 = (q * 0.0).astype(jnp.float32)
+    return m0, l0, acc0
+
+
+def _online_update(m, l, acc, s, v_blk):
+    """One online-softmax step: fold scores ``s`` (…q,k) and values ``v_blk``
+    (…k,d) into the running (max, normalizer, accumulator). Entries at
+    ``NEG_INF`` (masked) contribute exactly zero even when the whole row is
+    masked (where exp(NEG_INF − NEG_INF) would otherwise be 1)."""
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+    correction = jnp.exp(jnp.maximum(m - m_new, NEG_INF))
+    l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * correction + jnp.einsum(
+        "...qk,...kd->...qd", p, v_blk, preferred_element_type=jnp.float32
+    )
+    return m_new, l_new, acc_new
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    block_k: int = 512,
+    q_offset=0,
+    k_offset=0,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Differentiable online-softmax attention over key blocks (lax.scan).
+
+    Returns ``(out, m, l)`` — the un-finalized accumulator statistics, always
+    float32 regardless of input dtype — so ring attention can keep folding
+    further key chunks in; finalize with ``finalize_attention`` (and cast back
+    if needed). ``q_offset``/``k_offset`` are the global positions
+    of element 0 of the local q/k chunks, which is what makes the causal mask
+    correct when the sequence axis is sharded across devices.
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_k = min(block_k, sk)
+    n_blocks = pl.cdiv(sk, block_k)
+    pad = n_blocks * block_k - sk
+    if pad:
+        # padded keys are masked off via their out-of-range global position
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    scale = d**-0.5
+    q_pos = q_offset + jnp.arange(sq)
+
+    kb = k.reshape(b, h, n_blocks, block_k, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, n_blocks, block_k, d).transpose(2, 0, 1, 3, 4)
+
+    def body(carry, blk):
+        m, l, acc = carry
+        k_blk, v_blk, j = blk
+        # accumulate scores and softmax statistics in f32 even for bf16
+        # inputs (MXU takes bf16 operands natively; the accumulate is f32) —
+        # matching the flash kernel's f32 scratch
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, k_blk, preferred_element_type=jnp.float32
+        ) * scale
+        k_pos = k_offset + j * block_k + jnp.arange(block_k)
+        valid = k_pos < k_offset + sk
+        if causal:
+            valid = valid[None, :] & (k_pos[None, :] <= q_pos[:, None])
+        else:
+            valid = jnp.broadcast_to(valid[None, :], (sq, block_k))
+        s = jnp.where(valid, s, NEG_INF)
+        return _online_update(m, l, acc, s, v_blk), None
+
+    m0, l0, acc0 = init_softmax_state(q)
+    (m, l, acc), _ = jax.lax.scan(
+        body, (m0, l0, acc0), (kb, vb, jnp.arange(n_blocks))
+    )
+    return acc, m, l
+
+
+def finalize_attention(acc: jax.Array, l: jax.Array) -> jax.Array:
+    """Normalize the online-softmax accumulator into attention output."""
+    return acc / jnp.maximum(l, 1e-30)
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, block_q: int, block_k: int, causal: bool
+):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # keys strictly after the last query of this block contribute nothing
+    live = (kj * block_k < (qi + 1) * block_q) if causal else (kj >= 0)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0]  # (BQ, D)
+        d = q.shape[-1]
+        k_blk = k_ref[0]  # (BK, D)
+        v_blk = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * (d**-0.5)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_prev = m_ref[:, :1]  # lanes hold replicated copies; use lane 0
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        acc_ref[:] = acc_ref[:] * correction + pv
+
+    @pl.when(kj == n_k - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Pallas TPU flash-attention forward over (batch, heads, seq, head_dim).
+
+    Sequence lengths must be multiples of the block sizes (pad upstream for
+    ragged sequences — the blockwise/jnp path handles arbitrary lengths), and
+    ``causal`` requires ``sq == sk`` (the standard self-attention layout; the
+    end-aligned decode mask is a different contract and is rejected rather
+    than silently diverging). ``interpret=None`` auto-selects interpreter mode
+    off-TPU so the same code runs under the CPU test mesh.
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if causal and sq != sk:
+        raise ValueError(f"causal flash_attention requires sq == sk, got {sq} != {sk}")
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"flash_attention needs seq multiples of block sizes, got "
+            f"sq={sq}%{block_q}, sk={sk}%{block_k}"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, causal=causal
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=(b * h, sq // block_q, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda bh, i, j: (bh, i, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max (lane-replicated)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running normalizer
+            pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d)

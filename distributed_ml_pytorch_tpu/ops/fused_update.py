@@ -1,0 +1,96 @@
+"""Pallas TPU kernel for the flat-parameter axpy — the DownPour hot op.
+
+The reference's optimizer touches the whole raveled model every step:
+``accum.add_(-lr, grads)`` (``asgd/optim/Asynchronous.py:55``) — ``y + alpha*x``
+over a flat float vector, bandwidth-bound on any hardware. On TPU that op
+lives on the VPU and its ceiling is HBM bandwidth; the kernel streams the
+vector through VMEM in lane-aligned (rows × 128) blocks, reading each operand
+exactly once and aliasing the output onto ``y``'s buffer. The ragged final
+block is handled by Pallas's masked out-of-bounds stores, so no padding copy
+is ever made; vectors whose length isn't a multiple of 128 lanes take the
+fused-XLA path instead (same single HBM pass, no reshape possible).
+
+On non-TPU backends (the CPU test mesh) the function lowers to plain
+``y + alpha * x`` — XLA fuses that into one pass too; the kernel itself is
+still covered on CPU through ``interpret=True`` (``force_pallas_interpret``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+BLOCK_ROWS = 256  # 256×128 f32 = 128 KiB per operand block in VMEM
+
+_state = threading.local()
+
+
+@contextlib.contextmanager
+def force_pallas_interpret():
+    """Run the Pallas path in interpreter mode regardless of backend (tests)."""
+    prev = getattr(_state, "interpret", False)
+    _state.interpret = True
+    try:
+        yield
+    finally:
+        _state.interpret = prev
+
+
+def _interpret() -> bool:
+    return bool(getattr(_state, "interpret", False))
+
+
+def _axpy_kernel(alpha_ref, y_ref, x_ref, out_ref):
+    out_ref[:] = y_ref[:] + alpha_ref[0, 0] * x_ref[:]
+
+
+def _flat_axpy_pallas(y: jax.Array, x: jax.Array, alpha: jax.Array) -> jax.Array:
+    y2 = y.reshape(-1, LANES)
+    x2 = x.reshape(-1, LANES)
+    alpha2 = jnp.asarray(alpha, y.dtype).reshape(1, 1)
+    out = pl.pallas_call(
+        _axpy_kernel,
+        out_shape=jax.ShapeDtypeStruct(y2.shape, y2.dtype),
+        # cdiv grid + masked OOB stores cover a ragged final row block
+        grid=(pl.cdiv(y2.shape[0], BLOCK_ROWS),),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (BLOCK_ROWS, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        # write the result into y's buffer: the update is in-place in HBM when
+        # the caller donates y (async_ps donates its accumulator)
+        input_output_aliases={1: 0},
+        interpret=_interpret(),
+    )(alpha2, y2, x2)
+    return out.reshape(-1)
+
+
+def flat_axpy(y: jax.Array, x: jax.Array, alpha) -> jax.Array:
+    """``y + alpha * x`` over flat vectors — Pallas on TPU, fused XLA elsewhere.
+
+    The Pallas path needs a 128-lane-divisible length (the flat vector is
+    viewed as rows of 128 without copying); other lengths use the XLA fusion,
+    which is the same single streaming HBM pass.
+    """
+    if y.ndim != 1 or y.shape != x.shape:
+        raise ValueError(f"flat_axpy wants equal 1-D shapes, got {y.shape} / {x.shape}")
+    lane_aligned = y.shape[0] % LANES == 0 and y.shape[0] > 0
+    if lane_aligned and (_interpret() or jax.default_backend() == "tpu"):
+        return _flat_axpy_pallas(y, x, alpha)
+    return y + jnp.asarray(alpha, y.dtype) * x
+
+
+def downpour_accumulate(accum: jax.Array, flat_grads: jax.Array, lr) -> jax.Array:
+    """``accum - lr * grads`` — the lr-pre-scaled gradient accumulation of the
+    reference's ``accum.add_(-lr, grads)`` (``Asynchronous.py:55``)."""
+    return flat_axpy(accum, flat_grads, -lr)

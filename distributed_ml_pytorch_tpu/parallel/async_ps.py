@@ -177,8 +177,12 @@ class Asynchronous:
         self.idx = 0
         self.unravel = make_unraveler(params)
         # accumulator allocation parity: zeros sized like the raveled model
-        # (Asynchronous.py:27)
-        self.accum = jnp.zeros_like(ravel_model_params(params))
+        # (Asynchronous.py:27) — rounded up to a 128-lane multiple so the
+        # device accumulate takes the Pallas flat-axpy path on TPU; the pad
+        # tail stays zero and is sliced off before anything leaves the device
+        self._flat_n = int(ravel_model_params(params).shape[0])
+        self._pad = (-self._flat_n) % 128
+        self.accum = jnp.zeros(self._flat_n + self._pad, jnp.float32)
         # install this worker's initial params as the central params (:34)
         send_message(
             MessageCode.ParameterUpdate, ravel_model_params(params), transport=transport
@@ -187,11 +191,23 @@ class Asynchronous:
         self.listener.start()
 
         lr_const = self.lr
+        pad = self._pad
 
-        @jax.jit
+        from functools import partial
+
+        # accum is donated: the Pallas axpy's output aliases its buffer, so
+        # the accumulation really is in place in HBM
+        @partial(jax.jit, donate_argnums=(2,))
         def _device_step(params, grads, accum):
+            from distributed_ml_pytorch_tpu.ops import downpour_accumulate
+
             flat_grads = ravel_model_params(params, grads=grads)
-            accum = accum - lr_const * flat_grads  # lr-pre-scaled accumulation (:55)
+            if pad:
+                # folds into the concatenate ravel already performs — the
+                # padded flat vector costs no extra HBM pass
+                flat_grads = jnp.concatenate([flat_grads, jnp.zeros(pad, flat_grads.dtype)])
+            # lr-pre-scaled accumulation (:55) — Pallas flat-axpy kernel on TPU
+            accum = downpour_accumulate(accum, flat_grads, lr_const)
             new_params = jax.tree.map(lambda p, g: p - lr_const * g, params, grads)  # local SGD (:63-68)
             return new_params, accum
 
@@ -216,7 +232,11 @@ class Asynchronous:
 
         # push the accumulated (lr-scaled) gradients every n_push steps (:58-60)
         if self.idx % self.n_push == 0:
-            send_message(MessageCode.GradientUpdate, np.asarray(self.accum), transport=self.transport)
+            send_message(
+                MessageCode.GradientUpdate,
+                np.asarray(self.accum[: self._flat_n]),
+                transport=self.transport,
+            )
             self.accum = jnp.zeros_like(self.accum)
 
         self.idx += 1
@@ -224,7 +244,11 @@ class Asynchronous:
 
     def finish(self) -> None:
         """Flush a final push, notify the server, stop the listener."""
-        send_message(MessageCode.GradientUpdate, np.asarray(self.accum), transport=self.transport)
+        send_message(
+            MessageCode.GradientUpdate,
+            np.asarray(self.accum[: self._flat_n]),
+            transport=self.transport,
+        )
         send_message(MessageCode.WorkerDone, np.zeros(0, np.float32), transport=self.transport)
         self.listener.stop()
 

@@ -1,0 +1,130 @@
+"""Ring attention — sequence/context parallelism over the device mesh.
+
+The reference has no sequence axis at all (image CNNs, SURVEY.md §5.7); the
+TPU framework makes long-context a first-class capability. The sequence is
+sharded across a mesh axis: each device holds a (batch, heads, S/p, dim) chunk
+of Q, K, V. Attention over the full sequence is computed in ``p`` ring steps —
+every device attends its local Q against the K/V chunk it currently holds,
+folds the result into a running online-softmax state, and rotates the K/V
+chunks one hop around the ring with ``lax.ppermute`` (compiled by XLA into
+ICI neighbor transfers that overlap with the attention compute of the next
+step). HBM and VMEM footprint per device stay O(S/p · d); no device ever
+materializes the full sequence, which is precisely what makes contexts longer
+than one chip's memory trainable.
+
+Differentiable end-to-end: the ring is a ``lax.scan`` whose body is the
+blockwise online-softmax update (``ops/attention.py``) plus ``ppermute`` — all
+primitives with transpose rules, so ``jax.grad`` through a sharded training
+step works and the backward pass re-runs the ring in reverse.
+
+Causality across chunks falls out of global position offsets: device ``i``'s
+queries live at ``[i·S/p, (i+1)·S/p)``; a chunk received from device ``j``
+carries keys at ``[j·S/p, ...)``. Chunks entirely in the causal future
+contribute exactly zero (``acc = l = 0`` — see ``_online_update``'s masked-row
+handling) and merge as no-ops.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_ml_pytorch_tpu.ops.attention import (
+    NEG_INF,
+    blockwise_attention,
+    finalize_attention,
+    init_softmax_state,
+)
+
+
+def _merge_softmax_states(m1, l1, a1, m2, l2, a2):
+    """Merge two online-softmax partial states (associative)."""
+    m = jnp.maximum(m1, m2)
+    c1 = jnp.exp(jnp.maximum(m1 - m, NEG_INF))
+    c2 = jnp.exp(jnp.maximum(m2 - m, NEG_INF))
+    return m, l1 * c1 + l2 * c2, a1 * c1 + a2 * c2
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str,
+    axis_size: int,
+    causal: bool = False,
+    block_k: int = 512,
+) -> jax.Array:
+    """Attention over a sequence sharded on mesh axis ``axis``.
+
+    Call **inside** ``shard_map``: ``q``/``k``/``v`` are the local
+    (batch, heads, S/p, dim) chunks; returns the local output chunk.
+    ``axis_size`` is the static ring length (``mesh.shape[axis]``).
+    """
+    p = int(axis_size)
+    idx = jax.lax.axis_index(axis)
+    s_local = q.shape[2]
+    q_offset = idx * s_local
+
+    perm = [(j, (j + 1) % p) for j in range(p)]
+
+    def chunk(step, k_cur, v_cur):
+        src = (idx - step) % p  # whose chunk we hold at this ring step
+        return blockwise_attention(
+            q, k_cur, v_cur,
+            causal=causal,
+            block_k=block_k,
+            q_offset=q_offset,
+            k_offset=src * s_local,
+        )
+
+    m0, l0, acc0 = init_softmax_state(q)
+
+    def body(carry, step):
+        m, l, acc, k_cur, v_cur = carry
+        # start rotating the current chunk onward, then attend to it: the
+        # ppermute has no data dependency on the attention math, so XLA's
+        # scheduler overlaps the ICI transfer with the compute
+        k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+        a_i, m_i, l_i = chunk(step, k_cur, v_cur)
+        m, l, acc = _merge_softmax_states(m, l, acc, m_i, l_i, a_i)
+        return (m, l, acc, k_nxt, v_nxt), None
+
+    if p > 1:
+        # p−1 overlapped hops in the scan; the last received chunk is
+        # attended outside it with no trailing (wasted) rotation
+        (m, l, acc, k_last, v_last), _ = jax.lax.scan(
+            body, (m0, l0, acc0, k, v), jnp.arange(p - 1)
+        )
+    else:
+        m, l, acc, k_last, v_last = m0, l0, acc0, k, v
+    a_i, m_i, l_i = chunk(p - 1, k_last, v_last)
+    m, l, acc = _merge_softmax_states(m, l, acc, m_i, l_i, a_i)
+    return finalize_attention(acc, l).astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh, axis: str = "seq", *, causal: bool = False, block_k: int = 512
+) -> Callable:
+    """Jitted full-sequence attention with the seq axis sharded over ``mesh``.
+
+    Takes/returns global (batch, heads, seq, dim) arrays sharded
+    ``P(None, None, axis, None)``; seq must divide by ``mesh.shape[axis]``.
+    """
+    axis_size = int(mesh.shape[axis])
+    spec = P(None, None, axis, None)
+    local = partial(
+        ring_attention, axis=axis, axis_size=axis_size, causal=causal, block_k=block_k
+    )
+    sharded = jax.shard_map(
+        lambda q, k, v: local(q, k, v),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return jax.jit(sharded)

@@ -21,6 +21,7 @@ difference, which keeps the trainer backend-agnostic per SURVEY.md §7.
 
 from __future__ import annotations
 
+import copy
 import time
 from typing import Any, Callable, Tuple
 
@@ -34,10 +35,10 @@ from distributed_ml_pytorch_tpu.training.trainer import (
     TrainState,
     create_train_state,
     cross_entropy_loss,
-    evaluate,
     make_eval_fn,
+    run_training_loop,
 )
-from distributed_ml_pytorch_tpu.utils.metrics import MetricsLogger, print_eval_line
+from distributed_ml_pytorch_tpu.utils.metrics import MetricsLogger
 
 Pytree = Any
 
@@ -128,7 +129,7 @@ def train_sync(args, mesh: Mesh | None = None) -> Tuple[TrainState, MetricsLogge
     every controller loads only its strided shard of the training set and
     feeds its per-process slice of each global batch.
     """
-    from distributed_ml_pytorch_tpu.data import get_dataset, iterate_batches, shard_for_process
+    from distributed_ml_pytorch_tpu.data import get_dataset, shard_for_process
     from distributed_ml_pytorch_tpu.models import get_model
     from distributed_ml_pytorch_tpu.runtime import data_mesh
 
@@ -145,35 +146,45 @@ def train_sync(args, mesh: Mesh | None = None) -> Tuple[TrainState, MetricsLogge
         dtype=jnp.bfloat16 if getattr(args, "dtype", "float32") == "bfloat16" else jnp.float32,
     )
     state, tx = create_train_state(model, jax.random.key(getattr(args, "seed", 0)), args.lr)
+    # restore (if resuming) before replication: orbax then re-places the
+    # restored arrays under the replicated sharding like any fresh init
+    from distributed_ml_pytorch_tpu.training.trainer import setup_checkpoint
+
+    per_proc_batch = global_batch // n_proc
+    ckpt, state, start_epoch, start_iter = setup_checkpoint(
+        args, state, len(x_train) // per_proc_batch
+    )
     state = replicate(mesh, state)
     train_step = make_sync_train_step(model, tx, mesh)
     eval_step = make_eval_fn(model)
     logger = MetricsLogger(getattr(args, "log_dir", "log"))
     rng = replicate(mesh, jax.random.key(getattr(args, "seed", 0) + 1))
 
+    # reuse the shared epoch/skip/checkpoint loop: shard each host batch onto
+    # the mesh in the step wrapper, and iterate per-process-sized batches
+    def sharded_step(state, bx, by, _rng):
+        bx, by = shard_batch(mesh, bx, by)
+        return train_step(state, bx, by, rng)
+
+    loop_args = copy.copy(args)
+    loop_args.batch_size = per_proc_batch
+
     t0 = time.time()
-    for epoch in range(args.epochs):
-        print("Training for epoch {}".format(epoch))
-        for i, (bx, by) in enumerate(
-            iterate_batches(
-                x_train,
-                y_train,
-                global_batch // n_proc,  # per-process slice of the global batch
-                seed=getattr(args, "seed", 0),
-                epoch=epoch,
-            )
-        ):
-            bx, by = shard_batch(mesh, bx, by)
-            state, loss = train_step(state, bx, by, rng)
-            rec_extra = {}
-            if i % args.log_interval == 0 and i > 0:
-                test_loss, test_acc = evaluate(
-                    eval_step, state.params, x_test, y_test, args.test_batch_size
-                )
-                rec_extra = {"test_loss": test_loss, "test_accuracy": test_acc}
-            rec = logger.log_step(i, float(loss), **rec_extra)
-            if rec_extra:
-                print_eval_line(rec)
-        evaluate(eval_step, state.params, x_test, y_test, args.test_batch_size, verbose=True)
+    try:
+        state = run_training_loop(
+            model=model,
+            state=state,
+            train_step=sharded_step,
+            eval_step=eval_step,
+            data=(x_train, y_train, x_test, y_test),
+            args=loop_args,
+            logger=logger,
+            ckpt=ckpt,
+            start_epoch=start_epoch,
+            start_iter=start_iter,
+        )
+    finally:
+        if ckpt is not None:
+            ckpt.close()
     print("Finished sync-DP training ({:.1f}s, {} devices)".format(time.time() - t0, n_dev))
     return state, logger

@@ -115,6 +115,10 @@ def main(argv=None) -> int:
 
     import jax
 
+    if args.resume and not args.ckpt_dir:
+        print("error: --resume requires --ckpt-dir", file=sys.stderr)
+        return 2
+
     if args.no_distributed:
         # reference `make single` / `make gpu` path (SURVEY.md §3.5)
         from distributed_ml_pytorch_tpu.training.trainer import train_single
@@ -126,6 +130,17 @@ def main(argv=None) -> int:
         print("wrote", path)
         print("Finished Training")
         return 0
+
+    if args.ckpt_dir and args.mode in ("ps", "local-sgd"):
+        # checkpointing is wired into the single-process and sync trainers;
+        # fail loudly rather than silently training without preemption safety
+        print(
+            "error: --ckpt-dir is not supported in --mode {} yet; "
+            "no checkpoints would be written (use --mode sync, or drop "
+            "--ckpt-dir to train without preemption safety)".format(args.mode),
+            file=sys.stderr,
+        )
+        return 2
 
     if args.mode == "ps":
         try:

@@ -26,6 +26,7 @@ Parity decisions (SURVEY.md §7 "reproduce the intent, not the defect"):
 
 from __future__ import annotations
 
+import sys
 import time
 from functools import partial
 from typing import Any, Callable, Optional, Tuple
@@ -170,33 +171,77 @@ def run_training_loop(
     """
     x_train, y_train, x_test, y_test = data
     dropout_rng = jax.random.key(getattr(args, "seed", 0) + 1)
-    for epoch in range(start_epoch, args.epochs):
-        print("Training for epoch {}".format(epoch))
-        skip = start_iter if epoch == start_epoch else 0
-        for i, (bx, by) in enumerate(
-            iterate_batches(x_train, y_train, args.batch_size, seed=getattr(args, "seed", 0), epoch=epoch)
-        ):
-            if i < skip:
-                continue
-            if on_step is not None:
-                state = on_step(state, epoch, i)
-            state, loss = train_step(state, bx, by, dropout_rng)
-            if ckpt is not None:
-                ckpt.save(int(state.step), state)
-            rec_extra = {}
-            if i % args.log_interval == 0 and i > 0:  # reference :83-84
-                test_loss, test_acc = evaluate(
-                    eval_step, state.params, x_test, y_test, args.test_batch_size
-                )
-                rec_extra = {"test_loss": test_loss, "test_accuracy": test_acc}
-            rec = logger.log_step(i, float(loss), **rec_extra)
-            if rec_extra:
-                print_eval_line(rec)
-        evaluate(eval_step, state.params, x_test, y_test, args.test_batch_size, verbose=True)
-    if ckpt is not None:
-        ckpt.save(int(state.step), state, force=True)
-        ckpt.wait()
+    try:
+        for epoch in range(start_epoch, args.epochs):
+            print("Training for epoch {}".format(epoch))
+            skip = start_iter if epoch == start_epoch else 0
+            for i, (bx, by) in enumerate(
+                iterate_batches(
+                    x_train, y_train, args.batch_size,
+                    seed=getattr(args, "seed", 0), epoch=epoch, start_iter=skip,
+                ),
+                start=skip,
+            ):
+                if on_step is not None:
+                    state = on_step(state, epoch, i)
+                state, loss = train_step(state, bx, by, dropout_rng)
+                if ckpt is not None:
+                    ckpt.save(int(state.step), state)
+                rec_extra = {}
+                if i % args.log_interval == 0 and i > 0:  # reference :83-84
+                    test_loss, test_acc = evaluate(
+                        eval_step, state.params, x_test, y_test, args.test_batch_size
+                    )
+                    rec_extra = {"test_loss": test_loss, "test_accuracy": test_acc}
+                rec = logger.log_step(i, float(loss), **rec_extra)
+                if rec_extra:
+                    print_eval_line(rec)
+            evaluate(eval_step, state.params, x_test, y_test, args.test_batch_size, verbose=True)
+    finally:
+        # commit the last completed step even when interrupted mid-epoch —
+        # the exact scenario checkpointing exists for. If the interruption
+        # landed inside a donating train_step, `state` may reference deleted
+        # buffers; never let that mask the original exception.
+        if ckpt is not None:
+            try:
+                ckpt.save(int(state.step), state, force=True)
+                ckpt.wait()
+            except Exception as e:  # pragma: no cover - interrupt-timing dependent
+                print(f"warning: final checkpoint save failed: {e}", file=sys.stderr)
     return state
+
+
+def setup_checkpoint(args, state: TrainState, steps_per_epoch: int):
+    """Build the Checkpointer from CLI flags and fast-forward a resumed run.
+
+    Returns ``(ckpt, state, start_epoch, start_iter)``; ``ckpt`` is ``None``
+    when ``--ckpt-dir`` is unset. Shared by the single-process and sync-DP
+    trainers (orbax handles replicated/sharded arrays the same way).
+    """
+    if not getattr(args, "ckpt_dir", None):
+        return None, state, 0, 0
+    from distributed_ml_pytorch_tpu.utils.checkpoint import (
+        Checkpointer,
+        maybe_restore,
+        resume_position,
+    )
+
+    ckpt = Checkpointer(
+        args.ckpt_dir,
+        max_to_keep=getattr(args, "ckpt_keep", 3),
+        save_interval_steps=getattr(args, "ckpt_every", 500),
+    )
+    start_epoch = start_iter = 0
+    if getattr(args, "resume", False):
+        state, resume_step = maybe_restore(ckpt, state)
+        if resume_step:
+            start_epoch, start_iter = resume_position(resume_step, steps_per_epoch)
+            print(
+                "resumed from step {} → epoch {} iter {}".format(
+                    resume_step, start_epoch, start_iter
+                )
+            )
+    return ckpt, state, start_epoch, start_iter
 
 
 def train_single(args) -> Tuple[TrainState, MetricsLogger]:
@@ -216,44 +261,26 @@ def train_single(args) -> Tuple[TrainState, MetricsLogger]:
     eval_step = make_eval_fn(model)
     logger = MetricsLogger(getattr(args, "log_dir", "log"))
 
-    ckpt, start_epoch, start_iter = None, 0, 0
-    if getattr(args, "ckpt_dir", None):
-        from distributed_ml_pytorch_tpu.utils.checkpoint import (
-            Checkpointer,
-            maybe_restore,
-            resume_position,
-        )
-
-        ckpt = Checkpointer(
-            args.ckpt_dir,
-            max_to_keep=getattr(args, "ckpt_keep", 3),
-            save_interval_steps=getattr(args, "ckpt_every", 500),
-        )
-        if getattr(args, "resume", False):
-            state, resume_step = maybe_restore(ckpt, state)
-            if resume_step:
-                steps_per_epoch = len(x_train) // args.batch_size
-                start_epoch, start_iter = resume_position(resume_step, steps_per_epoch)
-                print(
-                    "resumed from step {} → epoch {} iter {}".format(
-                        resume_step, start_epoch, start_iter
-                    )
-                )
+    ckpt, state, start_epoch, start_iter = setup_checkpoint(
+        args, state, len(x_train) // args.batch_size
+    )
 
     t0 = time.time()
-    state = run_training_loop(
-        model=model,
-        state=state,
-        train_step=train_step,
-        eval_step=eval_step,
-        data=(x_train, y_train, x_test, y_test),
-        args=args,
-        logger=logger,
-        ckpt=ckpt,
-        start_epoch=start_epoch,
-        start_iter=start_iter,
-    )
-    if ckpt is not None:
-        ckpt.close()
+    try:
+        state = run_training_loop(
+            model=model,
+            state=state,
+            train_step=train_step,
+            eval_step=eval_step,
+            data=(x_train, y_train, x_test, y_test),
+            args=args,
+            logger=logger,
+            ckpt=ckpt,
+            start_epoch=start_epoch,
+            start_iter=start_iter,
+        )
+    finally:
+        if ckpt is not None:
+            ckpt.close()
     print("Finished Training ({:.1f}s)".format(time.time() - t0))
     return state, logger
