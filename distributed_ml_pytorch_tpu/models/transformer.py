@@ -1,0 +1,105 @@
+"""Decoder-only Transformer LM — the long-context model family.
+
+The reference's model zoo is two CIFAR CNNs (``example/models.py:5-49``); the
+TPU framework adds a Transformer because long-context training is first-class
+here (SURVEY.md §5.7 records the reference owes nothing — this is a
+capability extension, not parity). The design is shaped by how it trains:
+
+- **Attention is injectable.** ``attn_fn(q, k, v)`` defaults to the
+  blockwise online-softmax kernel (``ops/attention.py``) over the local
+  sequence; under sequence parallelism the trainer passes
+  ``parallel/ring.ring_attention`` bound to the mesh axis, and the same
+  module then computes exact full-sequence attention over sharded chunks.
+  Nothing else in the model knows the sequence is distributed.
+- **Positions are an input**, not ``arange(seq)``: a device holding chunk
+  ``i`` of a sharded sequence feeds its global positions, so learned
+  position embeddings are correct under sharding.
+- Pre-LN blocks, GELU MLP, bf16-friendly (dtype threads through every
+  dense/embed); weights stay f32 (master copies), activations cast.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from distributed_ml_pytorch_tpu.ops.attention import (
+    blockwise_attention,
+    finalize_attention,
+)
+
+
+def default_attn_fn(q, k, v):
+    """Causal attention over the local (= full, when unsharded) sequence."""
+    acc, _m, l = blockwise_attention(q, k, v, causal=True)
+    return finalize_attention(acc, l).astype(q.dtype)
+
+
+class MultiHeadAttention(nn.Module):
+    d_model: int
+    n_heads: int
+    dtype: jnp.dtype = jnp.float32
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, _ = x.shape
+        head_dim = self.d_model // self.n_heads
+        proj = lambda name: nn.Dense(self.d_model, use_bias=False, dtype=self.dtype, name=name)
+        split = lambda t: t.reshape(b, s, self.n_heads, head_dim).transpose(0, 2, 1, 3)
+        q, k, v = (split(proj(n)(x)) for n in ("q", "k", "v"))
+        attn = self.attn_fn or default_attn_fn
+        out = attn(q, k, v)  # (b, h, s, hd)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, self.d_model)
+        return nn.Dense(self.d_model, use_bias=False, dtype=self.dtype, name="o")(out)
+
+
+class Block(nn.Module):
+    d_model: int
+    n_heads: int
+    d_ff: int
+    dtype: jnp.dtype = jnp.float32
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + MultiHeadAttention(
+            self.d_model, self.n_heads, self.dtype, self.attn_fn, name="attn"
+        )(h)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.d_ff, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(self.d_model, dtype=self.dtype)(h)
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Causal LM over token ids; ``positions`` carries global positions so the
+    sequence axis can be sharded (each device passes its chunk's offsets)."""
+
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_len: int = 131072
+    dtype: jnp.dtype = jnp.float32
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        if positions is None:
+            positions = jnp.arange(tokens.shape[-1])[None, :]
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="tok_embed")(tokens)
+        x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype, name="pos_embed")(positions)
+        for i in range(self.n_layers):
+            x = Block(
+                self.d_model, self.n_heads, self.d_ff, self.dtype, self.attn_fn,
+                name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head")(x)

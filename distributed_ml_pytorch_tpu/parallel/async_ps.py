@@ -176,12 +176,14 @@ class Asynchronous:
         self.transport = transport
         self.idx = 0
         self.unravel = make_unraveler(params)
+        from distributed_ml_pytorch_tpu.ops.fused_update import LANES
+
         # accumulator allocation parity: zeros sized like the raveled model
-        # (Asynchronous.py:27) — rounded up to a 128-lane multiple so the
-        # device accumulate takes the Pallas flat-axpy path on TPU; the pad
-        # tail stays zero and is sliced off before anything leaves the device
+        # (Asynchronous.py:27) — rounded up to a lane multiple so the device
+        # accumulate takes the Pallas flat-axpy path on TPU; the pad tail
+        # stays zero and is sliced off before anything leaves the device
         self._flat_n = int(ravel_model_params(params).shape[0])
-        self._pad = (-self._flat_n) % 128
+        self._pad = (-self._flat_n) % LANES
         self.accum = jnp.zeros(self._flat_n + self._pad, jnp.float32)
         # install this worker's initial params as the central params (:34)
         send_message(

@@ -1,0 +1,168 @@
+"""Sequence-parallel LM training: dp×sp sharding with ring attention.
+
+Long sequences are sharded over the ``seq`` mesh axis (each device holds
+S/p tokens of every sequence in its batch shard), batches over ``data``.
+One jitted ``shard_map`` step:
+
+- activations stay sharded along sequence end-to-end; the only cross-chunk
+  communication is ring attention's K/V rotation (``parallel/ring.py``) —
+  everything else in the Transformer is position-local;
+- global token positions are reconstructed per device from
+  ``axis_index(seq)``, so position embeddings are sharding-transparent;
+- the loss is an exact global masked mean: per-device CE numerator/denominator
+  are ``psum``'d over both mesh axes before the division, so differentiating
+  it yields replicated gradients of the *global* loss (the psum transpose
+  inserts the gradient allreduce — same mechanism as ``parallel/sync.py``);
+- parameters and optimizer state are replicated; the update is computed
+  identically everywhere (the DDP invariant), donated for in-place HBM reuse.
+
+The reference has no sequence axis at all (SURVEY.md §5.7) — this is the
+capability the TPU framework adds to make long-context training first-class.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_ml_pytorch_tpu.parallel.ring import ring_attention
+from distributed_ml_pytorch_tpu.training.trainer import TrainState
+
+
+def next_token_targets(tokens: np.ndarray, pad_id: int = 0) -> np.ndarray:
+    """Host-side shifted targets: position i predicts token i+1; the final
+    position is padded and masked out of the loss (see ``make_sp_train_step``).
+    Computing this before sharding keeps the chunk boundary seam exact — the
+    last token of chunk j predicts the first token of chunk j+1."""
+    return np.concatenate(
+        [tokens[:, 1:], np.full((tokens.shape[0], 1), pad_id, tokens.dtype)], axis=1
+    )
+
+
+def create_lm_train_state(
+    model, rng: jax.Array, tx: optax.GradientTransformation, sample_len: int = 8
+) -> TrainState:
+    """Init params on a short dummy sequence (shapes are length-agnostic)."""
+    tokens = jnp.zeros((1, sample_len), jnp.int32)
+    params = model.init(rng, tokens)["params"]
+    return TrainState.create(params, tx)
+
+
+def _bind_ring(model, seq_axis: str, p: int):
+    return model.clone(
+        attn_fn=partial(ring_attention, axis=seq_axis, axis_size=p, causal=True)
+    )
+
+
+def _global_masked_ce(sp_model, params, tokens, targets, axes, seq_axis: str, p: int):
+    """Exact global next-token loss for one local (b, S/p) chunk.
+
+    Reconstructs global positions from ``axis_index(seq)``, masks the final
+    global position (it has no target), and ``psum``s the CE numerator and
+    token count over both mesh axes before dividing — one definition shared
+    by the train and eval paths.
+    """
+    s_local = tokens.shape[1]
+    s_global = s_local * p
+    max_len = getattr(sp_model, "max_len", None)
+    if max_len is not None and s_global > max_len:
+        raise ValueError(
+            f"global sequence length {s_global} exceeds the model's max_len "
+            f"{max_len} — position embeddings would silently go out of range"
+        )
+    seq_idx = jax.lax.axis_index(seq_axis)
+    positions = (seq_idx * s_local + jnp.arange(s_local))[None, :]
+    logits = sp_model.apply({"params": params}, tokens, positions)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    # the mask varies only over seq; tie it to ce's (data, seq) variance so
+    # both psums reduce over both mesh axes
+    mask = (positions < s_global - 1).astype(ce.dtype) * jnp.ones_like(ce)
+    num = jax.lax.psum(jnp.sum(ce * mask), axes)
+    den = jax.lax.psum(jnp.sum(mask), axes)
+    return num / den
+
+
+def make_sp_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+) -> Callable:
+    """Build the jitted dp×sp LM step: ``(state, tokens, targets) → (state, loss)``.
+
+    ``model`` is a ``TransformerLM`` (or compatible) config; its attention is
+    rebound to ring attention over ``seq_axis``. ``tokens``/``targets`` are
+    global (batch, seq) int arrays sharded ``P(data, seq)``; batch must divide
+    ``mesh.shape[data]`` and seq ``mesh.shape[seq]``.
+    """
+    p = int(mesh.shape[seq_axis])
+    sp_model = _bind_ring(model, seq_axis, p)
+    axes = (data_axis, seq_axis)
+
+    def shard_fn(state: TrainState, tokens, targets):
+        def loss_fn(params):
+            return _global_masked_ce(sp_model, params, tokens, targets, axes, seq_axis, p)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        # loss_fn is already the global mean (psum'd numerator/denominator),
+        # so its gradient w.r.t. the replicated params arrives allreduced —
+        # no further normalization.
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(params=params, opt_state=opt_state, step=state.step + 1), loss
+
+    sharded = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P(data_axis, seq_axis), P(data_axis, seq_axis)),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def shard_lm_batch(mesh: Mesh, tokens, targets, data_axis="data", seq_axis="seq"):
+    """Place a host (batch, seq) pair on the dp×sp mesh."""
+    from distributed_ml_pytorch_tpu.parallel.sync import put_sharded
+
+    spec = P(data_axis, seq_axis)
+    return put_sharded(mesh, tokens, spec), put_sharded(mesh, targets, spec)
+
+
+def make_sp_eval_fn(
+    model, mesh: Mesh, data_axis: str = "data", seq_axis: str = "seq"
+) -> Callable:
+    """Cached jitted eval: ``(params, tokens, targets) → global masked-mean CE``
+    under the same dp×sp sharding and loss definition as the train step."""
+    p = int(mesh.shape[seq_axis])
+    sp_model = _bind_ring(model, seq_axis, p)
+    axes = (data_axis, seq_axis)
+
+    def shard_fn(params, tokens, targets):
+        return _global_masked_ce(sp_model, params, tokens, targets, axes, seq_axis, p)
+
+    return jax.jit(
+        jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(), P(data_axis, seq_axis), P(data_axis, seq_axis)),
+            out_specs=P(),
+        )
+    )
+
+
+def sp_eval_loss(
+    model, mesh: Mesh, state: TrainState, tokens, targets,
+    data_axis: str = "data", seq_axis: str = "seq",
+) -> Tuple[float, int]:
+    """One-shot convenience around :func:`make_sp_eval_fn` (builds and jits a
+    fresh closure — inside a loop, cache ``make_sp_eval_fn`` instead)."""
+    fn = make_sp_eval_fn(model, mesh, data_axis, seq_axis)
+    loss = fn(state.params, tokens, targets)
+    return float(loss), int(np.prod(tokens.shape))
