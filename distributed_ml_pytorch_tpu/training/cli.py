@@ -95,6 +95,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="retain the newest N checkpoints")
     p.add_argument("--resume", action="store_true", default=False,
                    help="resume from the latest checkpoint in --ckpt-dir")
+    p.add_argument("--profile-dir", type=str, default="",
+                   help="capture an xprof/TensorBoard trace of a training-step "
+                        "window into this directory (reference has no tracing "
+                        "at all, SURVEY.md §5.1)")
+    p.add_argument("--profile-start", type=int, default=10, metavar="N",
+                   help="global step at which the trace window opens")
+    p.add_argument("--profile-steps", type=int, default=10, metavar="N",
+                   help="number of steps the trace window covers")
     return p
 
 
@@ -138,6 +146,18 @@ def main(argv=None) -> int:
             "error: --ckpt-dir is not supported in --mode {} yet; "
             "no checkpoints would be written (use --mode sync, or drop "
             "--ckpt-dir to train without preemption safety)".format(args.mode),
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.profile_dir and args.mode in ("ps", "local-sgd"):
+        # tracing is wired into the shared training loop (single / sync);
+        # the ps and local-sgd loops don't drive it — fail loudly rather
+        # than silently writing no trace
+        print(
+            "error: --profile-dir is not supported in --mode {} yet; "
+            "no trace would be written (use --mode sync or "
+            "--no-distributed)".format(args.mode),
             file=sys.stderr,
         )
         return 2

@@ -43,6 +43,11 @@ from distributed_ml_pytorch_tpu.utils.metrics import (
     print_classification_report,
     print_eval_line,
 )
+from distributed_ml_pytorch_tpu.utils.tracing import (
+    StepTimer,
+    TraceWindow,
+    annotate_step,
+)
 
 Pytree = Any
 
@@ -171,6 +176,18 @@ def run_training_loop(
     """
     x_train, y_train, x_test, y_test = data
     dropout_rng = jax.random.key(getattr(args, "seed", 0) + 1)
+    tracer = TraceWindow(
+        getattr(args, "profile_dir", None),
+        start=getattr(args, "profile_start", 10),
+        n_steps=getattr(args, "profile_steps", 10),
+    )
+    # persistent step counter: resumed runs continue where the checkpoint
+    # left off, so --profile-start addresses the same step numbering as
+    # --ckpt-every and the CSV logs
+    global_step = int(state.step)
+    # one timer for the whole run: warmup-skip covers XLA compile, which
+    # only happens on the first steps; per-epoch stats via reset_stats()
+    timer = StepTimer(items_per_step=args.batch_size)
     try:
         for epoch in range(start_epoch, args.epochs):
             print("Training for epoch {}".format(epoch))
@@ -182,22 +199,38 @@ def run_training_loop(
                 ),
                 start=skip,
             ):
+                tracer.on_step(global_step)
                 if on_step is not None:
                     state = on_step(state, epoch, i)
-                state, loss = train_step(state, bx, by, dropout_rng)
+                timer.start()
+                with annotate_step("train", global_step):
+                    state, loss = train_step(state, bx, by, dropout_rng)
+                    loss_val = float(loss)  # blocks on the step's output
+                timer.tick()
                 if ckpt is not None:
                     ckpt.save(int(state.step), state)
+                global_step += 1
+                tracer.after_step(global_step)
                 rec_extra = {}
                 if i % args.log_interval == 0 and i > 0:  # reference :83-84
                     test_loss, test_acc = evaluate(
                         eval_step, state.params, x_test, y_test, args.test_batch_size
                     )
                     rec_extra = {"test_loss": test_loss, "test_accuracy": test_acc}
-                rec = logger.log_step(i, float(loss), **rec_extra)
+                rec = logger.log_step(i, loss_val, **rec_extra)
                 if rec_extra:
                     print_eval_line(rec)
+            # a window straddling the epoch boundary is truncated here rather
+            # than polluting the capture with the full-test-set eval below
+            tracer.close()
             evaluate(eval_step, state.params, x_test, y_test, args.test_batch_size, verbose=True)
+            line = timer.report("epoch {} train-step time".format(epoch))
+            if line:
+                print(line)
+            timer.reset_stats()
     finally:
+        tracer.close()
+        tracer.warn_if_never_opened()
         # commit the last completed step even when interrupted mid-epoch —
         # the exact scenario checkpointing exists for. If the interruption
         # landed inside a donating train_step, `state` may reference deleted
