@@ -33,6 +33,7 @@ boundaries (the flat vector in/out), every ``n_push``/``n_pull`` steps.
 from __future__ import annotations
 
 import logging
+import sys
 import threading
 import time
 from typing import Any, Optional, Tuple
@@ -74,6 +75,7 @@ class ParameterServer:
         params: Optional[np.ndarray] = None,
         transport: Optional[Transport] = None,
         n_workers: Optional[int] = None,
+        worker_timeout: Optional[float] = None,
     ):
         if params is not None:
             self.central = np.asarray(params, dtype=np.float32).copy()
@@ -83,7 +85,12 @@ class ParameterServer:
             raise ValueError("ParameterServer needs a model pytree or a flat params vector")
         self.transport = transport
         self.n_workers = n_workers
+        self.worker_timeout = worker_timeout
+        self.failed_workers: set = set()
         self.message_counts = {code: 0 for code in MessageCode}
+        from distributed_ml_pytorch_tpu.utils.failure import StalenessAuditor
+
+        self.staleness = StalenessAuditor()
         self._stop = threading.Event()
 
     def stop(self) -> None:
@@ -95,30 +102,72 @@ class ParameterServer:
         if code == MessageCode.GradientUpdate:
             # workers pre-scale by -lr (Asynchronous.py:55) → server-side add
             self.central += payload
+            self.staleness.on_push(sender)
         elif code == MessageCode.ParameterRequest:
             send_message(
                 MessageCode.ParameterUpdate, self.central, dst=sender, transport=self.transport
             )
+            self.staleness.on_pull(sender)
         elif code == MessageCode.ParameterUpdate:
             self.central = payload.astype(np.float32).copy()
 
     def run(self, timeout: Optional[float] = None) -> None:
-        """Serve until all workers finish (or ``stop()``/``timeout``)."""
+        """Serve until all workers finish (or ``stop()``/``timeout``).
+
+        With ``worker_timeout`` set, a worker silent past that many seconds
+        (no frame of any kind — heartbeats count) is declared failed and
+        stops being waited for, so one crashed worker can't hang the world
+        (the reference server would wait forever, SURVEY.md §5.3).
+        """
         done_workers = set()
+        detector = None
+        if self.worker_timeout and self.n_workers is not None:
+            from distributed_ml_pytorch_tpu.utils.failure import FailureDetector
+
+            # launcher convention: server is rank 0, workers are 1..n_workers
+            detector = FailureDetector(
+                self.worker_timeout, ranks=range(1, self.n_workers + 1)
+            )
         deadline = None if timeout is None else time.monotonic() + timeout
         while not self._stop.is_set():
             if deadline is not None and time.monotonic() >= deadline:
                 break
+            if detector is not None:
+                for rank in sorted(detector.expired()):
+                    print(
+                        "parameter server: worker {} silent for {:.1f}s — "
+                        "declaring it failed".format(rank, self.worker_timeout)
+                    )
+                self.failed_workers = set(detector.failed)
+                if (
+                    len(done_workers) + len(self.failed_workers) >= self.n_workers
+                ):
+                    break
             msg = self.transport.recv(timeout=0.2)
             if msg is None:
                 continue
             sender, code, payload = msg
+            if detector is not None:
+                detector.note(sender)  # a failed rank that speaks rejoins
+                self.failed_workers = set(detector.failed)
+            if code == MessageCode.Heartbeat:
+                self.message_counts[code] = self.message_counts.get(code, 0) + 1
+                continue
             if code == MessageCode.WorkerDone:
                 done_workers.add(sender)
-                if self.n_workers is not None and len(done_workers) >= self.n_workers:
+                if detector is not None:
+                    detector.forget(sender)
+                # failed_workers excludes done_workers by construction: note()
+                # above rejoined this sender before it was marked done
+                if self.n_workers is not None and (
+                    len(done_workers) + len(self.failed_workers) >= self.n_workers
+                ):
                     break
                 continue
             self.handle(sender, code, payload)
+        line = self.staleness.report()
+        if line:
+            print("parameter server:", line)
 
 
 class Listener(MessageListener):
@@ -163,6 +212,7 @@ class Asynchronous:
         n_pull: int,
         *,
         transport: Optional[Transport] = None,
+        heartbeat: Optional["HeartbeatSender"] = None,
     ):
         if lr < 0.0:
             raise ValueError("Invalid learning rate: {}".format(lr))
@@ -191,6 +241,12 @@ class Asynchronous:
         )
         self.listener = Listener(transport=transport)
         self.listener.start()
+        # a dead server degrades the worker to purely-local SGD (see _send).
+        # The heartbeat (if any) is owned by the process entry, started before
+        # any jit compile — liveness must reflect process health, not compile
+        # progress; the optimizer only consults its peer_down flag.
+        self.server_down = False
+        self.heartbeat = heartbeat
 
         lr_const = self.lr
         pad = self._pad
@@ -215,6 +271,30 @@ class Asynchronous:
 
         self._device_step = _device_step
 
+    def _send(self, code: MessageCode, payload) -> None:
+        """Send toward the server; a dead server degrades, never crashes.
+
+        First failure prints one warning and flips :attr:`server_down`; from
+        then on the worker trains purely locally (the reference would raise
+        out of ``optimizer.step`` mid-epoch — SURVEY.md §5.3 notes it has no
+        failure handling anywhere).
+        """
+        if self.server_down:
+            return
+        if self.heartbeat is not None and self.heartbeat.peer_down:
+            self.server_down = True
+        else:
+            try:
+                send_message(code, payload, transport=self.transport)
+                return
+            except (OSError, ConnectionError):
+                self.server_down = True
+        print(
+            "worker: parameter server unreachable — continuing with "
+            "purely-local SGD (no further push/pull)",
+            file=sys.stderr,
+        )
+
     def step(self, params: Pytree, grads: Pytree) -> Pytree:
         # install the freshest server push at the step boundary (race-free
         # version of the reference's mid-step unravel, Asynchronous.py:17-18)
@@ -226,19 +306,13 @@ class Asynchronous:
         # ships the accumulator as a dummy payload — an empty payload is the
         # intent (the request carries no information)
         if self.idx % self.n_pull == 0:
-            send_message(
-                MessageCode.ParameterRequest, np.zeros(0, np.float32), transport=self.transport
-            )
+            self._send(MessageCode.ParameterRequest, np.zeros(0, np.float32))
 
         params, self.accum = self._device_step(params, grads, self.accum)
 
         # push the accumulated (lr-scaled) gradients every n_push steps (:58-60)
         if self.idx % self.n_push == 0:
-            send_message(
-                MessageCode.GradientUpdate,
-                np.asarray(self.accum[: self._flat_n]),
-                transport=self.transport,
-            )
+            self._send(MessageCode.GradientUpdate, np.asarray(self.accum[: self._flat_n]))
             self.accum = jnp.zeros_like(self.accum)
 
         self.idx += 1
@@ -246,12 +320,10 @@ class Asynchronous:
 
     def finish(self) -> None:
         """Flush a final push, notify the server, stop the listener."""
-        send_message(
-            MessageCode.GradientUpdate,
-            np.asarray(self.accum[: self._flat_n]),
-            transport=self.transport,
-        )
-        send_message(MessageCode.WorkerDone, np.zeros(0, np.float32), transport=self.transport)
+        self._send(MessageCode.GradientUpdate, np.asarray(self.accum[: self._flat_n]))
+        self._send(MessageCode.WorkerDone, np.zeros(0, np.float32))
+        if self.heartbeat is not None:
+            self.heartbeat.stop()
         self.listener.stop()
 
 
@@ -261,7 +333,9 @@ class Asynchronous:
 DownpourSGD = Asynchronous
 
 
-def train_worker(args, transport: Transport) -> Tuple[Pytree, "MetricsLogger"]:
+def train_worker(
+    args, transport: Transport, heartbeat=None
+) -> Tuple[Pytree, "MetricsLogger"]:
     """Worker-side training loop (reference ``main(args)`` distributed branch,
     ``example/main.py:31-105``)."""
     from distributed_ml_pytorch_tpu.data import get_dataset, iterate_batches
@@ -278,7 +352,12 @@ def train_worker(args, transport: Transport) -> Tuple[Pytree, "MetricsLogger"]:
     seed = getattr(args, "seed", 0)
     params = model.init(jax.random.key(seed), jnp.zeros((1, 32, 32, 3)))["params"]
     opt = Asynchronous(
-        params, lr=args.lr, n_push=args.num_push, n_pull=args.num_pull, transport=transport
+        params,
+        lr=args.lr,
+        n_push=args.num_push,
+        n_pull=args.num_pull,
+        transport=transport,
+        heartbeat=heartbeat,
     )
     dropout_rng = jax.random.key(seed + 1 + transport.rank)
 
@@ -329,9 +408,18 @@ def run_server(args, transport: Transport) -> ParameterServer:
         jax.random.key(getattr(args, "seed", 0)), jnp.zeros((1, 32, 32, 3))
     )["params"]
     server = ParameterServer(
-        params, transport=transport, n_workers=args.world_size - 1
+        params,
+        transport=transport,
+        n_workers=args.world_size - 1,
+        worker_timeout=getattr(args, "worker_timeout", 0.0) or None,
     )
     server.run()
+    if server.failed_workers:
+        print(
+            "parameter server: finished with failed workers: {}".format(
+                sorted(server.failed_workers)
+            )
+        )
     return server
 
 
@@ -350,15 +438,28 @@ def run_ps_process(args) -> int:
         int(args.port),
         kind=getattr(args, "transport", "auto"),
     )
+    heartbeat = None
     try:
         if args.server or args.rank == SERVER_RANK:
-            run_server(args, transport)
-            print("parameter server: all workers done")
+            server = run_server(args, transport)
+            if not server.failed_workers:
+                print("parameter server: all workers done")
         else:
-            _params, logger = train_worker(args, transport)
+            hb_interval = getattr(args, "heartbeat_interval", 0.0)
+            if hb_interval > 0:
+                # started before any jit compile: the server's failure
+                # detector must see liveness the moment the process is up,
+                # not after the first (possibly minutes-long) compilation
+                from distributed_ml_pytorch_tpu.utils.failure import HeartbeatSender
+
+                heartbeat = HeartbeatSender(transport, interval=hb_interval)
+                heartbeat.start()
+            _params, logger = train_worker(args, transport, heartbeat=heartbeat)
             path = logger.to_csv("node{}.csv".format(args.rank))
             print("wrote", path)
             print("Finished Training")
     finally:
+        if heartbeat is not None:
+            heartbeat.stop()
         transport.close()
     return 0

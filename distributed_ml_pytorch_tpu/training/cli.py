@@ -103,6 +103,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="global step at which the trace window opens")
     p.add_argument("--profile-steps", type=int, default=10, metavar="N",
                    help="number of steps the trace window covers")
+    p.add_argument("--heartbeat-interval", type=float, default=1.0, metavar="SEC",
+                   help="PS-mode worker liveness heartbeat cadence; 0 disables "
+                        "(the reference has no failure detection, SURVEY.md §5.3)")
+    p.add_argument("--worker-timeout", type=float, default=30.0, metavar="SEC",
+                   help="PS-mode server declares a worker failed after this "
+                        "long without a frame, instead of waiting forever; "
+                        "0 disables")
     return p
 
 
@@ -161,6 +168,19 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
+
+    if args.mode == "ps" and args.worker_timeout > 0:
+        hb = args.heartbeat_interval
+        if hb <= 0 or hb * 3 > args.worker_timeout:
+            # without fast heartbeats, "silent" and "dead" are
+            # indistinguishable: sparse push/pull cadence or a long jit
+            # compile would falsely fail a healthy worker
+            print(
+                "warning: --worker-timeout {:.0f}s needs heartbeats well "
+                "under it (got --heartbeat-interval {}); healthy-but-quiet "
+                "workers may be declared failed".format(args.worker_timeout, hb),
+                file=sys.stderr,
+            )
 
     if args.mode == "ps":
         try:

@@ -1,0 +1,167 @@
+"""Failure detection for the async-PS control plane.
+
+The reference has **no failure handling at all** (SURVEY.md §5.3): world size
+is a static flag, the gloo rendezvous blocks forever, and a worker crash
+leaves the parameter server serving a world that will never finish. This
+module closes that gap for the framework's PS topology:
+
+- :class:`FailureDetector` — pure liveness bookkeeping: per-rank last-seen
+  timestamps with a timeout; ``expired()`` reports newly-dead ranks exactly
+  once. No I/O, unit-testable with a fake clock.
+- :class:`HeartbeatSender` — a worker-side daemon thread sending periodic
+  ``MessageCode.Heartbeat`` frames (an extension code; the wire format is
+  unchanged, so Python and native C++ endpoints both carry it). Heartbeats
+  make liveness independent of push/pull cadence — a worker with a huge
+  ``n_push`` is silent for minutes while perfectly healthy.
+- Server integration (``parallel/async_ps.ParameterServer.run``): any frame
+  from a rank refreshes its liveness; a rank silent past ``worker_timeout``
+  is declared failed, logged, and counted toward run termination so the
+  server exits cleanly instead of hanging — the precise failure mode the
+  reference's ``server.run()``-never-returns design exhibits
+  (SURVEY.md §3.2).
+- Worker integration (``parallel/async_ps.Asynchronous``): a dead server
+  (send raising ``OSError``/``ConnectionError``) degrades the worker to
+  purely-local SGD with a single warning instead of crashing mid-epoch —
+  training forward progress survives the control plane.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Iterable, Optional, Set
+
+
+class FailureDetector:
+    """Timeout-based liveness tracking over a set of ranks.
+
+    ``note(rank)`` refreshes a rank's liveness; :meth:`expired` returns the
+    ranks whose silence exceeds ``timeout`` — each reported once, then moved
+    to :attr:`failed`. A ``clock`` injection point keeps tests instant.
+    """
+
+    def __init__(
+        self,
+        timeout: float,
+        ranks: Iterable[int] = (),
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if timeout <= 0:
+            raise ValueError(f"timeout must be positive, got {timeout}")
+        self.timeout = float(timeout)
+        self._clock = clock
+        now = self._clock()
+        self._last_seen: Dict[int, float] = {int(r): now for r in ranks}
+        self.failed: Set[int] = set()
+
+    def watch(self, rank: int) -> None:
+        """Start tracking a rank (no-op if already tracked or failed)."""
+        if rank not in self._last_seen and rank not in self.failed:
+            self._last_seen[rank] = self._clock()
+
+    def note(self, rank: int) -> None:
+        """Record evidence of life. A failed rank that speaks again rejoins."""
+        self.failed.discard(rank)
+        self._last_seen[rank] = self._clock()
+
+    def forget(self, rank: int) -> None:
+        """Stop tracking a rank (it finished cleanly)."""
+        self._last_seen.pop(rank, None)
+
+    def expired(self) -> Set[int]:
+        """Ranks newly past the timeout; each is reported exactly once."""
+        now = self._clock()
+        newly = {
+            r for r, seen in self._last_seen.items() if now - seen > self.timeout
+        }
+        for r in newly:
+            del self._last_seen[r]
+        self.failed |= newly
+        return newly
+
+    def alive(self) -> Set[int]:
+        return set(self._last_seen)
+
+
+class HeartbeatSender(threading.Thread):
+    """Worker-side daemon: send a Heartbeat frame every ``interval`` seconds.
+
+    Send failures mark the peer dead (exposed via :attr:`peer_down`) and end
+    the loop quietly — the training loop decides what to do about it; the
+    heartbeat thread must never take the process down.
+    """
+
+    def __init__(self, transport, interval: float = 1.0):
+        super().__init__(daemon=True)
+        from distributed_ml_pytorch_tpu.utils.messaging import MessageCode
+
+        self._code = MessageCode.Heartbeat
+        self.transport = transport
+        self.interval = float(interval)
+        self.peer_down = False
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        import numpy as np
+
+        empty = np.zeros(0, np.float32)
+        while not self._stop.wait(self.interval):
+            try:
+                self.transport.send(self._code, empty)
+            except (OSError, ConnectionError, KeyError):
+                self.peer_down = True
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class StalenessAuditor:
+    """Observability for the DownPour race the reference leaves implicit.
+
+    The reference's listener thread overwrites live parameters mid-step — a
+    deliberate, *unmeasured* data race (SURVEY.md §5.2). The framework's
+    functional re-design makes every pull a clean between-steps swap, which
+    also makes staleness measurable: the server stamps its central params
+    with a version (one increment per applied GradientUpdate) and records,
+    for each worker push, how many versions elapsed since that worker last
+    pulled. ``summary()`` turns that into the staleness distribution —
+    the quantity DownPour-style async SGD's convergence actually depends on.
+    """
+
+    def __init__(self):
+        self.version = 0
+        self._pulled_at: Dict[int, int] = {}
+        self.per_worker: Dict[int, list] = {}
+
+    def on_pull(self, rank: int) -> None:
+        self._pulled_at[rank] = self.version
+
+    def on_push(self, rank: int) -> int:
+        staleness = self.version - self._pulled_at.get(rank, 0)
+        self.per_worker.setdefault(rank, []).append(staleness)
+        self.version += 1
+        return staleness
+
+    def summary(self) -> Optional[dict]:
+        all_s = [s for v in self.per_worker.values() for s in v]
+        if not all_s:
+            return None
+        all_s.sort()
+        n = len(all_s)
+        return {
+            "pushes": n,
+            "versions": self.version,
+            "mean": sum(all_s) / n,
+            "max": all_s[-1],
+            "p50": all_s[n // 2],
+        }
+
+    def report(self) -> Optional[str]:
+        s = self.summary()
+        if s is None:
+            return None
+        return (
+            "gradient staleness over {pushes} pushes: mean {mean:.1f}, "
+            "p50 {p50}, max {max} versions".format(**s)
+        )

@@ -45,16 +45,19 @@ SERVER_RANK = 0  # reference convention: rank 0 is the parameter server
 class MessageCode(enum.IntEnum):
     """Message tags (reference ``Asynchronous.py:17,34,49,59``).
 
-    ``WorkerDone`` is an extension beyond the reference's three codes: it lets
-    the server terminate cleanly once every worker finishes, instead of
-    blocking forever (SURVEY.md §3.2 notes the reference server never
-    returns).
+    ``WorkerDone`` and ``Heartbeat`` are extensions beyond the reference's
+    three codes: ``WorkerDone`` lets the server terminate cleanly once every
+    worker finishes instead of blocking forever (SURVEY.md §3.2 notes the
+    reference server never returns), and ``Heartbeat`` carries worker
+    liveness for failure detection (``utils/failure.py`` — the reference has
+    none, SURVEY.md §5.3).
     """
 
     ParameterUpdate = 0
     ParameterRequest = 1
     GradientUpdate = 2
     WorkerDone = 3
+    Heartbeat = 4
 
 
 Message = Tuple[int, MessageCode, np.ndarray]
@@ -165,6 +168,11 @@ class TCPTransport(Transport):
         self._peers: Dict[int, socket.socket] = {}
         self._threads = []
         self._closed = False
+        # serializes concurrent senders (training loop + heartbeat thread) so
+        # frames never interleave mid-write — sendall releases the GIL between
+        # syscalls on large payloads. The native transport's send_mu
+        # (native/transport.cpp) guards the same hazard.
+        self._send_locks: Dict[int, threading.Lock] = {}
         if rank == SERVER_RANK:
             srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -215,7 +223,8 @@ class TCPTransport(Transport):
 
     def send(self, code: MessageCode, payload: np.ndarray, dst: int = SERVER_RANK) -> None:
         arr = np.asarray(payload, dtype=np.float32).ravel()
-        _send_frame(self._peers[dst], self.rank, int(code), arr)
+        with self._send_locks.setdefault(dst, threading.Lock()):
+            _send_frame(self._peers[dst], self.rank, int(code), arr)
 
     def recv(self, timeout: Optional[float] = None) -> Optional[Message]:
         # Poll in short slices so a blocking recv() still returns None once the

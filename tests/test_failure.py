@@ -1,0 +1,241 @@
+"""Failure detection + staleness audit — subsystems the reference lacks
+(SURVEY.md §5.2-5.3): crashed-worker detection at the server, dead-server
+degradation at the worker, and measured gradient staleness."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.parallel.async_ps import Asynchronous, ParameterServer
+from distributed_ml_pytorch_tpu.utils.failure import (
+    FailureDetector,
+    HeartbeatSender,
+    StalenessAuditor,
+)
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    InProcessTransport,
+    MessageCode,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_detector_reports_expired_rank_once():
+    clk = FakeClock()
+    d = FailureDetector(timeout=5.0, ranks=[1, 2], clock=clk)
+    clk.t = 3.0
+    d.note(1)
+    clk.t = 6.0  # rank 2 silent for 6 > 5; rank 1 seen 3s ago
+    assert d.expired() == {2}
+    assert d.expired() == set()  # reported exactly once
+    assert d.failed == {2}
+    assert d.alive() == {1}
+
+
+def test_detector_rejoin_and_forget():
+    clk = FakeClock()
+    d = FailureDetector(timeout=1.0, ranks=[1], clock=clk)
+    clk.t = 2.0
+    assert d.expired() == {1}
+    d.note(1)  # failed rank speaks again → rejoins
+    assert d.failed == set()
+    d.forget(1)  # clean finish → not tracked, never expires
+    clk.t = 10.0
+    assert d.expired() == set()
+
+
+def test_detector_rejects_nonpositive_timeout():
+    with pytest.raises(ValueError):
+        FailureDetector(timeout=0.0)
+
+
+def test_staleness_auditor_measures_versions_between_pull_and_push():
+    a = StalenessAuditor()
+    a.on_pull(1)          # worker 1 pulls at version 0
+    a.on_push(2)          # version 0→1 while worker 1 trains
+    a.on_push(2)          # version 1→2
+    s = a.on_push(1)      # worker 1's push is 2 versions stale
+    assert s == 2
+    summary = a.summary()
+    assert summary["pushes"] == 3 and summary["max"] == 2
+    assert "staleness" in a.report()
+
+
+def test_staleness_auditor_empty_is_silent():
+    assert StalenessAuditor().summary() is None
+    assert StalenessAuditor().report() is None
+
+
+def test_heartbeat_sender_emits_frames():
+    world = InProcessTransport.create_world(2)
+    hb = HeartbeatSender(world[1], interval=0.02)
+    hb.start()
+    msg = world[0].recv(timeout=2.0)
+    hb.stop()
+    assert msg is not None
+    sender, code, payload = msg
+    assert sender == 1 and code == MessageCode.Heartbeat and payload.size == 0
+
+
+def test_server_declares_silent_worker_failed_instead_of_hanging():
+    world = InProcessTransport.create_world(3)
+    server = ParameterServer(
+        params=np.zeros(4, np.float32),
+        transport=world[0],
+        n_workers=2,
+        worker_timeout=0.3,
+    )
+    # worker 1 finishes cleanly; worker 2 "crashes" (never sends anything)
+    world[1].send(MessageCode.WorkerDone, np.zeros(0, np.float32))
+    t0 = time.monotonic()
+    server.run(timeout=10.0)  # guard: must exit via detection, not timeout
+    assert time.monotonic() - t0 < 5.0
+    assert server.failed_workers == {2}
+
+
+def test_heartbeats_keep_long_cadence_worker_alive():
+    world = InProcessTransport.create_world(2)
+    server = ParameterServer(
+        params=np.zeros(4, np.float32),
+        transport=world[0],
+        n_workers=1,
+        worker_timeout=0.4,
+    )
+    hb = HeartbeatSender(world[1], interval=0.05)
+    hb.start()
+    result = {}
+
+    def serve():
+        server.run(timeout=10.0)
+        result["failed"] = set(server.failed_workers)
+
+    t = threading.Thread(target=serve)
+    t.start()
+    time.sleep(1.0)  # well past worker_timeout: heartbeats must keep rank 1 alive
+    assert t.is_alive(), "server exited while its only worker was heartbeating"
+    world[1].send(MessageCode.WorkerDone, np.zeros(0, np.float32))
+    t.join(timeout=5.0)
+    hb.stop()
+    assert result["failed"] == set()
+
+
+def test_concurrent_sends_do_not_interleave_frames():
+    """Heartbeat thread + training thread share one socket; frames must not
+    tear (TCPTransport serializes writers per peer socket)."""
+    from distributed_ml_pytorch_tpu.launch import _free_port
+    from distributed_ml_pytorch_tpu.utils.messaging import TCPTransport
+
+    port = _free_port()
+    server_box = {}
+
+    def serve():
+        server_box["t"] = TCPTransport(0, 2, port=port)
+
+    st = threading.Thread(target=serve)
+    st.start()
+    worker = TCPTransport(1, 2, port=port)
+    st.join()
+    server = server_box["t"]
+
+    n_big, n_beats = 12, 300
+    big = np.arange(500_000, dtype=np.float32)  # 2 MB: sendall spans syscalls
+
+    def push():
+        for _ in range(n_big):
+            worker.send(MessageCode.GradientUpdate, big)
+
+    def beat():
+        for _ in range(n_beats):
+            worker.send(MessageCode.Heartbeat, np.zeros(0, np.float32))
+
+    threads = [threading.Thread(target=push), threading.Thread(target=beat)]
+    for t in threads:
+        t.start()
+    got_big = got_beat = 0
+    # (the worker's hello frame is consumed by the server's accept loop)
+    for _ in range(n_big + n_beats):
+        msg = server.recv(timeout=10.0)
+        assert msg is not None, "stream corrupted or stalled"
+        _, code, payload = msg
+        if code == MessageCode.GradientUpdate:
+            got_big += 1
+            np.testing.assert_array_equal(payload, big)
+        elif code == MessageCode.Heartbeat:
+            got_beat += 1
+    for t in threads:
+        t.join()
+    assert got_big == n_big and got_beat == n_beats
+    worker.close()
+    server.close()
+
+
+def test_failed_worker_that_finishes_is_not_double_counted():
+    world = InProcessTransport.create_world(3)
+    server = ParameterServer(
+        params=np.zeros(4, np.float32),
+        transport=world[0],
+        n_workers=2,
+        worker_timeout=0.3,
+    )
+    result = {}
+
+    def serve():
+        server.run(timeout=10.0)
+        result["failed"] = set(server.failed_workers)
+
+    hb2 = HeartbeatSender(world[2], interval=0.05)  # worker 2 stays healthy
+    hb2.start()
+    t = threading.Thread(target=serve)
+    t.start()
+    time.sleep(0.6)  # worker 1 silent past the timeout → declared failed
+    # worker 1 was only slow (long jit compile): it finishes cleanly. It must
+    # rejoin and count as done only — NOT as done AND failed, which would end
+    # the run while worker 2 is still training.
+    world[1].send(MessageCode.WorkerDone, np.zeros(0, np.float32))
+    time.sleep(0.3)
+    assert t.is_alive(), (
+        "server exited counting a finished worker as both done and failed"
+    )
+    world[2].send(MessageCode.WorkerDone, np.zeros(0, np.float32))
+    t.join(timeout=5.0)
+    hb2.stop()
+    assert not t.is_alive()
+    assert result["failed"] == set()
+
+
+class DyingTransport(InProcessTransport):
+    """Starts delivering, then raises on send — a mid-run server death."""
+
+    def __init__(self, rank, mailboxes):
+        super().__init__(rank, mailboxes)
+        self.dead = False
+
+    def send(self, code, payload, dst=0):
+        if self.dead:
+            raise ConnectionError("server is gone")
+        super().send(code, payload, dst=dst)
+
+
+def test_worker_degrades_to_local_sgd_when_server_dies():
+    import jax.numpy as jnp
+
+    boxes = InProcessTransport.create_world(2)
+    dying = DyingTransport(1, boxes[1]._boxes)
+    params = {"w": jnp.ones((3,)), "b": jnp.zeros((2,))}
+    grads = {"w": jnp.ones((3,)), "b": jnp.ones((2,))}
+    opt = Asynchronous(params, lr=0.1, n_push=1, n_pull=1, transport=dying)
+    params = opt.step(params, grads)  # healthy step
+    dying.dead = True
+    for _ in range(3):  # must not raise; training continues locally
+        params = opt.step(params, grads)
+    assert opt.server_down
+    opt.finish()  # also must not raise
+    np.testing.assert_allclose(np.asarray(params["w"]), np.full(3, 1.0 - 0.4), rtol=1e-6)
