@@ -14,8 +14,18 @@ from distributed_ml_pytorch_tpu.parallel.local_sgd import (
     make_local_sgd_round,
     train_local_sgd,
 )
+from distributed_ml_pytorch_tpu.parallel.tensor_parallel import (
+    create_tp_train_state,
+    make_tp_train_step,
+    shard_tp_batch,
+    tp_param_specs,
+)
 
 __all__ = [
+    "create_tp_train_state",
+    "make_tp_train_step",
+    "shard_tp_batch",
+    "tp_param_specs",
     "make_sync_train_step",
     "shard_batch",
     "train_sync",

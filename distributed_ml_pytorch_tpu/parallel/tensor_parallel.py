@@ -1,0 +1,172 @@
+"""Tensor-parallel LM training: dp×tp via pjit/GSPMD sharding annotations.
+
+The reference has no tensor parallelism (SURVEY.md §2.4 marks TP ABSENT) —
+this is a capability extension, built the TPU-native way: instead of manual
+collectives (Megatron's row/column wrappers, or this framework's own
+``shard_map`` sequence-parallel path), the *parameters* carry Megatron-style
+``PartitionSpec``s and XLA's SPMD partitioner inserts the matching
+all-reduces:
+
+- attention q/k/v projections column-sharded ``P(None, model)`` (heads split
+  across the ``model`` axis), output projection row-sharded ``P(model, None)``
+  — one all-reduce per attention block, inserted by XLA;
+- MLP up-projection column-sharded, down-projection row-sharded — one
+  all-reduce per MLP;
+- ``lm_head`` column-sharded over vocab: logits stay vocab-sharded and the
+  cross-entropy's log-sum-exp reduces over the sharded axis with XLA-chosen
+  collectives;
+- embeddings replicated (small relative to blocks at these widths).
+
+This module is deliberately the *pjit idiom* counterpart to
+``parallel/seq_parallel.py``'s *shard_map idiom*: annotate + propagate vs
+explicit per-device code. Both compose with data parallelism through the
+mesh; batches are sharded ``P(data)`` and parameters are sharded over
+``model`` only, so the gradient all-reduce over ``data`` is likewise
+inserted by XLA (the compiled analog of DDP).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_ml_pytorch_tpu.training.trainer import TrainState
+
+
+def tp_param_specs(tree, model_axis: str = "model"):
+    """Megatron-style ``PartitionSpec`` tree for a ``TransformerLM`` param tree.
+
+    The rules are path-based, so they also apply to any tree whose paths
+    *embed* param paths — in particular a whole ``TrainState`` (optimizer
+    momentum mirrors the param tree), which is how
+    :func:`create_tp_train_state` shards the optimizer state without
+    per-optimizer knowledge.
+
+    Rules are by parameter path (flax module names from
+    ``models/transformer.py``):
+
+    ==========================  =======================  ==================
+    parameter                   shape                    spec
+    ==========================  =======================  ==================
+    attn q/k/v kernels          (d_model, d_model)       P(None, model)
+    attn o kernel               (d_model, d_model)       P(model, None)
+    block MLP up (Dense_0)      (d_model, d_ff)          P(None, model)
+    block MLP up bias           (d_ff,)                  P(model)
+    block MLP down (Dense_1)    (d_ff, d_model)          P(model, None)
+    lm_head kernel              (d_model, vocab)         P(None, model)
+    everything else             —                        P() (replicated)
+    ==========================  =======================  ==================
+
+    The column-then-row pairing means each block needs exactly one
+    all-reduce on its output — XLA inserts it from these annotations.
+    """
+
+    def spec_for(path: Tuple[str, ...], leaf) -> P:
+        names = [getattr(k, "key", str(k)) for k in path]
+        joined = "/".join(names)
+        if "attn" in names:
+            if names[-2] in ("q", "k", "v"):
+                return P(None, model_axis)
+            if names[-2] == "o":
+                return P(model_axis, None)
+        if "Dense_0" in names:  # MLP up-projection (Block's first Dense)
+            return P(None, model_axis) if leaf.ndim == 2 else P(model_axis)
+        if "Dense_1" in names:  # MLP down-projection
+            return P(model_axis, None) if leaf.ndim == 2 else P()
+        if "lm_head" in joined and leaf.ndim == 2:
+            return P(None, model_axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def _check_divisibility(model, n_model: int) -> None:
+    for name, dim in (
+        ("n_heads", model.n_heads),
+        ("d_ff", model.d_ff),
+        ("vocab_size", model.vocab_size),
+    ):
+        if dim % n_model:
+            raise ValueError(
+                f"model.{name}={dim} is not divisible by the tp axis size "
+                f"{n_model} — the sharded dimension must split evenly"
+            )
+
+
+def create_tp_train_state(
+    model,
+    rng: jax.Array,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    model_axis: str = "model",
+    sample_len: int = 8,
+) -> TrainState:
+    """Init a ``TrainState`` with params laid out per :func:`tp_param_specs`.
+
+    The init runs under ``jit`` with the whole-state sharding as
+    ``out_shardings`` (params *and* optimizer state, via the path-based
+    rules), so the state is *created already sharded* — no host-side full
+    copy of the model ever materializes (how TPU frameworks init models too
+    big for one host).
+    """
+    _check_divisibility(model, int(mesh.shape[model_axis]))
+    dummy = jnp.zeros((1, sample_len), jnp.int32)
+
+    def init_fn(rng):
+        params = model.init(rng, dummy)["params"]
+        return TrainState.create(params, tx)
+
+    state_shapes = jax.eval_shape(init_fn, rng)
+    specs = tp_param_specs(state_shapes, model_axis)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+def make_tp_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    model_axis: str = "model",
+) -> Callable:
+    """Build the jitted dp×tp LM step: ``(state, tokens, targets) → (state, loss)``.
+
+    ``tokens``/``targets`` are global (batch, seq) int arrays sharded over the
+    mesh's data axis by :func:`shard_tp_batch` (sharding flows from the
+    arrays; the step itself is axis-name agnostic); params are sharded over
+    ``model`` per :func:`tp_param_specs`. ``targets`` follow the
+    ``seq_parallel.next_token_targets`` convention, so the loss masks the
+    final position by *position* (it has no next token) — identical loss
+    definition to the sp path, making dp/sp/tp runs comparable on the same
+    data. Every collective (logsumexp over the sharded vocab, grad
+    all-reduces over data and model) comes from the partitioner, not from
+    handwritten ``psum``s; contrast ``seq_parallel.make_sp_train_step``.
+    """
+    _check_divisibility(model, int(mesh.shape[model_axis]))
+
+    def step(state: TrainState, tokens, targets):
+        def loss_fn(params):
+            logits = model.apply({"params": params}, tokens)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+            mask = jnp.ones_like(ce).at[:, -1].set(0.0)  # last position: no target
+            return jnp.sum(ce * mask) / jnp.sum(mask)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(params=params, opt_state=opt_state, step=state.step + 1), loss
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def shard_tp_batch(mesh: Mesh, tokens, targets, data_axis: str = "data"):
+    """Place a host (batch, seq) pair on the dp×tp mesh: batch-sharded,
+    sequence and vocab handled by propagation from the params."""
+    sharding = NamedSharding(mesh, P(data_axis, None))
+    return jax.device_put(tokens, sharding), jax.device_put(targets, sharding)

@@ -1,0 +1,118 @@
+"""dp×tp tensor parallelism: Megatron-style param shardings under pjit/GSPMD
+must be numerically identical to unsharded training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_ml_pytorch_tpu.models.transformer import TransformerLM
+from distributed_ml_pytorch_tpu.parallel.seq_parallel import next_token_targets
+from distributed_ml_pytorch_tpu.parallel.tensor_parallel import (
+    create_tp_train_state,
+    make_tp_train_step,
+    shard_tp_batch,
+    tp_param_specs,
+)
+from distributed_ml_pytorch_tpu.training.trainer import TrainState
+
+
+def tiny_model():
+    return TransformerLM(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_len=128
+    )
+
+
+def dp_tp_mesh(dp=2, tp=4):
+    devs = np.array(jax.devices()[: dp * tp]).reshape(dp, tp)
+    return Mesh(devs, ("data", "model"))
+
+
+def make_batch(batch=4, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(1, 64, size=(batch, seq)).astype(np.int32)
+    return tokens, next_token_targets(tokens)
+
+
+def test_tp_param_specs_follow_megatron_rules():
+    model = tiny_model()
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    specs = tp_param_specs(params)
+    b0 = specs["block_0"]
+    assert b0["attn"]["q"]["kernel"] == P(None, "model")
+    assert b0["attn"]["o"]["kernel"] == P("model", None)
+    assert b0["Dense_0"]["kernel"] == P(None, "model")
+    assert b0["Dense_0"]["bias"] == P("model")
+    assert b0["Dense_1"]["kernel"] == P("model", None)
+    assert b0["Dense_1"]["bias"] == P()
+    assert specs["lm_head"]["kernel"] == P(None, "model")
+    assert specs["tok_embed"]["embedding"] == P()
+
+
+def test_tp_state_is_actually_sharded():
+    mesh = dp_tp_mesh()
+    model = tiny_model()
+    state = create_tp_train_state(
+        model, jax.random.key(0), optax.sgd(0.1, momentum=0.9), mesh
+    )
+    qk = state.params["block_0"]["attn"]["q"]["kernel"]
+    assert qk.sharding.spec == P(None, "model")
+    # optimizer state (momentum trace) inherits the param sharding by
+    # propagation — created sharded, never materialized replicated
+    trace = state.opt_state[0].trace["block_0"]["attn"]["q"]["kernel"]
+    assert trace.sharding.spec == P(None, "model")
+
+
+def test_tp_training_matches_unsharded_exactly():
+    model = tiny_model()
+    mesh = dp_tp_mesh()
+    tx = optax.sgd(0.1)
+    tokens, targets = make_batch()
+
+    # unsharded single-device reference: the SAME step code, fed unsharded
+    # state and arrays (jit runs it on one device)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    ref_state = TrainState.create(params, tx)
+    ref_step = make_tp_train_step(model, tx, mesh)
+
+    tp_state = create_tp_train_state(model, jax.random.key(0), tx, mesh)
+    tp_step = make_tp_train_step(model, tx, mesh)
+    stok, stgt = shard_tp_batch(mesh, tokens, targets)
+
+    ref_losses, tp_losses = [], []
+    for _ in range(3):
+        ref_state, rl = ref_step(ref_state, jnp.asarray(tokens), jnp.asarray(targets))
+        tp_state, tl = tp_step(tp_state, stok, stgt)
+        ref_losses.append(float(rl))
+        tp_losses.append(float(tl))
+    np.testing.assert_allclose(tp_losses, ref_losses, rtol=2e-5)
+    # final params agree leaf-for-leaf (gather the sharded ones)
+    flat_ref = jax.tree.leaves(ref_state.params)
+    flat_tp = jax.tree.leaves(jax.device_get(tp_state.params))
+    for a, b in zip(flat_ref, flat_tp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-5, atol=1e-6)
+
+
+def test_tp_rejects_indivisible_dimensions():
+    mesh = dp_tp_mesh(dp=2, tp=4)
+    bad = TransformerLM(vocab_size=64, d_model=30, n_heads=3, n_layers=1, d_ff=60)
+    with pytest.raises(ValueError, match="not divisible"):
+        make_tp_train_step(bad, optax.sgd(0.1), mesh)
+
+
+def test_tp_composes_with_data_parallel_batch_split():
+    """Loss must be identical whichever dp×tp factorization the mesh uses."""
+    model = tiny_model()
+    tx = optax.sgd(0.1)
+    tokens, targets = make_batch(batch=8, seq=16)
+    losses = []
+    for dp, tp in ((2, 4), (4, 2), (8, 1)):
+        mesh = dp_tp_mesh(dp, tp)
+        state = create_tp_train_state(model, jax.random.key(0), tx, mesh)
+        step = make_tp_train_step(model, tx, mesh)
+        stok, stgt = shard_tp_batch(mesh, tokens, targets)
+        _, loss = step(state, stok, stgt)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses, losses[0] * np.ones(len(losses)), rtol=2e-5)
