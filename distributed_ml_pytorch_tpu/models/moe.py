@@ -1,0 +1,167 @@
+"""Mixture-of-Experts layers for the Transformer LM (Switch-style top-1).
+
+The reference has no MoE (SURVEY.md §2.4 marks EP ABSENT) — this is a
+capability extension, expressed the TPU way (GShard/Switch): routing is a
+pair of dense one-hot einsums (dispatch and combine) over stacked expert
+weights, so there is **no data-dependent control flow** — the whole layer is
+three einsums XLA can partition. Sharding the stacked expert axis over an
+``expert`` mesh mesh axis turns those einsums into all-to-all dispatch
+/combine automatically (``parallel/expert_parallel.py``); unsharded, the same
+code is a dense reference implementation.
+
+Key shapes (B batch, S seq, D d_model, F d_ff, E experts, C capacity):
+
+- router probs  ``[B, S, E]`` → top-1 expert per token
+- dispatch      ``[B, S, E, C]`` one-hot (token → its slot in its expert)
+- expert in     ``[E, B, C, D]`` = einsum(dispatch, x)
+- expert FFN    ``[E, B, C, D]`` via stacked ``w_up [E, D, F]``, ``w_down [E, F, D]``
+- combine       ``[B, S, D]`` = einsum(dispatch * router_prob, expert_out)
+
+Tokens beyond an expert's capacity are *dropped* (pass through the residual
+unchanged) — Switch semantics; the load-balance auxiliary loss pushes the
+router toward uniform load so drops stay rare.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from distributed_ml_pytorch_tpu.models.transformer import MultiHeadAttention
+
+
+def switch_route(
+    router_probs: jnp.ndarray, capacity: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-1 routing with per-expert capacity, no data-dependent shapes.
+
+    Returns ``(dispatch [B,S,E,C], combine [B,S,E,C])``; ``combine`` carries
+    the router probability so the gradient reaches the router (straight-
+    through on the argmax, exactly Switch).
+    """
+    b, s, e = router_probs.shape
+    expert_idx = jnp.argmax(router_probs, axis=-1)                 # [B,S]
+    expert_onehot = jax.nn.one_hot(expert_idx, e, dtype=router_probs.dtype)
+    # position of each token within its expert's queue (exclusive cumsum
+    # over the sequence), computed densely per expert
+    pos_in_expert = jnp.cumsum(expert_onehot, axis=1) - expert_onehot  # [B,S,E]
+    kept = (pos_in_expert < capacity) * expert_onehot               # [B,S,E]
+    slot = jax.nn.one_hot(
+        jnp.sum(pos_in_expert * expert_onehot, axis=-1).astype(jnp.int32), capacity,
+        dtype=router_probs.dtype,
+    )                                                               # [B,S,C]
+    dispatch = kept[..., None] * slot[:, :, None, :]                # [B,S,E,C]
+    gate = jnp.sum(router_probs * kept, axis=-1)                    # [B,S]
+    combine = dispatch * gate[:, :, None, None]
+    return dispatch, combine
+
+
+def load_balance_loss(router_probs: jnp.ndarray) -> jnp.ndarray:
+    """Switch aux loss (eq. 4): E · Σ_e (fraction argmax-routed to e) · (mean prob of e).
+
+    ``f_e`` uses the **pre-capacity** argmax assignment, not the truncated
+    dispatch mask — under router collapse the hot expert's fraction must
+    approach 1.0 (not saturate at capacity/seq) so the corrective gradient
+    stays strong exactly when balancing matters most.
+    """
+    e = router_probs.shape[-1]
+    expert_onehot = jax.nn.one_hot(
+        jnp.argmax(router_probs, axis=-1), e, dtype=router_probs.dtype
+    )
+    frac_tokens = jnp.mean(expert_onehot, axis=(0, 1))               # [E]
+    frac_probs = jnp.mean(router_probs, axis=(0, 1))                 # [E]
+    return e * jnp.sum(frac_tokens * frac_probs)
+
+
+class MoEMLP(nn.Module):
+    """Switch FFN: top-1 router over ``n_experts`` stacked expert MLPs.
+
+    The stacked leading expert axis of ``w_up``/``b_up``/``w_down``/``b_down``
+    is the one ``parallel/expert_parallel.ep_param_specs`` shards over the
+    ``expert`` mesh axis. The aux load-balance loss is ``sow``n under the
+    ``"losses"`` collection (reduced by the train step).
+    """
+
+    d_model: int
+    d_ff: int
+    n_experts: int = 4
+    capacity_factor: float = 2.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        e = self.n_experts
+        capacity = max(1, int(self.capacity_factor * s / e))
+        router = nn.Dense(e, use_bias=False, dtype=self.dtype, name="router")
+        probs = jax.nn.softmax(router(x).astype(jnp.float32), axis=-1).astype(x.dtype)
+        dispatch, combine = switch_route(probs, capacity)
+        self.sow("losses", "load_balance", load_balance_loss(probs))
+
+        w_up = self.param(
+            "w_up", nn.initializers.lecun_normal(batch_axis=(0,)), (e, d, self.d_ff)
+        )
+        b_up = self.param("b_up", nn.initializers.zeros, (e, self.d_ff))
+        w_down = self.param(
+            "w_down", nn.initializers.lecun_normal(batch_axis=(0,)), (e, self.d_ff, d)
+        )
+        b_down = self.param("b_down", nn.initializers.zeros, (e, d))
+
+        xin = jnp.einsum("bsec,bsd->ebcd", dispatch, x)              # dispatch
+        h = jnp.einsum("ebcd,edf->ebcf", xin, w_up) + b_up[:, None, None, :]
+        h = nn.gelu(h)
+        out = jnp.einsum("ebcf,efd->ebcd", h, w_down) + b_down[:, None, None, :]
+        return jnp.einsum("bsec,ebcd->bsd", combine, out)            # combine
+
+
+class MoEBlock(nn.Module):
+    """Pre-LN Transformer block with a Switch-MoE FFN."""
+
+    d_model: int
+    n_heads: int
+    d_ff: int
+    n_experts: int = 4
+    capacity_factor: float = 2.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + MultiHeadAttention(self.d_model, self.n_heads, self.dtype, name="attn")(h)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + MoEMLP(
+            self.d_model, self.d_ff, self.n_experts, self.capacity_factor,
+            self.dtype, name="moe",
+        )(h)
+        return x
+
+
+class MoETransformerLM(nn.Module):
+    """Causal LM whose FFNs are Switch-MoE layers (every block)."""
+
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    n_experts: int = 4
+    capacity_factor: float = 2.0
+    max_len: int = 131072
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        if positions is None:
+            positions = jnp.arange(tokens.shape[-1])[None, :]
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="tok_embed")(tokens)
+        x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype, name="pos_embed")(positions)
+        for i in range(self.n_layers):
+            x = MoEBlock(
+                self.d_model, self.n_heads, self.d_ff, self.n_experts,
+                self.capacity_factor, self.dtype, name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head")(x)

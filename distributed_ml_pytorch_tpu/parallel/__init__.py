@@ -20,8 +20,28 @@ from distributed_ml_pytorch_tpu.parallel.tensor_parallel import (
     shard_tp_batch,
     tp_param_specs,
 )
+from distributed_ml_pytorch_tpu.parallel.pipeline import (
+    PipelineLMConfig,
+    create_pp_train_state,
+    make_pp_train_step,
+    microbatch,
+)
+from distributed_ml_pytorch_tpu.parallel.expert_parallel import (
+    create_ep_train_state,
+    ep_param_specs,
+    make_ep_train_step,
+    shard_ep_batch,
+)
 
 __all__ = [
+    "PipelineLMConfig",
+    "create_pp_train_state",
+    "make_pp_train_step",
+    "microbatch",
+    "create_ep_train_state",
+    "ep_param_specs",
+    "make_ep_train_step",
+    "shard_ep_batch",
     "create_tp_train_state",
     "make_tp_train_step",
     "shard_tp_batch",

@@ -1,0 +1,123 @@
+"""Expert-parallel MoE training: dp×ep via pjit/GSPMD sharding annotations.
+
+The reference has no expert parallelism (SURVEY.md §2.4 marks EP ABSENT) —
+capability extension, TPU-native. ``models/moe.py`` expresses Switch routing
+as dense dispatch/combine einsums over expert weights stacked on a leading
+``E`` axis; sharding that axis over an ``expert`` mesh axis is *all* this
+module adds — XLA's partitioner turns the dispatch and combine einsums into
+the all-to-alls GShard implements by hand. Routers, attention, embeddings
+stay replicated; batches shard over ``data``.
+
+Same pjit idiom as ``parallel/tensor_parallel.py`` (annotate + propagate);
+the MoE-specific piece is the aux load-balance loss collected from the
+``"losses"`` sow collection and added to the CE objective.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_ml_pytorch_tpu.training.trainer import TrainState
+
+_EXPERT_PARAMS = ("w_up", "b_up", "w_down", "b_down")
+
+
+def ep_param_specs(tree, expert_axis: str = "expert"):
+    """Spec tree: stacked expert weights ``P(expert, ...)``, rest replicated.
+
+    Path-based (leaf names from ``models/moe.MoEMLP``), so it applies to any
+    tree embedding param paths — including a whole ``TrainState`` (optimizer
+    momentum mirrors the params), as in ``tensor_parallel.tp_param_specs``.
+    """
+
+    def spec_for(path, leaf):
+        names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
+        if names and names[-1] in _EXPERT_PARAMS:
+            return P(*((expert_axis,) + (None,) * (leaf.ndim - 1)))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def _check_experts(model, n_expert: int) -> None:
+    if model.n_experts % n_expert:
+        raise ValueError(
+            f"model.n_experts={model.n_experts} is not divisible by the ep "
+            f"axis size {n_expert} — each device must hold whole experts"
+        )
+
+
+def create_ep_train_state(
+    model,
+    rng: jax.Array,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    expert_axis: str = "expert",
+    sample_len: int = 8,
+) -> TrainState:
+    """Init a ``TrainState`` with expert weights sharded over ``expert_axis``
+    (created already sharded via whole-state ``out_shardings``)."""
+    _check_experts(model, int(mesh.shape[expert_axis]))
+    dummy = jnp.zeros((1, sample_len), jnp.int32)
+
+    def init_fn(rng):
+        params = model.init(rng, dummy)["params"]
+        return TrainState.create(params, tx)
+
+    state_shapes = jax.eval_shape(init_fn, rng)
+    specs = ep_param_specs(state_shapes, expert_axis)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+def make_ep_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    expert_axis: str = "expert",
+    aux_loss_weight: float = 0.01,
+) -> Callable:
+    """Build the jitted dp×ep MoE step: ``(state, tokens, targets) → (state, metrics)``.
+
+    ``metrics`` is ``(loss, aux)`` — next-token CE (masking the final
+    position, ``seq_parallel.next_token_targets`` convention) plus the
+    weighted Switch load-balance loss summed over MoE layers.
+    """
+    _check_experts(model, int(mesh.shape[expert_axis]))
+
+    def step(state: TrainState, tokens, targets):
+        def loss_fn(params):
+            logits, sown = model.apply(
+                {"params": params}, tokens, mutable=["losses"]
+            )
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+            mask = jnp.ones_like(ce).at[:, -1].set(0.0)
+            ce_loss = jnp.sum(ce * mask) / jnp.sum(mask)
+            aux = sum(jnp.sum(v) for v in jax.tree.leaves(sown["losses"]))
+            return ce_loss + aux_loss_weight * aux, (ce_loss, aux)
+
+        (_, (loss, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            params=params, opt_state=opt_state, step=state.step + 1
+        )
+        return new_state, (loss, aux)
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+# same placement as the tp path: batch-sharded over data, rest replicated —
+# one implementation, two mesh flavors
+from distributed_ml_pytorch_tpu.parallel.tensor_parallel import (  # noqa: E402
+    shard_tp_batch as shard_ep_batch,
+)

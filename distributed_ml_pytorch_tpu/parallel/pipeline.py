@@ -1,0 +1,256 @@
+"""Pipeline-parallel LM training: GPipe microbatch schedule over a ``stage``
+mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.4 marks PP ABSENT) —
+this is a capability extension, built the TPU-native way: the whole schedule
+is one jitted ``shard_map`` program, differentiated end-to-end.
+
+Design:
+
+- The Transformer body is a **stack of identical blocks** whose parameters
+  are stacked on a leading layer axis and sharded ``P(stage)`` — each of the
+  ``S`` stages holds ``L/S`` contiguous layers in HBM. Embedding, final
+  LayerNorm, and the LM head are replicated (small next to the blocks) but
+  *applied* only where they belong: embed on stage 0, head + loss on the
+  last stage.
+- The GPipe schedule is a ``lax.scan`` over ``M + S - 1`` ticks. At tick
+  ``t`` stage ``s`` holds microbatch ``t - s`` (when valid): it runs its
+  local layers and ``ppermute``s the activation to stage ``s + 1``. Bubbles
+  are masked, not branched — every stage executes the same program every
+  tick (SPMD), selecting between "freshly embedded microbatch" (stage 0)
+  and "activation received from the left neighbor".
+- Losses accumulate on the last stage over its valid ticks and are ``psum``
+  -broadcast; gradients come from differentiating straight through the
+  scan + ppermute schedule (the transpose of ``ppermute`` is the reversed
+  permutation, so backward activations flow right→left automatically — no
+  hand-written backward schedule). Replicated params (embed/head) get their
+  cross-stage gradient psum from ``shard_map``'s transpose of the broadcast.
+
+Composes with data parallelism by adding a ``data`` mesh axis: microbatches
+are additionally split over it and the loss psum covers both axes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_ml_pytorch_tpu.models.transformer import Block
+from distributed_ml_pytorch_tpu.training.trainer import TrainState
+
+
+class PipelineLMConfig:
+    """Static config for the pipelined decoder LM (a plain data holder so the
+    schedule code stays framework-free)."""
+
+    def __init__(
+        self,
+        vocab_size: int = 64,
+        d_model: int = 32,
+        n_heads: int = 4,
+        n_layers: int = 4,
+        d_ff: int = 64,
+        max_len: int = 1024,
+    ):
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.n_heads = n_heads
+        self.n_layers = n_layers
+        self.d_ff = d_ff
+        self.max_len = max_len
+
+    def block(self) -> Block:
+        return Block(self.d_model, self.n_heads, self.d_ff)
+
+
+def init_pp_params(cfg: PipelineLMConfig, rng: jax.Array, sample_len: int = 8):
+    """Init the pipelined param tree.
+
+    ``blocks`` is the per-layer param tree *stacked on a leading layer axis*
+    (vmapped init over per-layer rngs) — the axis that shards over ``stage``.
+    """
+    from flax import linen as nn
+
+    block = cfg.block()
+    x = jnp.zeros((1, sample_len, cfg.d_model))
+    layer_rngs = jax.random.split(jax.random.fold_in(rng, 0), cfg.n_layers)
+    blocks = jax.vmap(lambda r: block.init(r, x)["params"])(layer_rngs)
+
+    embed = nn.Embed(cfg.vocab_size, cfg.d_model)
+    pos_embed = nn.Embed(cfg.max_len, cfg.d_model)
+    head = nn.Dense(cfg.vocab_size, use_bias=False)
+    ln_f = nn.LayerNorm()
+    tokens = jnp.zeros((1, sample_len), jnp.int32)
+    return {
+        "blocks": blocks,
+        "tok_embed": embed.init(jax.random.fold_in(rng, 1), tokens)["params"],
+        "pos_embed": pos_embed.init(jax.random.fold_in(rng, 2), tokens)["params"],
+        "ln_f": ln_f.init(jax.random.fold_in(rng, 3), x)["params"],
+        "head": head.init(jax.random.fold_in(rng, 4), x)["params"],
+    }
+
+
+def pp_param_specs(tree, stage_axis: str = "stage"):
+    """Spec tree: any leaf under a ``"blocks"`` key is layer-stacked on its
+    leading axis → ``P(stage, ...)``; everything else replicated.
+
+    Path-based, so it applies to the param tree and to any tree embedding
+    param paths — a whole ``TrainState`` included (optimizer momentum mirrors
+    the params), same single-rule design as
+    ``tensor_parallel.tp_param_specs`` / ``expert_parallel.ep_param_specs``.
+    """
+
+    def spec_for(path, leaf):
+        names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
+        if "blocks" in names:
+            return P(*((stage_axis,) + (None,) * (leaf.ndim - 1)))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def create_pp_train_state(
+    cfg: PipelineLMConfig,
+    rng: jax.Array,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    stage_axis: str = "stage",
+) -> TrainState:
+    """Init a ``TrainState`` with block layers sharded over the stages."""
+    n_stages = int(mesh.shape[stage_axis])
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} must divide evenly over {n_stages} stages"
+        )
+
+    def init_fn(rng):
+        return TrainState.create(init_pp_params(cfg, rng), tx)
+
+    state_shapes = jax.eval_shape(init_fn, rng)
+    specs = pp_param_specs(state_shapes, stage_axis)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+def _stage_forward(cfg: PipelineLMConfig, block_params, h):
+    """Run this stage's local layers (scan over the local stacked params)."""
+    block = cfg.block()
+
+    def body(h, layer_params):
+        return block.apply({"params": layer_params}, h), None
+
+    h, _ = jax.lax.scan(body, h, block_params)
+    return h
+
+
+def make_pp_train_step(
+    cfg: PipelineLMConfig,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    n_microbatches: int,
+    stage_axis: str = "stage",
+) -> Callable:
+    """Build the jitted PP LM step: ``(state, tokens_mb, targets_mb) → (state, loss)``.
+
+    ``tokens_mb``/``targets_mb`` are ``(M, mb, seq)`` int arrays (microbatched
+    on the leading axis, replicated across stages). The loss is the global
+    next-token CE over all M microbatches, masking the final position of each
+    sequence (``seq_parallel.next_token_targets`` convention).
+    """
+    n_stages = int(mesh.shape[stage_axis])
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} must divide evenly over {n_stages} stages"
+        )
+    M = int(n_microbatches)
+    from flax import linen as nn
+
+    embed = nn.Embed(cfg.vocab_size, cfg.d_model)
+    pos_embed = nn.Embed(cfg.max_len, cfg.d_model)
+    head = nn.Dense(cfg.vocab_size, use_bias=False)
+    ln_f = nn.LayerNorm()
+    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def pipeline_loss(params, tokens_mb, targets_mb):
+        s = jax.lax.axis_index(stage_axis)
+        mb, seq = tokens_mb.shape[1], tokens_mb.shape[2]
+        positions = jnp.arange(seq)[None, :]
+
+        def embed_mb(m):
+            m = jnp.clip(m, 0, M - 1)
+            toks = jax.lax.dynamic_index_in_dim(tokens_mb, m, axis=0, keepdims=False)
+            x = embed.apply({"params": params["tok_embed"]}, toks)
+            return x + pos_embed.apply({"params": params["pos_embed"]}, positions)
+
+        def tick(carry, t):
+            h_in, loss_sum, count = carry
+            # stage 0 injects microbatch t; others use the received activation
+            h = jnp.where(s == 0, embed_mb(t), h_in)
+            m_here = t - s  # microbatch this stage holds at tick t
+            valid = (m_here >= 0) & (m_here < M)
+            h_out = _stage_forward(cfg, params["blocks"], h)
+            h_out = jnp.where(valid, h_out, h)  # bubbles pass through untouched
+            # last stage: head + loss for its microbatch (masked elsewhere)
+            logits = head.apply(
+                {"params": params["head"]},
+                ln_f.apply({"params": params["ln_f"]}, h_out),
+            )
+            tgt = jax.lax.dynamic_index_in_dim(
+                targets_mb, jnp.clip(m_here, 0, M - 1), axis=0, keepdims=False
+            )
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
+            mask = jnp.ones_like(ce).at[:, -1].set(0.0)
+            take = valid & (s == n_stages - 1)
+            loss_sum = loss_sum + jnp.where(take, jnp.sum(ce * mask), 0.0)
+            count = count + jnp.where(take, jnp.sum(mask), 0.0)
+            # hand the activation to the right neighbor for the next tick
+            h_next = jax.lax.ppermute(h_out, stage_axis, fwd_perm)
+            return (h_next, loss_sum, count), None
+
+        # the carry varies per stage (each holds a different activation), so
+        # the initial zeros must be cast to stage-varying for scan's
+        # carry-type invariance under shard_map
+        carry0 = jax.lax.pcast(
+            (jnp.zeros((mb, seq, cfg.d_model)), jnp.zeros(()), jnp.zeros(())),
+            stage_axis,
+            to="varying",
+        )
+        (_, loss_sum, count), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(M + n_stages - 1)
+        )
+        # broadcast the last stage's totals to every stage
+        loss_sum = jax.lax.psum(loss_sum, stage_axis)
+        count = jax.lax.psum(count, stage_axis)
+        return loss_sum / count
+
+    def step(state: TrainState, tokens_mb, targets_mb):
+        param_specs = pp_param_specs(state.params, stage_axis)
+        grad_fn = jax.value_and_grad(pipeline_loss)
+        loss, grads = jax.shard_map(
+            grad_fn,
+            mesh=mesh,
+            in_specs=(param_specs, P(), P()),
+            out_specs=(P(), param_specs),
+        )(state.params, tokens_mb, targets_mb)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(params=params, opt_state=opt_state, step=state.step + 1), loss
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def microbatch(tokens, targets, n_microbatches: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side: split a (batch, seq) pair into (M, batch/M, seq)."""
+    b = tokens.shape[0]
+    if b % n_microbatches:
+        raise ValueError(f"batch {b} must divide into {n_microbatches} microbatches")
+    shape = (n_microbatches, b // n_microbatches) + tuple(tokens.shape[1:])
+    return tokens.reshape(shape), targets.reshape(shape)

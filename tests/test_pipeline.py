@@ -1,0 +1,85 @@
+"""GPipe pipeline parallelism: the S-stage microbatch schedule must be
+numerically identical to the single-stage (plain sequential) forward."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_ml_pytorch_tpu.parallel.pipeline import (
+    PipelineLMConfig,
+    create_pp_train_state,
+    make_pp_train_step,
+    microbatch,
+)
+from distributed_ml_pytorch_tpu.parallel.seq_parallel import next_token_targets
+
+
+def cfg4():
+    return PipelineLMConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64, max_len=128
+    )
+
+
+def stage_mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("stage",))
+
+
+def make_batch(batch=8, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, 64, size=(batch, seq)).astype(np.int32)
+    return tokens, next_token_targets(tokens)
+
+
+def run_steps(n_stages, n_micro, n_steps=2):
+    cfg = cfg4()
+    mesh = stage_mesh(n_stages)
+    tx = optax.sgd(0.1)
+    state = create_pp_train_state(cfg, jax.random.key(0), tx, mesh)
+    step = make_pp_train_step(cfg, tx, mesh, n_microbatches=n_micro)
+    tokens, targets = make_batch()
+    tok_mb, tgt_mb = microbatch(tokens, targets, n_micro)
+    losses = []
+    for _ in range(n_steps):
+        state, loss = step(state, tok_mb, tgt_mb)
+        losses.append(float(loss))
+    return losses, jax.device_get(state.params)
+
+
+def test_pipeline_matches_single_stage():
+    ref_losses, ref_params = run_steps(n_stages=1, n_micro=1)
+    pp_losses, pp_params = run_steps(n_stages=4, n_micro=4)
+    np.testing.assert_allclose(pp_losses, ref_losses, rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(ref_params), jax.tree.leaves(pp_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-5, atol=1e-6)
+
+
+def test_pipeline_microbatch_count_does_not_change_loss():
+    l1, _ = run_steps(n_stages=2, n_micro=2, n_steps=1)
+    l2, _ = run_steps(n_stages=2, n_micro=8, n_steps=1)
+    np.testing.assert_allclose(l1, l2, rtol=2e-5)
+
+
+def test_pp_state_blocks_sharded_over_stages():
+    cfg = cfg4()
+    mesh = stage_mesh(4)
+    state = create_pp_train_state(cfg, jax.random.key(0), optax.sgd(0.1, momentum=0.9), mesh)
+    leaf = jax.tree.leaves(state.params["blocks"])[0]
+    assert leaf.sharding.spec[0] == "stage"
+    mom = jax.tree.leaves(state.opt_state[0].trace["blocks"])[0]
+    assert mom.sharding.spec[0] == "stage"
+    # replicated pieces stay replicated
+    assert state.params["head"]["kernel"].sharding.spec == P()
+
+
+def test_pp_rejects_indivisible_layers():
+    cfg = PipelineLMConfig(n_layers=3)
+    with pytest.raises(ValueError, match="divide evenly"):
+        create_pp_train_state(cfg, jax.random.key(0), optax.sgd(0.1), stage_mesh(2))
+
+
+def test_microbatch_rejects_indivisible_batch():
+    tokens, targets = make_batch(batch=6)
+    with pytest.raises(ValueError, match="microbatches"):
+        microbatch(tokens, targets, 4)
