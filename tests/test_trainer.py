@@ -96,3 +96,31 @@ def test_training_improves_accuracy():
     eval_step = make_eval_fn(model)
     _, acc = evaluate(eval_step, state.params, x_test, y_test, 128)
     assert acc > 0.5, f"synthetic accuracy only {acc}"
+
+
+def test_scan_train_step_matches_singles():
+    """K scanned steps must equal K individually dispatched steps exactly
+    (same update body, same step-folded dropout stream)."""
+    from distributed_ml_pytorch_tpu.models import LeNet
+    from distributed_ml_pytorch_tpu.training.trainer import make_scan_train_step
+
+    k, batch = 4, 8
+    rng_np = np.random.default_rng(0)
+    images = rng_np.normal(size=(k, batch, 32, 32, 3)).astype(np.float32)
+    labels = (np.arange(k * batch) % 10).astype(np.int32).reshape(k, batch)
+    dropout_rng = jax.random.key(7)
+
+    model = LeNet(num_classes=10)  # has dropout: exercises the rng stream
+    state_a, tx = create_train_state(model, jax.random.key(0), lr=0.05)
+    single = make_train_step(model, tx)
+    for i in range(k):
+        state_a, loss_a = single(state_a, images[i], labels[i], dropout_rng)
+
+    state_b, tx = create_train_state(model, jax.random.key(0), lr=0.05)
+    scan = make_scan_train_step(model, tx)
+    state_b, losses = scan(state_b, images, labels, dropout_rng)
+
+    assert int(state_b.step) == k
+    np.testing.assert_allclose(float(losses[-1]), float(loss_a), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
