@@ -103,6 +103,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="global step at which the trace window opens")
     p.add_argument("--profile-steps", type=int, default=10, metavar="N",
                    help="number of steps the trace window covers")
+    p.add_argument("--steps-per-dispatch", type=int, default=1, metavar="K",
+                   help="fuse up to K consecutive SGD steps into one compiled "
+                        "program (lax.scan) in the single-process trainer — "
+                        "amortizes host dispatch; per-step CSV logging and "
+                        "eval cadence are preserved")
     p.add_argument("--heartbeat-interval", type=float, default=1.0, metavar="SEC",
                    help="PS-mode worker liveness heartbeat cadence; 0 disables "
                         "(the reference has no failure detection, SURVEY.md §5.3)")

@@ -194,6 +194,7 @@ def run_training_loop(
     ckpt=None,
     start_epoch: int = 0,
     start_iter: int = 0,
+    scan_step: Optional[Callable] = None,
 ) -> TrainState:
     """Shared epoch/batch loop (reference ``example/main.py:57-93`` shape).
 
@@ -206,6 +207,15 @@ def run_training_loop(
     saves are async so the next step launches while bytes drain to disk.
     ``start_epoch``/``start_iter`` fast-forward a resumed run to the exact
     batch (the shuffle order is a pure function of ``(seed, epoch)``).
+
+    ``scan_step`` (``make_scan_train_step``-shaped) enables chunked dispatch:
+    with ``--steps-per-dispatch K > 1``, up to K consecutive batches are
+    stacked and trained in one compiled program. Chunks never cross a
+    ``log_interval`` or ``--ckpt-every`` boundary (evals see exactly the
+    params they would per-step; checkpoint steps land on exact multiples, as
+    orbax requires), and per-step losses still land in the CSV row-for-row
+    (the scan returns all K). Batches are uniform (``iterate_batches`` drops
+    the last partial batch), so stacking is always well-shaped.
     """
     x_train, y_train, x_test, y_test = data
     dropout_rng = jax.random.key(getattr(args, "seed", 0) + 1)
@@ -221,10 +231,63 @@ def run_training_loop(
     # one timer for the whole run: warmup-skip covers XLA compile, which
     # only happens on the first steps; per-epoch stats via reset_stats()
     timer = StepTimer(items_per_step=args.batch_size)
+    chunk_k = int(getattr(args, "steps_per_dispatch", 1) or 1)
+    use_scan = scan_step is not None and chunk_k > 1 and on_step is None
+
+    def run_one(state, i, bx, by):
+        """One per-step dispatch (the reference-shaped path)."""
+        nonlocal global_step
+        tracer.on_step(global_step)
+        if on_step is not None:
+            state = on_step(state, epoch, i)
+        timer.start()
+        with annotate_step("train", global_step):
+            state, loss = train_step(state, bx, by, dropout_rng)
+            loss_val = float(loss)  # blocks on the step's output
+        timer.tick()
+        if ckpt is not None:
+            ckpt.save(int(state.step), state)
+        global_step += 1
+        tracer.after_step(global_step)
+        return state, [(i, loss_val)]
+
+    def run_chunk(state, chunk):
+        """One scanned dispatch over len(chunk) stacked batches."""
+        nonlocal global_step
+        if len(chunk) == 1:
+            return run_one(state, *chunk[0])
+        tracer.on_step(global_step, n_steps=len(chunk))
+        bxs = np.stack([c[1] for c in chunk])
+        bys = np.stack([c[2] for c in chunk])
+        timer.start()
+        with annotate_step("train", global_step):
+            state, losses = scan_step(state, bxs, bys, dropout_rng)
+            losses = np.asarray(losses)  # blocks on the chunk's output
+        timer.tick_n(len(chunk))
+        if ckpt is not None:
+            ckpt.save(int(state.step), state)
+        global_step += len(chunk)
+        tracer.after_step(global_step)
+        return state, [(c[0], float(l)) for c, l in zip(chunk, losses)]
+
+    def emit(records):
+        """Per-step CSV rows + boundary evals (reference :83-89 telemetry)."""
+        for i, loss_val in records:
+            rec_extra = {}
+            if i % args.log_interval == 0 and i > 0:  # reference :83-84
+                test_loss, test_acc = evaluate(
+                    eval_step, state.params, x_test, y_test, args.test_batch_size
+                )
+                rec_extra = {"test_loss": test_loss, "test_accuracy": test_acc}
+            rec = logger.log_step(i, loss_val, **rec_extra)
+            if rec_extra:
+                print_eval_line(rec)
+
     try:
         for epoch in range(start_epoch, args.epochs):
             print("Training for epoch {}".format(epoch))
             skip = start_iter if epoch == start_epoch else 0
+            pending = []  # buffered (i, bx, by) awaiting a chunk flush
             for i, (bx, by) in enumerate(
                 iterate_batches(
                     x_train, y_train, args.batch_size,
@@ -232,27 +295,28 @@ def run_training_loop(
                 ),
                 start=skip,
             ):
-                tracer.on_step(global_step)
-                if on_step is not None:
-                    state = on_step(state, epoch, i)
-                timer.start()
-                with annotate_step("train", global_step):
-                    state, loss = train_step(state, bx, by, dropout_rng)
-                    loss_val = float(loss)  # blocks on the step's output
-                timer.tick()
-                if ckpt is not None:
-                    ckpt.save(int(state.step), state)
-                global_step += 1
-                tracer.after_step(global_step)
-                rec_extra = {}
-                if i % args.log_interval == 0 and i > 0:  # reference :83-84
-                    test_loss, test_acc = evaluate(
-                        eval_step, state.params, x_test, y_test, args.test_batch_size
-                    )
-                    rec_extra = {"test_loss": test_loss, "test_accuracy": test_acc}
-                rec = logger.log_step(i, loss_val, **rec_extra)
-                if rec_extra:
-                    print_eval_line(rec)
+                if not use_scan:
+                    state, records = run_one(state, i, bx, by)
+                    emit(records)
+                    continue
+                pending.append((i, bx, by))
+                # flush on a full chunk, at an eval boundary (so the eval sees
+                # exactly the params after step i, never later ones), or at a
+                # checkpoint boundary (orbax accepts saves only at exact
+                # multiples of --ckpt-every, so a boundary must be a chunk end)
+                at_eval = i % args.log_interval == 0 and i > 0
+                at_ckpt = (
+                    ckpt is not None
+                    and (global_step + len(pending)) % ckpt.save_interval_steps == 0
+                )
+                if len(pending) >= chunk_k or at_eval or at_ckpt:
+                    state, records = run_chunk(state, pending)
+                    pending = []
+                    emit(records)
+            if pending:
+                state, records = run_chunk(state, pending)
+                pending = []
+                emit(records)
             # a window straddling the epoch boundary is truncated here rather
             # than polluting the capture with the full-test-set eval below
             tracer.close()
@@ -324,6 +388,11 @@ def train_single(args) -> Tuple[TrainState, MetricsLogger]:
     )
     state, tx = create_train_state(model, jax.random.key(getattr(args, "seed", 0)), args.lr)
     train_step = make_train_step(model, tx)
+    scan_step = (
+        make_scan_train_step(model, tx)
+        if int(getattr(args, "steps_per_dispatch", 1) or 1) > 1
+        else None
+    )
     eval_step = make_eval_fn(model)
     logger = MetricsLogger(getattr(args, "log_dir", "log"))
 
@@ -344,6 +413,7 @@ def train_single(args) -> Tuple[TrainState, MetricsLogger]:
             ckpt=ckpt,
             start_epoch=start_epoch,
             start_iter=start_iter,
+            scan_step=scan_step,
         )
     finally:
         if ckpt is not None:

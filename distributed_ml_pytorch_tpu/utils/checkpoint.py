@@ -45,6 +45,7 @@ class Checkpointer:
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
+        self.save_interval_steps = int(save_interval_steps)
         path = os.path.abspath(directory)
         os.makedirs(path, exist_ok=True)
         self._mgr = ocp.CheckpointManager(

@@ -56,6 +56,24 @@ class StepTimer:
         if self._seen > self.skip:
             self._times.append(dt)
 
+    def tick_n(self, n: int) -> None:
+        """Record the elapsed interval as ``n`` equal steps (chunked dispatch:
+        one start/tick pair covers a whole scanned chunk of n steps).
+
+        A chunk containing any warmup step is dropped whole — its interval
+        includes XLA compile, and averaging compile over n "steps" would
+        pollute every recorded sample (per-step mode excludes it via skip).
+        """
+        if self._last is None or n < 1:
+            return
+        dt = (time.perf_counter() - self._last) / n
+        self._last = None
+        if self._seen < self.skip:
+            self._seen += n  # warmup chunk: count it, record nothing
+            return
+        self._seen += n
+        self._times.extend([dt] * n)
+
     def reset_stats(self) -> None:
         """Clear collected intervals but keep warmup state.
 
@@ -107,13 +125,16 @@ class TraceWindow:
         self._done = False
         self._first_step: Optional[int] = None
 
-    def on_step(self, step: int) -> None:
-        """Open the trace when ``step`` enters the window; call before dispatch."""
+    def on_step(self, step: int, n_steps: int = 1) -> None:
+        """Open the trace when the dispatch ``[step, step + n_steps)`` overlaps
+        the window; call before dispatch. ``n_steps > 1`` (chunked dispatch)
+        rounds the capture out to chunk granularity — a chunk that strides
+        over the window still gets traced."""
         if not self.profile_dir or self._done:
             return
         if self._first_step is None:
             self._first_step = step
-        if not self._active and self.start <= step < self.stop:
+        if not self._active and step < self.stop and step + n_steps > self.start:
             import jax
 
             jax.profiler.start_trace(self.profile_dir)

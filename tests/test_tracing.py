@@ -110,3 +110,29 @@ def test_trace_window_warns_when_never_reached(capsys):
     tw.warn_if_never_opened()
     err = capsys.readouterr().err
     assert "never reached" in err
+
+
+def test_trace_window_opens_when_chunk_strides_over_it(tmp_path):
+    profile_dir = str(tmp_path / "trace4")
+    tw = TraceWindow(profile_dir, start=10, n_steps=10)
+    x = jnp.ones((8, 8))
+    f = jax.jit(lambda a: a @ a)
+    tw.on_step(0, n_steps=32)  # chunk [0, 32) strides over [10, 20)
+    assert tw._active
+    f(x).block_until_ready()
+    tw.after_step(32)
+    assert tw._done
+
+
+def test_step_timer_tick_n_drops_warmup_chunks():
+    t = StepTimer(skip=2)
+    t.start()
+    time.sleep(0.05)  # "compile" chunk: includes warmup steps → dropped whole
+    t.tick_n(8)
+    assert t.summary() is None
+    t.start()
+    time.sleep(0.008)
+    t.tick_n(4)  # steady chunk: all 4 recorded at dt/4 each
+    s = t.summary()
+    assert s["steps"] == 4
+    assert s["mean_ms"] < 10.0, "compile time leaked into steady-state stats"

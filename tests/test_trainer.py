@@ -124,3 +124,62 @@ def test_scan_train_step_matches_singles():
     np.testing.assert_allclose(float(losses[-1]), float(loss_a), rtol=1e-6)
     for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
+
+
+def test_chunked_dispatch_matches_per_step_training(tmp_path):
+    """--steps-per-dispatch K must produce the identical CSV records and
+    final params as per-step dispatch (chunks aligned to eval boundaries)."""
+    import copy
+
+    class A(Args):
+        epochs = 1
+        batch_size = 32
+        log_interval = 4
+        synthetic_train_size = 32 * 11  # 11 steps: exercises chunk remainders
+        synthetic_test_size = 64
+        log_dir = None
+
+    a1, a2 = copy.deepcopy(A()), copy.deepcopy(A())
+    a1.log_dir = str(tmp_path / "a")
+    a2.log_dir = str(tmp_path / "b")
+    a2.steps_per_dispatch = 3  # does not divide log_interval: remainders happen
+
+    state1, logger1 = train_single(a1)
+    state2, logger2 = train_single(a2)
+
+    assert int(state1.step) == int(state2.step) == 11
+    r1, r2 = logger1.records, logger2.records
+    assert len(r1) == len(r2)
+    for rec1, rec2 in zip(r1, r2):
+        assert rec1["iteration"] == rec2["iteration"]
+        np.testing.assert_allclose(rec1["training_loss"], rec2["training_loss"], rtol=1e-6)
+        assert ("test_loss" in rec1) == ("test_loss" in rec2)
+    for p1, p2 in zip(jax.tree.leaves(state1.params), jax.tree.leaves(state2.params)):
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-6, atol=1e-7)
+
+
+def test_chunked_dispatch_still_checkpoints_on_exact_boundaries(tmp_path):
+    """Chunks must flush at --ckpt-every boundaries: orbax only accepts saves
+    at exact interval multiples, so K-step chunk ends that skip over the
+    boundary would otherwise silently disable checkpointing."""
+    import copy
+
+    class A(Args):
+        epochs = 1
+        batch_size = 32
+        log_interval = 100  # no eval boundaries in range
+        synthetic_train_size = 32 * 10  # 10 steps
+        synthetic_test_size = 64
+
+    a = copy.deepcopy(A())
+    a.log_dir = str(tmp_path / "log")
+    a.ckpt_dir = str(tmp_path / "ckpt")
+    a.ckpt_every = 4   # K=3 chunk ends (3, 6, 9...) never hit 4 or 8 unaided
+    a.ckpt_keep = 5
+    a.steps_per_dispatch = 3
+
+    train_single(a)
+    import os
+
+    saved = {int(d) for d in os.listdir(a.ckpt_dir) if d.isdigit()}
+    assert {4, 8} <= saved, f"interval saves missing: {sorted(saved)}"
