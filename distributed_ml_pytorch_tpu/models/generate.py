@@ -1,0 +1,119 @@
+"""Autoregressive decoding for the Transformer LM family.
+
+The reference is a CNN classifier framework with no text generation at all
+(SURVEY.md §2, image models only) — this is a capability extension that
+completes the LM story: train with ``parallel/seq_parallel.py`` (or tp/pp),
+then sample from the trained params here.
+
+TPU-native decode structure:
+
+- **Prefill** runs the whole prompt through the model in ONE call, writing
+  every layer's K/V into the cache (``models/transformer.MultiHeadAttention``
+  with ``decode=True``) — the MXU-friendly bulk phase.
+- **Generation** is a ``lax.scan`` over single-token steps: one compiled
+  program for the entire sampled continuation, cache threaded as carry — no
+  per-token Python dispatch, no growing shapes (the cache is statically
+  sized to ``prompt + max_new_tokens``).
+- Sampling is temperature-controlled categorical (temperature 0 → greedy
+  argmax), per-step rng folded from one key, fully deterministic given
+  ``(params, prompt, rng)``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _decode_model(model, cache_size: int):
+    return model.clone(decode=True, cache_size=cache_size, attn_fn=None)
+
+
+def init_cache(model, batch: int, cache_size: int):
+    """Allocate the per-layer K/V cache (zeros, cursor at 0) for ``batch``
+    sequences of total length ``cache_size``."""
+    dec = _decode_model(model, cache_size)
+    variables = jax.eval_shape(
+        lambda: dec.init(
+            jax.random.key(0),
+            jnp.zeros((batch, 1), jnp.int32),
+            jnp.zeros((batch, 1), jnp.int32),
+        )
+    )
+    return jax.tree.map(jnp.zeros_like, variables["cache"])
+
+
+def generate(
+    model,
+    params,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """Sample ``max_new_tokens`` continuations of ``prompt`` ([B, P] int32).
+
+    Returns ``[B, P + max_new_tokens]`` tokens. ``temperature=0`` is greedy;
+    otherwise categorical sampling at the given temperature (``rng``
+    required). Jit-compiled end-to-end: one prefill program + one scanned
+    generation program, both cached across calls with the same shapes.
+    """
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 sampling needs an rng key")
+    rng = rng if rng is not None else jax.random.key(0)
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    max_len = getattr(model, "max_len", None)
+    if max_len is not None and total > max_len:
+        raise ValueError(
+            f"prompt + max_new_tokens = {total} exceeds the model's max_len "
+            f"{max_len} — position embeddings would go out of range"
+        )
+    if max_new_tokens < 1:
+        return prompt
+    cache = init_cache(model, b, total)
+    dec = _decode_model(model, total)
+    return _generate_jit(
+        dec, int(max_new_tokens), float(temperature), params, cache, prompt, rng
+    )
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def _generate_jit(dec, max_new_tokens, temperature, params, cache, prompt, rng):
+    b, p = prompt.shape
+
+    # prefill: whole prompt in one pass; next token comes from the last logit
+    positions = jnp.arange(p)[None, :]
+    logits, mutated = dec.apply(
+        {"params": params, "cache": cache}, prompt, positions, mutable=["cache"]
+    )
+    cache = mutated["cache"]
+
+    def sample(logits, step_rng):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(step_rng, logits / temperature, axis=-1).astype(
+            prompt.dtype
+        )
+
+    first = sample(logits[:, -1], jax.random.fold_in(rng, 0))
+
+    def step(carry, t):
+        cache, tok = carry
+        pos = jnp.full((b, 1), p, jnp.int32) + t
+        logits, mutated = dec.apply(
+            {"params": params, "cache": cache}, tok[:, None], pos, mutable=["cache"]
+        )
+        nxt = sample(logits[:, -1], jax.random.fold_in(rng, t + 1))
+        return (mutated["cache"], nxt), tok
+
+    (_, last), toks = jax.lax.scan(
+        step, (cache, first), jnp.arange(max_new_tokens - 1)
+    )
+    generated = jnp.concatenate(
+        [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1
+    )  # [B, max_new_tokens]
+    return jnp.concatenate([prompt, generated], axis=1)

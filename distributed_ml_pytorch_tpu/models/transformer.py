@@ -39,10 +39,17 @@ def default_attn_fn(q, k, v):
 
 
 class MultiHeadAttention(nn.Module):
+    """Causal MHA; with ``decode=True`` it maintains a K/V cache (flax
+    ``"cache"`` collection) for incremental autoregressive decoding: each call
+    appends the new keys/values at the cache cursor and attends the (short)
+    query block over everything written so far."""
+
     d_model: int
     n_heads: int
     dtype: jnp.dtype = jnp.float32
     attn_fn: Optional[Callable] = None
+    decode: bool = False
+    cache_size: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -51,10 +58,42 @@ class MultiHeadAttention(nn.Module):
         proj = lambda name: nn.Dense(self.d_model, use_bias=False, dtype=self.dtype, name=name)
         split = lambda t: t.reshape(b, s, self.n_heads, head_dim).transpose(0, 2, 1, 3)
         q, k, v = (split(proj(n)(x)) for n in ("q", "k", "v"))
-        attn = self.attn_fn or default_attn_fn
-        out = attn(q, k, v)  # (b, h, s, hd)
+        if self.decode:
+            if self.attn_fn is not None:
+                raise ValueError(
+                    "decode=True uses cached dense attention and cannot honor "
+                    "an injected attn_fn — clone the model with attn_fn=None "
+                    "for decoding (models/generate.py does this)"
+                )
+            out = self._cached_attention(q, k, v, b, s, head_dim)
+        else:
+            attn = self.attn_fn or default_attn_fn
+            out = attn(q, k, v)  # (b, h, s, hd)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, self.d_model)
         return nn.Dense(self.d_model, use_bias=False, dtype=self.dtype, name="o")(out)
+
+    def _cached_attention(self, q, k, v, b, s, head_dim):
+        if self.cache_size < 1:
+            raise ValueError("decode=True needs cache_size > 0")
+        # cache lives in the model's activation dtype (half the HBM under
+        # bf16); scores/softmax compute in f32 for stability
+        shape = (b, self.n_heads, self.cache_size, head_dim)
+        cache_k = self.variable("cache", "cached_k", jnp.zeros, shape, self.dtype)
+        cache_v = self.variable("cache", "cached_v", jnp.zeros, shape, self.dtype)
+        cursor = self.variable("cache", "cursor", lambda: jnp.zeros((), jnp.int32))
+        idx = cursor.value
+        ck = jax.lax.dynamic_update_slice(cache_k.value, k.astype(self.dtype), (0, 0, idx, 0))
+        cv = jax.lax.dynamic_update_slice(cache_v.value, v.astype(self.dtype), (0, 0, idx, 0))
+        cache_k.value, cache_v.value, cursor.value = ck, cv, idx + s
+        scores = jnp.einsum("bhsd,bhcd->bhsc", q.astype(jnp.float32), ck.astype(jnp.float32))
+        scores = scores / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+        # causal over absolute positions: query i (at idx+i) sees keys ≤ idx+i
+        key_pos = jnp.arange(self.cache_size)
+        q_pos = idx + jnp.arange(s)
+        mask = key_pos[None, :] <= q_pos[:, None]  # (s, cache)
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhsc,bhcd->bhsd", probs, cv).astype(q.dtype)
 
 
 class Block(nn.Module):
@@ -63,12 +102,15 @@ class Block(nn.Module):
     d_ff: int
     dtype: jnp.dtype = jnp.float32
     attn_fn: Optional[Callable] = None
+    decode: bool = False
+    cache_size: int = 0
 
     @nn.compact
     def __call__(self, x):
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + MultiHeadAttention(
-            self.d_model, self.n_heads, self.dtype, self.attn_fn, name="attn"
+            self.d_model, self.n_heads, self.dtype, self.attn_fn,
+            decode=self.decode, cache_size=self.cache_size, name="attn",
         )(h)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.d_ff, dtype=self.dtype)(h)
@@ -89,6 +131,8 @@ class TransformerLM(nn.Module):
     max_len: int = 131072
     dtype: jnp.dtype = jnp.float32
     attn_fn: Optional[Callable] = None
+    decode: bool = False
+    cache_size: int = 0
 
     @nn.compact
     def __call__(self, tokens, positions=None):
@@ -99,6 +143,7 @@ class TransformerLM(nn.Module):
         for i in range(self.n_layers):
             x = Block(
                 self.d_model, self.n_heads, self.d_ff, self.dtype, self.attn_fn,
+                decode=self.decode, cache_size=self.cache_size,
                 name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
