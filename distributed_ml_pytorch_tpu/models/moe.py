@@ -151,6 +151,7 @@ class MoETransformerLM(nn.Module):
     capacity_factor: float = 2.0
     max_len: int = 131072
     dtype: jnp.dtype = jnp.float32
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, positions=None):
@@ -158,8 +159,9 @@ class MoETransformerLM(nn.Module):
             positions = jnp.arange(tokens.shape[-1])[None, :]
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="tok_embed")(tokens)
         x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype, name="pos_embed")(positions)
+        block_cls = nn.remat(MoEBlock) if self.remat else MoEBlock
         for i in range(self.n_layers):
-            x = MoEBlock(
+            x = block_cls(
                 self.d_model, self.n_heads, self.d_ff, self.n_experts,
                 self.capacity_factor, self.dtype, name=f"block_{i}",
             )(x)

@@ -133,6 +133,7 @@ class TransformerLM(nn.Module):
     attn_fn: Optional[Callable] = None
     decode: bool = False
     cache_size: int = 0
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, positions=None):
@@ -140,8 +141,14 @@ class TransformerLM(nn.Module):
             positions = jnp.arange(tokens.shape[-1])[None, :]
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="tok_embed")(tokens)
         x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype, name="pos_embed")(positions)
+        # remat: recompute each block's intra-block intermediates (attention
+        # scores, d_ff tensors) in the backward pass instead of keeping them
+        # in HBM; only the n_layers block-boundary residuals stay resident —
+        # the standard long-context trade of FLOPs for HBM (jax.checkpoint
+        # per block)
+        block_cls = nn.remat(Block) if self.remat and not self.decode else Block
         for i in range(self.n_layers):
-            x = Block(
+            x = block_cls(
                 self.d_model, self.n_heads, self.d_ff, self.dtype, self.attn_fn,
                 decode=self.decode, cache_size=self.cache_size,
                 name=f"block_{i}",

@@ -145,7 +145,12 @@ def train_sync(args, mesh: Mesh | None = None) -> Tuple[TrainState, MetricsLogge
         getattr(args, "model", "alexnet"),
         dtype=jnp.bfloat16 if getattr(args, "dtype", "float32") == "bfloat16" else jnp.float32,
     )
-    state, tx = create_train_state(model, jax.random.key(getattr(args, "seed", 0)), args.lr)
+    state, tx = create_train_state(
+        model,
+        jax.random.key(getattr(args, "seed", 0)),
+        args.lr,
+        grad_accum=getattr(args, "grad_accum", 1),
+    )
     # restore (if resuming) before replication: orbax then re-places the
     # restored arrays under the replicated sharding like any fresh init
     from distributed_ml_pytorch_tpu.training.trainer import setup_checkpoint

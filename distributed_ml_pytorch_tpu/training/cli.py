@@ -103,6 +103,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="global step at which the trace window opens")
     p.add_argument("--profile-steps", type=int, default=10, metavar="N",
                    help="number of steps the trace window covers")
+    p.add_argument("--grad-accum", type=int, default=1, metavar="K",
+                   help="average gradients over K micro-batches before each "
+                        "optimizer update (optax.MultiSteps) — effective "
+                        "batch K×batch-size without K× activation HBM")
     p.add_argument("--steps-per-dispatch", type=int, default=1, metavar="K",
                    help="fuse up to K consecutive SGD steps into one compiled "
                         "program (lax.scan) in the single-process trainer — "
@@ -158,6 +162,16 @@ def main(argv=None) -> int:
             "error: --ckpt-dir is not supported in --mode {} yet; "
             "no checkpoints would be written (use --mode sync, or drop "
             "--ckpt-dir to train without preemption safety)".format(args.mode),
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.grad_accum > 1 and args.mode in ("ps", "local-sgd"):
+        # accumulation is wired into the single-process and sync trainers;
+        # silently training at 1x effective batch would mislead
+        print(
+            "error: --grad-accum is not supported in --mode {} yet "
+            "(use --mode sync or --no-distributed)".format(args.mode),
             file=sys.stderr,
         )
         return 2

@@ -65,12 +65,24 @@ class TrainState(struct.PyTreeNode):
 
 
 def create_train_state(
-    model, rng: jax.Array, lr: float, momentum: float = 0.0, sample_shape=(1, 32, 32, 3)
+    model,
+    rng: jax.Array,
+    lr: float,
+    momentum: float = 0.0,
+    sample_shape=(1, 32, 32, 3),
+    grad_accum: int = 1,
 ) -> Tuple[TrainState, optax.GradientTransformation]:
     """Initialize params + plain SGD (reference ``optim.SGD(lr, momentum=0.0)``,
-    ``example/main.py:44``)."""
+    ``example/main.py:44``).
+
+    ``grad_accum > 1`` wraps the optimizer in ``optax.MultiSteps``: gradients
+    average over that many consecutive micro-batches before one SGD update
+    is applied — the effective batch grows without growing per-step HBM.
+    """
     params = model.init(rng, jnp.zeros(sample_shape))["params"]
     tx = optax.sgd(lr, momentum=momentum if momentum else None)
+    if int(grad_accum) > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=int(grad_accum))
     return TrainState.create(params, tx), tx
 
 
@@ -386,7 +398,12 @@ def train_single(args) -> Tuple[TrainState, MetricsLogger]:
         getattr(args, "model", "alexnet"),
         dtype=jnp.bfloat16 if getattr(args, "dtype", "float32") == "bfloat16" else jnp.float32,
     )
-    state, tx = create_train_state(model, jax.random.key(getattr(args, "seed", 0)), args.lr)
+    state, tx = create_train_state(
+        model,
+        jax.random.key(getattr(args, "seed", 0)),
+        args.lr,
+        grad_accum=getattr(args, "grad_accum", 1),
+    )
     train_step = make_train_step(model, tx)
     scan_step = (
         make_scan_train_step(model, tx)

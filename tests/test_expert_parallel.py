@@ -130,3 +130,19 @@ def test_ep_rejects_indivisible_experts():
     )
     with pytest.raises(ValueError, match="not divisible"):
         make_ep_train_step(bad, optax.sgd(0.1), mesh)
+
+
+def test_moe_remat_matches_no_remat():
+    model = tiny_moe()
+    remat_model = MoETransformerLM(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        n_experts=4, capacity_factor=2.0, max_len=128, remat=True,
+    )
+    tokens, _ = make_batch()
+    params = model.init(jax.random.key(0), jnp.asarray(tokens))["params"]
+    a, sown_a = model.apply({"params": params}, jnp.asarray(tokens), mutable=["losses"])
+    b, sown_b = remat_model.apply({"params": params}, jnp.asarray(tokens), mutable=["losses"])
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    la = sum(float(jnp.sum(v)) for v in jax.tree.leaves(sown_a["losses"]))
+    lb = sum(float(jnp.sum(v)) for v in jax.tree.leaves(sown_b["losses"]))
+    np.testing.assert_allclose(la, lb, rtol=1e-6)

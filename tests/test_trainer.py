@@ -183,3 +183,27 @@ def test_chunked_dispatch_still_checkpoints_on_exact_boundaries(tmp_path):
 
     saved = {int(d) for d in os.listdir(a.ckpt_dir) if d.isdigit()}
     assert {4, 8} <= saved, f"interval saves missing: {sorted(saved)}"
+
+
+def test_grad_accum_equals_full_batch_step():
+    """k accumulation micro-steps must equal one step on the concatenated
+    batch (grad of mean CE averages linearly over equal-size micro-batches)."""
+    from distributed_ml_pytorch_tpu.models import AlexNet  # no dropout: exact
+
+    model = AlexNet(num_classes=10)
+    rng_np = np.random.default_rng(0)
+    images = rng_np.normal(size=(16, 32, 32, 3)).astype(np.float32)
+    labels = (np.arange(16) % 10).astype(np.int32)
+    drng = jax.random.key(1)
+
+    state_a, tx_a = create_train_state(model, jax.random.key(0), lr=0.05, grad_accum=2)
+    step_a = make_train_step(model, tx_a)
+    state_a, _ = step_a(state_a, images[:8], labels[:8], drng)
+    state_a, _ = step_a(state_a, images[8:], labels[8:], drng)
+
+    state_b, tx_b = create_train_state(model, jax.random.key(0), lr=0.05)
+    step_b = make_train_step(model, tx_b)
+    state_b, _ = step_b(state_b, images, labels, drng)
+
+    for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7)

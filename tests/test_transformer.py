@@ -113,3 +113,28 @@ def test_sp_step_long_sequence_smoke():
     state, loss = step(state, tok_s, tgt_s)
     assert np.isfinite(float(loss))
     assert int(state.step) == 1
+
+
+def test_remat_matches_no_remat_exactly():
+    """remat=True must change memory, not math: same loss and grads."""
+    import optax
+    from distributed_ml_pytorch_tpu.models.transformer import TransformerLM
+
+    cfg = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_len=64)
+    base = TransformerLM(**cfg)
+    remat = TransformerLM(**cfg, remat=True)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(2, 16)), jnp.int32
+    )
+    params = base.init(jax.random.key(0), tokens)["params"]
+
+    def loss(model, p):
+        logits = model.apply({"params": p}, tokens)
+        tgt = jnp.roll(tokens, -1, axis=1)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, tgt).mean()
+
+    l1, g1 = jax.value_and_grad(lambda p: loss(base, p))(params)
+    l2, g2 = jax.value_and_grad(lambda p: loss(remat, p))(params)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7)
