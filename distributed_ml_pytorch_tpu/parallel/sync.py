@@ -145,17 +145,28 @@ def train_sync(args, mesh: Mesh | None = None) -> Tuple[TrainState, MetricsLogge
         getattr(args, "model", "alexnet"),
         dtype=jnp.bfloat16 if getattr(args, "dtype", "float32") == "bfloat16" else jnp.float32,
     )
+    from distributed_ml_pytorch_tpu.training.trainer import (
+        make_lr_schedule,
+        setup_checkpoint,
+    )
+
+    per_proc_batch = global_batch // n_proc
+    grad_accum = int(getattr(args, "grad_accum", 1) or 1)
+    lr = make_lr_schedule(
+        getattr(args, "lr_schedule", "constant"),
+        args.lr,
+        # schedule steps = optimizer updates (MultiSteps emits one per K)
+        steps_per_epoch=max(1, len(x_train) // per_proc_batch // grad_accum),
+        total_epochs=args.epochs,
+    )
     state, tx = create_train_state(
         model,
         jax.random.key(getattr(args, "seed", 0)),
-        args.lr,
-        grad_accum=getattr(args, "grad_accum", 1),
+        lr,
+        grad_accum=grad_accum,
     )
     # restore (if resuming) before replication: orbax then re-places the
     # restored arrays under the replicated sharding like any fresh init
-    from distributed_ml_pytorch_tpu.training.trainer import setup_checkpoint
-
-    per_proc_batch = global_batch // n_proc
     ckpt, state, start_epoch, start_iter = setup_checkpoint(
         args, state, len(x_train) // per_proc_batch
     )

@@ -103,6 +103,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="global step at which the trace window opens")
     p.add_argument("--profile-steps", type=int, default=10, metavar="N",
                    help="number of steps the trace window covers")
+    p.add_argument("--lr-schedule", type=str, default="constant",
+                   choices=("constant", "inverse-epoch", "cosine"),
+                   help="learning-rate schedule; the reference configures "
+                        "1/(epoch+1) decay but never steps it (SURVEY.md "
+                        "§5.6) — 'inverse-epoch' is that intent done right")
     p.add_argument("--grad-accum", type=int, default=1, metavar="K",
                    help="average gradients over K micro-batches before each "
                         "optimizer update (optax.MultiSteps) — effective "
@@ -166,15 +171,20 @@ def main(argv=None) -> int:
         )
         return 2
 
-    if args.grad_accum > 1 and args.mode in ("ps", "local-sgd"):
-        # accumulation is wired into the single-process and sync trainers;
-        # silently training at 1x effective batch would mislead
-        print(
-            "error: --grad-accum is not supported in --mode {} yet "
-            "(use --mode sync or --no-distributed)".format(args.mode),
-            file=sys.stderr,
-        )
-        return 2
+    if args.mode in ("ps", "local-sgd"):
+        # these knobs are wired into the single-process and sync trainers;
+        # silently ignoring them would mislead (constant-lr / 1x batch runs)
+        for flag, bad in (
+            ("--grad-accum", args.grad_accum > 1),
+            ("--lr-schedule", args.lr_schedule != "constant"),
+        ):
+            if bad:
+                print(
+                    "error: {} is not supported in --mode {} yet "
+                    "(use --mode sync or --no-distributed)".format(flag, args.mode),
+                    file=sys.stderr,
+                )
+                return 2
 
     if args.profile_dir and args.mode in ("ps", "local-sgd"):
         # tracing is wired into the shared training loop (single / sync);

@@ -19,9 +19,10 @@ Parity decisions (SURVEY.md §7 "reproduce the intent, not the defect"):
 - no eval-mode leak: dropout is controlled per-call by ``train=``, unlike the
   reference whose ``net.eval()`` at ``:113`` permanently disables dropout
   after the first mid-epoch eval;
-- the never-stepped LambdaLR scheduler (``:47-48``) is intentionally not
-  reproduced — lr stays constant, which is the reference's *effective*
-  behavior.
+- the never-stepped LambdaLR scheduler (``:47-48``): the default
+  (``--lr-schedule constant``) matches the reference's *effective* behavior,
+  and ``make_lr_schedule`` offers its *configured* 1/(epoch+1) decay done
+  right (``inverse-epoch``), plus cosine.
 """
 
 from __future__ import annotations
@@ -64,16 +65,42 @@ class TrainState(struct.PyTreeNode):
         return cls(params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32))
 
 
+def make_lr_schedule(
+    kind: str, lr: float, steps_per_epoch: int = 1, total_epochs: int = 1
+):
+    """Working LR schedules — the reference *configures* a ``LambdaLR`` with
+    ``1/(epoch+1)`` decay but never calls ``scheduler.step()``, so its lr
+    stays constant (``example/main.py:47-48``; SURVEY.md §5.6 flags the dead
+    scheduler). This implements the intent:
+
+    - ``constant`` — the reference's *effective* behavior (default);
+    - ``inverse-epoch`` — the reference's *configured* behavior, done right;
+    - ``cosine`` — cosine decay to 0 over the whole run.
+
+    Returns an optax schedule (step → lr) or a float for ``constant``.
+    """
+    if kind == "constant":
+        return lr
+    if kind == "inverse-epoch":
+        spe = max(1, int(steps_per_epoch))
+        return lambda step: lr / (step // spe + 1)
+    if kind == "cosine":
+        total = max(1, int(steps_per_epoch) * int(total_epochs))
+        return optax.cosine_decay_schedule(lr, decay_steps=total)
+    raise ValueError(f"unknown lr schedule {kind!r} (constant|inverse-epoch|cosine)")
+
+
 def create_train_state(
     model,
     rng: jax.Array,
-    lr: float,
+    lr,
     momentum: float = 0.0,
     sample_shape=(1, 32, 32, 3),
     grad_accum: int = 1,
 ) -> Tuple[TrainState, optax.GradientTransformation]:
     """Initialize params + plain SGD (reference ``optim.SGD(lr, momentum=0.0)``,
-    ``example/main.py:44``).
+    ``example/main.py:44``). ``lr`` may be a float or an optax schedule
+    (see :func:`make_lr_schedule`).
 
     ``grad_accum > 1`` wraps the optimizer in ``optax.MultiSteps``: gradients
     average over that many consecutive micro-batches before one SGD update
@@ -398,11 +425,21 @@ def train_single(args) -> Tuple[TrainState, MetricsLogger]:
         getattr(args, "model", "alexnet"),
         dtype=jnp.bfloat16 if getattr(args, "dtype", "float32") == "bfloat16" else jnp.float32,
     )
+    steps_per_epoch = max(1, len(x_train) // args.batch_size)
+    grad_accum = int(getattr(args, "grad_accum", 1) or 1)
+    lr = make_lr_schedule(
+        getattr(args, "lr_schedule", "constant"),
+        args.lr,
+        # MultiSteps advances the inner schedule once per K micro-batches, so
+        # the schedule's epoch must be measured in optimizer updates
+        steps_per_epoch=max(1, steps_per_epoch // grad_accum),
+        total_epochs=args.epochs,
+    )
     state, tx = create_train_state(
         model,
         jax.random.key(getattr(args, "seed", 0)),
-        args.lr,
-        grad_accum=getattr(args, "grad_accum", 1),
+        lr,
+        grad_accum=grad_accum,
     )
     train_step = make_train_step(model, tx)
     scan_step = (
@@ -413,9 +450,7 @@ def train_single(args) -> Tuple[TrainState, MetricsLogger]:
     eval_step = make_eval_fn(model)
     logger = MetricsLogger(getattr(args, "log_dir", "log"))
 
-    ckpt, state, start_epoch, start_iter = setup_checkpoint(
-        args, state, len(x_train) // args.batch_size
-    )
+    ckpt, state, start_epoch, start_iter = setup_checkpoint(args, state, steps_per_epoch)
 
     t0 = time.time()
     try:

@@ -207,3 +207,43 @@ def test_grad_accum_equals_full_batch_step():
 
     for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7)
+
+
+def test_lr_schedules():
+    from distributed_ml_pytorch_tpu.training.trainer import make_lr_schedule
+
+    assert make_lr_schedule("constant", 0.1) == 0.1
+    inv = make_lr_schedule("inverse-epoch", 0.1, steps_per_epoch=10)
+    assert float(inv(0)) == pytest.approx(0.1)
+    assert float(inv(9)) == pytest.approx(0.1)    # still epoch 0
+    assert float(inv(10)) == pytest.approx(0.05)  # epoch 1 → lr/2
+    assert float(inv(25)) == pytest.approx(0.1 / 3)
+    cos = make_lr_schedule("cosine", 0.1, steps_per_epoch=10, total_epochs=2)
+    assert float(cos(0)) == pytest.approx(0.1)
+    assert float(cos(20)) == pytest.approx(0.0, abs=1e-6)
+    with pytest.raises(ValueError, match="unknown lr schedule"):
+        make_lr_schedule("warmup-nope", 0.1)
+
+
+def test_inverse_epoch_schedule_decays_updates():
+    """SGD under the schedule must take smaller steps in later epochs."""
+    from distributed_ml_pytorch_tpu.models import AlexNet
+    from distributed_ml_pytorch_tpu.training.trainer import make_lr_schedule
+
+    model = AlexNet(num_classes=10)
+    images = np.random.default_rng(0).normal(size=(8, 32, 32, 3)).astype(np.float32)
+    labels = (np.arange(8) % 10).astype(np.int32)
+    drng = jax.random.key(1)
+    sched = make_lr_schedule("inverse-epoch", 0.1, steps_per_epoch=1)  # lr/ (step+1)
+    state, tx = create_train_state(model, jax.random.key(0), sched)
+    step = make_train_step(model, tx)
+
+    deltas = []
+    # materialize to host: the step donates state, deleting old leaves
+    prev = [np.asarray(l) for l in jax.tree.leaves(state.params)]
+    for _ in range(3):
+        state, _ = step(state, images, labels, drng)
+        cur = [np.asarray(l) for l in jax.tree.leaves(state.params)]
+        deltas.append(float(sum(np.abs(a - b).sum() for a, b in zip(cur, prev))))
+        prev = cur
+    assert deltas[0] > deltas[1] > deltas[2], deltas
