@@ -39,6 +39,7 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -102,9 +103,16 @@ struct TptTransport {
   int rank = -1;
   int world = 0;
   int listen_fd = -1;
+  // peers_mu guards peer_fds / send_mu / readers: the elastic accept thread
+  // mutates them concurrently with sends and shutdown. Lock order where both
+  // are needed: per-peer send mutex BEFORE peers_mu (see tpt_send /
+  // admit_worker).
+  std::mutex peers_mu;
   std::map<int, int> peer_fds;                            // rank -> socket
   std::map<int, std::unique_ptr<std::mutex>> send_mu;     // per-socket write lock
+  std::vector<int> retired_fds;  // replaced-on-rejoin sockets, closed at teardown
   std::vector<std::thread> readers;
+  std::thread acceptor;
   std::mutex mu;
   std::condition_variable cv;
   std::deque<TptMsg*> inbox;
@@ -138,12 +146,89 @@ struct TptTransport {
     cv.notify_all();  // wake blocked recv so it can observe a dead peer/close
   }
 
+  // Handshake one inbound worker connection; a duplicate rank is a REJOIN
+  // (restarted worker): the stale socket is shut down — its reader exits —
+  // and replaced. Returns false (closing conn) on a malformed hello.
+  bool admit_worker(int conn) {
+    int one = 1;
+    setsockopt(conn, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // bound the handshake: a half-open connection (port scan, worker dead
+    // right after connect) must not wedge the single-threaded acceptor or
+    // hang shutdown_all's join forever
+    timeval hs_to{5, 0};
+    setsockopt(conn, SOL_SOCKET, SO_RCVTIMEO, &hs_to, sizeof(hs_to));
+    Header hello;
+    if (!recv_all(conn, reinterpret_cast<char*>(&hello), sizeof(hello)) ||
+        hello.nbytes != 0 || hello.sender < 1 || hello.sender >= world) {
+      set_error("worker handshake failed or invalid rank");
+      ::close(conn);
+      return false;
+    }
+    timeval no_to{0, 0};  // handshake done: reads must block indefinitely
+    setsockopt(conn, SOL_SOCKET, SO_RCVTIMEO, &no_to, sizeof(no_to));
+    std::mutex* m = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(peers_mu);
+      auto it = send_mu.find(hello.sender);
+      if (it == send_mu.end()) {
+        send_mu[hello.sender] = std::make_unique<std::mutex>();
+      }
+      m = send_mu[hello.sender].get();
+    }
+    {
+      // hold the peer's send mutex across the swap so an in-flight send to
+      // the dead socket finishes (or fails) before the fd changes under it
+      std::lock_guard<std::mutex> slk(*m);
+      std::lock_guard<std::mutex> lk(peers_mu);
+      if (closed.load()) {
+        // raced shutdown_all: registering now would spawn a reader whose
+        // socket the teardown sweep already missed — bail instead
+        ::close(conn);
+        return false;
+      }
+      auto it = peer_fds.find(hello.sender);
+      if (it != peer_fds.end()) {
+        // shutdown only — closing here could recycle the fd number while
+        // the old reader is still inside recv on it; the fd is closed at
+        // teardown instead (bounded by the number of rejoins)
+        ::shutdown(it->second, SHUT_RDWR);
+        retired_fds.push_back(it->second);
+      }
+      peer_fds[hello.sender] = conn;
+      readers.emplace_back([this, conn] { reader_loop(conn); });
+    }
+    return true;
+  }
+
+  // Elastic accept loop: runs after the initial rendezvous so restarted
+  // workers can reconnect mid-run (the reference has no rejoin logic,
+  // rendezvous is once-and-static). Polls with a timeout rather than
+  // blocking in accept(): shutdown() on a listening socket does NOT wake a
+  // blocked accept on Linux, so a blocking loop would deadlock
+  // shutdown_all's join.
+  void accept_loop() {
+    for (;;) {
+      if (closed.load()) return;
+      pollfd p{listen_fd, POLLIN, 0};
+      int r = ::poll(&p, 1, 200);
+      if (closed.load()) return;
+      if (r <= 0) continue;
+      int conn = ::accept(listen_fd, nullptr, nullptr);
+      if (conn < 0) {
+        if (closed.load()) return;
+        continue;
+      }
+      admit_worker(conn);
+    }
+  }
+
   // Idempotent teardown: wake waiters, unblock readers, join, close fds.
   // Used by tpt_close, the destructor, and tpt_create's error paths (where
   // reader threads may already be running — destroying a joinable
   // std::thread would call std::terminate).
   void shutdown_all() {
     if (!closed.exchange(true)) {
+      std::lock_guard<std::mutex> lk(peers_mu);
       for (auto& kv : peer_fds) ::shutdown(kv.second, SHUT_RDWR);
       if (listen_fd >= 0) ::shutdown(listen_fd, SHUT_RDWR);
     }
@@ -152,12 +237,21 @@ struct TptTransport {
     // fires — otherwise the wakeup is lost and recv blocks forever.
     { std::lock_guard<std::mutex> lk(mu); }
     cv.notify_all();
-    for (auto& th : readers) {
+    // join the acceptor first: once it is gone, nothing mutates `readers`
+    if (acceptor.joinable()) acceptor.join();
+    std::vector<std::thread> to_join;
+    {
+      std::lock_guard<std::mutex> lk(peers_mu);
+      to_join.swap(readers);
+    }
+    for (auto& th : to_join) {
       if (th.joinable()) th.join();
     }
-    readers.clear();
+    std::lock_guard<std::mutex> lk(peers_mu);
     for (auto& kv : peer_fds) ::close(kv.second);
     peer_fds.clear();
+    for (int fd : retired_fds) ::close(fd);
+    retired_fds.clear();
     if (listen_fd >= 0) {
       ::close(listen_fd);
       listen_fd = -1;
@@ -211,36 +305,23 @@ void* tpt_create(int rank, int world, const char* master, int port, double timeo
       return nullptr;
     }
     t->listen_fd = fd;
-    for (int i = 0; i < world - 1; i++) {
+    // initial rendezvous: block until world-1 DISTINCT workers are admitted
+    // (a duplicate --rank counts as a rejoin and replaces its predecessor)
+    for (;;) {
+      {
+        std::lock_guard<std::mutex> lk(t->peers_mu);
+        if (static_cast<int>(t->peer_fds.size()) >= world - 1) break;
+      }
       int conn = ::accept(fd, nullptr, nullptr);
       if (conn < 0) {
         set_error("accept failed: " + std::string(strerror(errno)));
         return nullptr;
       }
-      setsockopt(conn, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      Header hello;
-      if (!recv_all(conn, reinterpret_cast<char*>(&hello), sizeof(hello)) ||
-          hello.nbytes != 0) {
-        set_error("worker handshake failed");
-        ::close(conn);
-        return nullptr;
-      }
-      // Reject misconfigured workers (out-of-range or duplicate --rank):
-      // overwriting an existing peer fd would orphan its reader thread's
-      // socket and deadlock shutdown_all's join. The unique_ptr destructor
-      // tears down the already-accepted peers cleanly.
-      if (hello.sender < 1 || hello.sender >= world ||
-          t->peer_fds.count(hello.sender) != 0) {
-        set_error("invalid or duplicate worker rank in hello: " +
-                  std::to_string(hello.sender));
-        ::close(conn);
-        return nullptr;
-      }
-      t->peer_fds[hello.sender] = conn;
-      t->send_mu[hello.sender] = std::make_unique<std::mutex>();
-      TptTransport* tp = t.get();
-      t->readers.emplace_back([tp, conn] { tp->reader_loop(conn); });
+      t->admit_worker(conn);
     }
+    // elastic phase: keep accepting so restarted workers can rejoin mid-run
+    TptTransport* tp = t.get();
+    t->acceptor = std::thread([tp] { tp->accept_loop(); });
   } else {
     addrinfo hints{};
     hints.ai_family = AF_INET;
@@ -287,17 +368,35 @@ void* tpt_create(int rank, int world, const char* master, int port, double timeo
 int tpt_rank(void* handle) { return static_cast<TptTransport*>(handle)->rank; }
 
 // Send n float32s to dst. Returns 0 on success, -1 on error.
+// Lock order: the per-peer send mutex is taken BEFORE re-reading the fd
+// under peers_mu, matching admit_worker's swap (which holds the send mutex)
+// so a rejoin can never change the fd mid-frame.
 int tpt_send(void* handle, int dst, int code, const float* data, int64_t n) {
   auto* t = static_cast<TptTransport*>(handle);
-  auto it = t->peer_fds.find(dst);
-  if (it == t->peer_fds.end()) {
-    set_error("no connection to rank " + std::to_string(dst));
-    return -1;
+  std::mutex* m = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(t->peers_mu);
+    auto it = t->send_mu.find(dst);
+    if (it == t->send_mu.end()) {
+      set_error("no connection to rank " + std::to_string(dst));
+      return -1;
+    }
+    m = it->second.get();  // stable: entries are never erased
+  }
+  std::lock_guard<std::mutex> slk(*m);
+  int fd = -1;
+  {
+    std::lock_guard<std::mutex> lk(t->peers_mu);
+    auto it = t->peer_fds.find(dst);
+    if (it == t->peer_fds.end()) {
+      set_error("no connection to rank " + std::to_string(dst));
+      return -1;
+    }
+    fd = it->second;
   }
   Header h{t->rank, code, n * 4};
-  std::lock_guard<std::mutex> lk(*t->send_mu[dst]);
-  if (!send_all(it->second, reinterpret_cast<const char*>(&h), sizeof(h)) ||
-      (n > 0 && !send_all(it->second, reinterpret_cast<const char*>(data),
+  if (!send_all(fd, reinterpret_cast<const char*>(&h), sizeof(h)) ||
+      (n > 0 && !send_all(fd, reinterpret_cast<const char*>(data),
                           static_cast<size_t>(n) * 4))) {
     set_error("send failed: " + std::string(strerror(errno)));
     return -1;

@@ -213,6 +213,7 @@ class Asynchronous:
         *,
         transport: Optional[Transport] = None,
         heartbeat: Optional["HeartbeatSender"] = None,
+        rejoin: bool = False,
     ):
         if lr < 0.0:
             raise ValueError("Invalid learning rate: {}".format(lr))
@@ -235,10 +236,19 @@ class Asynchronous:
         self._flat_n = int(ravel_model_params(params).shape[0])
         self._pad = (-self._flat_n) % LANES
         self.accum = jnp.zeros(self._flat_n + self._pad, jnp.float32)
-        # install this worker's initial params as the central params (:34)
-        send_message(
-            MessageCode.ParameterUpdate, ravel_model_params(params), transport=transport
-        )
+        if rejoin:
+            # elastic restart: ADOPT the server's current central params
+            # instead of stomping them with this process's fresh init — the
+            # pull lands in the listener mailbox and installs at the first
+            # step boundary
+            send_message(
+                MessageCode.ParameterRequest, np.zeros(0, np.float32), transport=transport
+            )
+        else:
+            # install this worker's initial params as the central params (:34)
+            send_message(
+                MessageCode.ParameterUpdate, ravel_model_params(params), transport=transport
+            )
         self.listener = Listener(transport=transport)
         self.listener.start()
         # a dead server degrades the worker to purely-local SGD (see _send).
@@ -358,6 +368,7 @@ def train_worker(
         n_pull=args.num_pull,
         transport=transport,
         heartbeat=heartbeat,
+        rejoin=getattr(args, "rejoin", False),
     )
     dropout_rng = jax.random.key(seed + 1 + transport.rank)
 

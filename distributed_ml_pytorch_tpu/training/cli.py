@@ -103,6 +103,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="global step at which the trace window opens")
     p.add_argument("--profile-steps", type=int, default=10, metavar="N",
                    help="number of steps the trace window covers")
+    p.add_argument("--rejoin", action="store_true", default=False,
+                   help="PS-mode worker restart: reconnect to a running "
+                        "server and ADOPT its central params instead of "
+                        "installing this process's fresh init (elastic "
+                        "recovery; the reference has none, SURVEY.md §5.3)")
     p.add_argument("--lr-schedule", type=str, default="constant",
                    choices=("constant", "inverse-epoch", "cosine"),
                    help="learning-rate schedule; the reference configures "

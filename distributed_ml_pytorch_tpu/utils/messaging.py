@@ -173,21 +173,29 @@ class TCPTransport(Transport):
         # syscalls on large payloads. The native transport's send_mu
         # (native/transport.cpp) guards the same hazard.
         self._send_locks: Dict[int, threading.Lock] = {}
+        self._retired: list = []  # replaced-on-rejoin sockets, closed at close()
         if rank == SERVER_RANK:
             srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             srv.bind((master if master != "localhost" else "", int(port)))
             srv.listen(world_size)
             self._server_sock = srv
-            for _ in range(world_size - 1):
+            # block until world_size-1 DISTINCT workers are admitted; garbage
+            # connections (malformed hello) are dropped, not fatal, matching
+            # the native transport's tolerant rendezvous
+            while len(self._peers) < world_size - 1:
                 conn, _addr = srv.accept()
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                hello = _recv_frame(conn)
-                if hello is None:
-                    raise ConnectionError("worker handshake failed")
-                peer_rank = hello[0]
-                self._peers[peer_rank] = conn
-                self._spawn_reader(conn)
+                try:
+                    self._admit_worker(conn)
+                except ConnectionError:
+                    conn.close()
+            # elastic rejoin: keep accepting after the initial rendezvous so
+            # a restarted worker can reconnect mid-run (the reference has no
+            # rejoin logic anywhere, SURVEY.md §5.3); a duplicate rank
+            # replaces the dead socket
+            t = threading.Thread(target=self._accept_loop, daemon=True)
+            t.start()
+            self._threads.append(t)
         else:
             # Retry refused dials until the server is listening — rendezvous
             # blocks until all ranks join, like the reference's
@@ -208,6 +216,53 @@ class TCPTransport(Transport):
             self._peers[SERVER_RANK] = sock
             self._server_sock = None
             self._spawn_reader(sock)
+
+    def _admit_worker(self, conn: socket.socket) -> None:
+        """Handshake one inbound worker connection and start its reader.
+
+        A rank that already has a peer socket is a *rejoin*: the stale socket
+        (whose process died) is shut down — its reader exits — and replaced.
+        """
+        # bound the handshake: a half-open connection must not wedge the
+        # single-threaded accept loop (or the rendezvous) forever
+        conn.settimeout(5.0)
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        hello = _recv_frame(conn)
+        if hello is None:
+            raise ConnectionError("worker handshake failed")
+        conn.settimeout(None)  # handshake done: reads must block indefinitely
+        peer_rank = hello[0]
+        if not (1 <= peer_rank < self.world_size):
+            raise ConnectionError(f"invalid worker rank in hello: {peer_rank}")
+        # swap under the peer's send lock so an in-flight send to the dead
+        # socket finishes before the replacement (shutdown only — closing
+        # here could recycle the fd under the old reader; closed at close())
+        with self._send_locks.setdefault(peer_rank, threading.Lock()):
+            old = self._peers.get(peer_rank)
+            if old is not None:
+                try:
+                    old.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                self._retired.append(old)
+            self._peers[peer_rank] = conn
+        self._spawn_reader(conn)
+
+    def _accept_loop(self) -> None:
+        # poll with a timeout: a close() in another thread does not reliably
+        # wake a blocked accept, so the loop must observe _closed itself
+        self._server_sock.settimeout(0.25)
+        while not self._closed:
+            try:
+                conn, _addr = self._server_sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed
+            try:
+                self._admit_worker(conn)
+            except ConnectionError:
+                conn.close()
 
     def _spawn_reader(self, sock: socket.socket) -> None:
         def pump():
@@ -242,7 +297,7 @@ class TCPTransport(Transport):
 
     def close(self) -> None:
         self._closed = True
-        for s in self._peers.values():
+        for s in list(self._peers.values()) + self._retired:
             try:
                 s.shutdown(socket.SHUT_RDWR)
             except OSError:

@@ -239,3 +239,143 @@ def test_worker_degrades_to_local_sgd_when_server_dies():
     assert opt.server_down
     opt.finish()  # also must not raise
     np.testing.assert_allclose(np.asarray(params["w"]), np.full(3, 1.0 - 0.4), rtol=1e-6)
+
+
+def _rejoin_scenario(make_worker, server):
+    """Shared elastic-rejoin drill for both transports: worker 2 dies, a
+    replacement process (new transport, same rank) reconnects and talks."""
+    w1 = make_worker(1)
+    w2 = make_worker(2)
+    w2.send(MessageCode.GradientUpdate, np.arange(4, dtype=np.float32))
+    msg = server.recv(timeout=5.0)
+    assert msg is not None and msg[0] == 2
+    w2.close()  # worker 2 "crashes"
+    time.sleep(0.2)
+    w2b = make_worker(2)  # restarted process rejoins with the same rank
+    w2b.send(MessageCode.ParameterRequest, np.zeros(0, np.float32))
+    msg = server.recv(timeout=5.0)
+    assert msg is not None and msg[0] == 2 and msg[1] == MessageCode.ParameterRequest
+    # server can reply to the REJOINED socket
+    server.send(MessageCode.ParameterUpdate, np.ones(3, np.float32), dst=2)
+    got = w2b.recv(timeout=5.0)
+    assert got is not None and got[1] == MessageCode.ParameterUpdate
+    np.testing.assert_array_equal(got[2], np.ones(3, np.float32))
+    # the surviving worker is unaffected
+    w1.send(MessageCode.WorkerDone, np.zeros(0, np.float32))
+    msg = server.recv(timeout=5.0)
+    assert msg is not None and msg[0] == 1 and msg[1] == MessageCode.WorkerDone
+    for t in (w1, w2b):
+        t.close()
+    server.close()
+
+
+def test_python_tcp_transport_supports_worker_rejoin():
+    from distributed_ml_pytorch_tpu.launch import _free_port
+    from distributed_ml_pytorch_tpu.utils.messaging import TCPTransport
+
+    port = _free_port()
+    box = {}
+    st = threading.Thread(target=lambda: box.update(s=TCPTransport(0, 3, port=port)))
+    st.start()
+    workers = {}
+
+    def make_worker(rank):
+        t = TCPTransport(rank, 3, port=port)
+        workers[rank] = t
+        return t
+
+    make_worker(1), make_worker(2)
+    st.join(timeout=10.0)
+    server = box["s"]
+    for t in workers.values():
+        t.close()
+    _rejoin_scenario(make_worker, server)
+
+
+def test_native_transport_supports_worker_rejoin():
+    from distributed_ml_pytorch_tpu import native
+    from distributed_ml_pytorch_tpu.launch import _free_port
+
+    if not native.native_available():
+        pytest.skip(f"native transport unavailable: {native.native_load_error()}")
+    port = _free_port()
+    box = {}
+    st = threading.Thread(
+        target=lambda: box.update(s=native.NativeTCPTransport(0, 3, port=port))
+    )
+    st.start()
+    workers = {}
+
+    def make_worker(rank):
+        t = native.NativeTCPTransport(rank, 3, port=port)
+        workers[rank] = t
+        return t
+
+    make_worker(1), make_worker(2)
+    st.join(timeout=30.0)
+    server = box["s"]
+    for t in workers.values():
+        t.close()
+    _rejoin_scenario(make_worker, server)
+
+
+def test_rejoining_worker_adopts_central_params_instead_of_stomping():
+    import jax.numpy as jnp
+
+    from distributed_ml_pytorch_tpu.utils.serialization import ravel_model_params
+
+    world = InProcessTransport.create_world(2)
+    central = np.arange(5, dtype=np.float32)  # the run's learned state
+    server = ParameterServer(params=central, transport=world[0], n_workers=1)
+
+    params = {"w": jnp.zeros((3,)), "b": jnp.zeros((2,))}  # fresh init
+    opt = Asynchronous(params, lr=0.1, n_push=10, n_pull=10,
+                       transport=world[1], rejoin=True)
+    # serve the pending ParameterRequest (and nothing else)
+    msg = world[0].recv(timeout=2.0)
+    assert msg is not None and msg[1] == MessageCode.ParameterRequest
+    server.handle(*msg)
+    np.testing.assert_array_equal(server.central, central)  # NOT stomped
+    time.sleep(0.3)  # listener deposits the reply
+    grads = {"w": jnp.zeros((3,)), "b": jnp.zeros((2,))}
+    params = opt.step(params, grads)  # first boundary installs central
+    np.testing.assert_allclose(
+        np.asarray(ravel_model_params(params)), central, rtol=1e-6
+    )
+    opt.finish()
+
+
+def test_half_open_connection_does_not_wedge_elastic_accept():
+    """A connection that never sends its hello (port scan / instant death)
+    must not block later rejoins: the handshake is timeout-bounded."""
+    import socket as socket_mod
+
+    from distributed_ml_pytorch_tpu.launch import _free_port
+    from distributed_ml_pytorch_tpu.utils.messaging import TCPTransport
+
+    port = _free_port()
+    box = {}
+    st = threading.Thread(target=lambda: box.update(s=TCPTransport(0, 2, port=port)))
+    st.start()
+    w1 = TCPTransport(1, 2, port=port)
+    st.join(timeout=10.0)
+    server = box["s"]
+    # half-open garbage: connects, sends nothing
+    zombie = socket_mod.create_connection(("localhost", port), timeout=5)
+    w1.close()
+    time.sleep(0.2)
+    # the rejoin must get through even while the zombie handshake is pending
+    # (bounded at 5s, so allow for it to be processed first)
+    w1b = TCPTransport(1, 2, port=port, connect_timeout=20)
+    w1b.send(MessageCode.Heartbeat, np.zeros(0, np.float32))
+    deadline = time.monotonic() + 15.0
+    got = None
+    while time.monotonic() < deadline:
+        msg = server.recv(timeout=1.0)
+        if msg is not None and msg[1] == MessageCode.Heartbeat:
+            got = msg
+            break
+    assert got is not None and got[0] == 1, "rejoin blocked by half-open connection"
+    zombie.close()
+    w1b.close()
+    server.close()
