@@ -4,6 +4,7 @@ from distributed_ml_pytorch_tpu.data.cifar10 import (
     load_cifar10,
     synthetic_cifar10,
     iterate_batches,
+    prefetch_to_device,
     shard_for_process,
 )
 
@@ -13,5 +14,6 @@ __all__ = [
     "load_cifar10",
     "synthetic_cifar10",
     "iterate_batches",
+    "prefetch_to_device",
     "shard_for_process",
 ]

@@ -163,7 +163,9 @@ def train_sync(args, mesh: Mesh | None = None) -> Tuple[TrainState, MetricsLogge
         model,
         jax.random.key(getattr(args, "seed", 0)),
         lr,
+        momentum=getattr(args, "momentum", 0.0),
         grad_accum=grad_accum,
+        optimizer=getattr(args, "optimizer", "sgd"),
     )
     # restore (if resuming) before replication: orbax then re-places the
     # restored arrays under the replicated sharding like any fresh init
@@ -184,6 +186,10 @@ def train_sync(args, mesh: Mesh | None = None) -> Tuple[TrainState, MetricsLogge
 
     loop_args = copy.copy(args)
     loop_args.batch_size = per_proc_batch
+    # the step wrapper shards each host batch itself (put_sharded needs the
+    # numpy array, and on multi-host the per-process slice); default-device
+    # prefetch would force an extra device→device reshard copy
+    loop_args.prefetch = 0
 
     t0 = time.time()
     try:

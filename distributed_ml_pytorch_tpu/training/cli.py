@@ -108,6 +108,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "server and ADOPT its central params instead of "
                         "installing this process's fresh init (elastic "
                         "recovery; the reference has none, SURVEY.md §5.3)")
+    p.add_argument("--prefetch", type=int, default=2, metavar="N",
+                   help="keep N batches' host→device copies in flight ahead "
+                        "of compute (per-step path; 0 disables)")
+    p.add_argument("--optimizer", type=str, default="sgd",
+                   choices=("sgd", "adam", "adamw"),
+                   help="optimizer; sgd is the reference recipe "
+                        "(example/main.py:44)")
+    p.add_argument("--momentum", type=float, default=0.0, metavar="M",
+                   help="sgd momentum (the reference hardcodes 0.0)")
     p.add_argument("--lr-schedule", type=str, default="constant",
                    choices=("constant", "inverse-epoch", "cosine"),
                    help="learning-rate schedule; the reference configures "
@@ -182,6 +191,8 @@ def main(argv=None) -> int:
         for flag, bad in (
             ("--grad-accum", args.grad_accum > 1),
             ("--lr-schedule", args.lr_schedule != "constant"),
+            ("--optimizer", args.optimizer != "sgd"),
+            ("--momentum", args.momentum != 0.0),
         ):
             if bad:
                 print(

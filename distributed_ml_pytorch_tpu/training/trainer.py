@@ -90,6 +90,23 @@ def make_lr_schedule(
     raise ValueError(f"unknown lr schedule {kind!r} (constant|inverse-epoch|cosine)")
 
 
+def make_optimizer(name: str, lr, momentum: float = 0.0) -> optax.GradientTransformation:
+    """Optimizer registry for the ``--optimizer`` flag.
+
+    ``sgd`` is the reference's recipe (``optim.SGD(lr, momentum=0.0)``,
+    ``example/main.py:44``); ``adam`` and ``adamw`` are extensions. ``lr``
+    may be a float or an optax schedule.
+    """
+    name = name.lower()
+    if name == "sgd":
+        return optax.sgd(lr, momentum=momentum if momentum else None)
+    if name == "adam":
+        return optax.adam(lr)
+    if name == "adamw":
+        return optax.adamw(lr)
+    raise ValueError(f"unknown optimizer {name!r} (sgd|adam|adamw)")
+
+
 def create_train_state(
     model,
     rng: jax.Array,
@@ -97,8 +114,9 @@ def create_train_state(
     momentum: float = 0.0,
     sample_shape=(1, 32, 32, 3),
     grad_accum: int = 1,
+    optimizer: str = "sgd",
 ) -> Tuple[TrainState, optax.GradientTransformation]:
-    """Initialize params + plain SGD (reference ``optim.SGD(lr, momentum=0.0)``,
+    """Initialize params + optimizer (reference ``optim.SGD(lr, momentum=0.0)``,
     ``example/main.py:44``). ``lr`` may be a float or an optax schedule
     (see :func:`make_lr_schedule`).
 
@@ -107,7 +125,7 @@ def create_train_state(
     is applied — the effective batch grows without growing per-step HBM.
     """
     params = model.init(rng, jnp.zeros(sample_shape))["params"]
-    tx = optax.sgd(lr, momentum=momentum if momentum else None)
+    tx = make_optimizer(optimizer, lr, momentum)
     if int(grad_accum) > 1:
         tx = optax.MultiSteps(tx, every_k_schedule=int(grad_accum))
     return TrainState.create(params, tx), tx
@@ -327,13 +345,19 @@ def run_training_loop(
             print("Training for epoch {}".format(epoch))
             skip = start_iter if epoch == start_epoch else 0
             pending = []  # buffered (i, bx, by) awaiting a chunk flush
-            for i, (bx, by) in enumerate(
-                iterate_batches(
-                    x_train, y_train, args.batch_size,
-                    seed=getattr(args, "seed", 0), epoch=epoch, start_iter=skip,
-                ),
-                start=skip,
-            ):
+            batch_iter = iterate_batches(
+                x_train, y_train, args.batch_size,
+                seed=getattr(args, "seed", 0), epoch=epoch, start_iter=skip,
+            )
+            prefetch_n = int(getattr(args, "prefetch", 2) or 0)
+            if not use_scan and prefetch_n > 0:
+                # per-step path: keep batches in flight so the H2D copy
+                # overlaps the previous step's compute (the chunked path
+                # stacks on host, so it stays on numpy batches)
+                from distributed_ml_pytorch_tpu.data import prefetch_to_device
+
+                batch_iter = prefetch_to_device(batch_iter, prefetch_n)
+            for i, (bx, by) in enumerate(batch_iter, start=skip):
                 if not use_scan:
                     state, records = run_one(state, i, bx, by)
                     emit(records)
@@ -439,7 +463,9 @@ def train_single(args) -> Tuple[TrainState, MetricsLogger]:
         model,
         jax.random.key(getattr(args, "seed", 0)),
         lr,
+        momentum=getattr(args, "momentum", 0.0),
         grad_accum=grad_accum,
+        optimizer=getattr(args, "optimizer", "sgd"),
     )
     train_step = make_train_step(model, tx)
     scan_step = (

@@ -247,3 +247,59 @@ def test_inverse_epoch_schedule_decays_updates():
         deltas.append(float(sum(np.abs(a - b).sum() for a, b in zip(cur, prev))))
         prev = cur
     assert deltas[0] > deltas[1] > deltas[2], deltas
+
+
+def test_optimizer_registry_and_adam_learns():
+    from distributed_ml_pytorch_tpu.models import AlexNet
+    from distributed_ml_pytorch_tpu.training.trainer import make_optimizer
+
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        make_optimizer("rmsprop-nope", 0.1)
+
+    model = AlexNet(num_classes=10)
+    images = np.random.default_rng(0).normal(size=(32, 32, 32, 3)).astype(np.float32)
+    labels = (np.arange(32) % 10).astype(np.int32)
+    drng = jax.random.key(1)
+    state, tx = create_train_state(model, jax.random.key(0), 1e-3, optimizer="adam")
+    step = make_train_step(model, tx)
+    losses = []
+    for _ in range(20):
+        state, loss = step(state, images, labels, drng)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.2, losses[::5]
+
+
+def test_prefetch_preserves_batches_and_order():
+    from distributed_ml_pytorch_tpu.data import iterate_batches, prefetch_to_device
+
+    x = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = np.arange(10, dtype=np.int32)
+    plain = list(iterate_batches(x, y, 2, shuffle=True, seed=3))
+    fetched = list(
+        prefetch_to_device(iterate_batches(x, y, 2, shuffle=True, seed=3), size=3)
+    )
+    assert len(plain) == len(fetched)
+    for (ax, ay), (bx, by) in zip(plain, fetched):
+        np.testing.assert_array_equal(ax, np.asarray(bx))
+        np.testing.assert_array_equal(ay, np.asarray(by))
+
+
+def test_prefetched_training_matches_unprefetched(tmp_path):
+    import copy
+
+    class A(Args):
+        epochs = 1
+        synthetic_train_size = 128
+        synthetic_test_size = 64
+
+    a1, a2 = copy.deepcopy(A()), copy.deepcopy(A())
+    a1.log_dir = str(tmp_path / "a")
+    a1.prefetch = 0
+    a2.log_dir = str(tmp_path / "b")
+    a2.prefetch = 3
+    s1, l1 = train_single(a1)
+    s2, l2 = train_single(a2)
+    for r1, r2 in zip(l1.records, l2.records):
+        np.testing.assert_allclose(r1["training_loss"], r2["training_loss"], rtol=1e-6)
+    for p1, p2 in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-6)
