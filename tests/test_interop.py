@@ -1,0 +1,113 @@
+"""Torch→JAX weight migration: converted reference-architecture checkpoints
+must reproduce the torch model's logits."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+from distributed_ml_pytorch_tpu.models import AlexNet, LeNet  # noqa: E402
+from distributed_ml_pytorch_tpu.utils.interop import load_torch_state_dict  # noqa: E402
+
+
+def torch_alexnet():
+    # the reference's CIFAR AlexNet architecture (SURVEY.md C7)
+    return tnn.Sequential(
+        tnn.Conv2d(3, 64, 11, stride=4, padding=5), tnn.ReLU(),
+        tnn.MaxPool2d(2, 2),
+        tnn.Conv2d(64, 192, 5, padding=2), tnn.ReLU(),
+        tnn.MaxPool2d(2, 2),
+        tnn.Conv2d(192, 384, 3, padding=1), tnn.ReLU(),
+        tnn.Conv2d(384, 256, 3, padding=1), tnn.ReLU(),
+        tnn.Conv2d(256, 256, 3, padding=1), tnn.ReLU(),
+        tnn.MaxPool2d(2, 2),
+        tnn.Flatten(),
+        tnn.Linear(256, 10),
+    )
+
+
+def torch_lenet():
+    class TL(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = tnn.Conv2d(3, 6, 5)
+            self.conv2 = tnn.Conv2d(6, 16, 5)
+            self.fc1 = tnn.Linear(400, 120)
+            self.fc2 = tnn.Linear(120, 84)
+            self.fc3 = tnn.Linear(84, 10)
+
+        def forward(self, x):
+            import torch.nn.functional as F
+
+            x = F.relu(F.max_pool2d(self.conv1(x), 2))
+            x = F.relu(F.max_pool2d(self.conv2(x), 2))
+            x = x.flatten(1)
+            x = F.relu(self.fc1(x))
+            x = F.relu(self.fc2(x))
+            return self.fc3(x)
+
+    return TL()
+
+
+def _compare(torch_model, flax_model, flatten_shape=None, rtol=2e-4, atol=2e-5):
+    torch.manual_seed(0)
+    x = np.random.default_rng(0).normal(size=(4, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        want = torch_model(torch.from_numpy(x.transpose(0, 3, 1, 2).copy())).numpy()
+
+    template = flax_model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))["params"]
+    params = load_torch_state_dict(
+        template, torch_model.state_dict(), flatten_shape=flatten_shape
+    )
+    got = np.asarray(flax_model.apply({"params": params}, jnp.asarray(x), train=False))
+    np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+
+
+def test_alexnet_torch_weights_reproduce_logits():
+    _compare(torch_alexnet(), AlexNet(num_classes=10))  # 1x1 flatten: no permute
+
+
+def test_lenet_torch_weights_reproduce_logits():
+    # fc1 consumes a 16x5x5 flatten: CHW→HWC column permutation required
+    _compare(torch_lenet(), LeNet(num_classes=10), flatten_shape=(16, 5, 5))
+
+
+def test_converter_rejects_wrong_architecture():
+    template = AlexNet(num_classes=10).init(
+        jax.random.key(0), jnp.zeros((1, 32, 32, 3))
+    )["params"]
+    with pytest.raises(ValueError, match="architectures differ"):
+        load_torch_state_dict(template, torch_lenet().state_dict())
+
+
+def test_converter_rejects_shape_mismatch():
+    template = LeNet(num_classes=10).init(
+        jax.random.key(0), jnp.zeros((1, 32, 32, 3))
+    )["params"]
+    bad = torch_lenet()
+    sd = dict(bad.state_dict())
+    sd["fc3.weight"] = torch.zeros(11, 84)  # wrong num_classes
+    with pytest.raises(ValueError, match="no state_dict tensor matches"):
+        load_torch_state_dict(template, sd)
+
+
+def test_converter_rejects_batchnorm_checkpoints():
+    model = tnn.Sequential(tnn.Conv2d(3, 8, 3), tnn.BatchNorm2d(8))
+    template = AlexNet(num_classes=10).init(
+        jax.random.key(0), jnp.zeros((1, 32, 32, 3))
+    )["params"]
+    with pytest.raises(ValueError, match="BatchNorm"):
+        load_torch_state_dict(template, model.state_dict())
+
+
+def test_converter_rejects_unmatched_flatten_shape():
+    template = LeNet(num_classes=10).init(
+        jax.random.key(0), jnp.zeros((1, 32, 32, 3))
+    )["params"]
+    with pytest.raises(ValueError, match="flatten_shape"):
+        load_torch_state_dict(
+            template, torch_lenet().state_dict(), flatten_shape=(16, 5, 4)
+        )
