@@ -32,8 +32,30 @@ from distributed_ml_pytorch_tpu.parallel.expert_parallel import (
     make_ep_train_step,
     shard_ep_batch,
 )
+from distributed_ml_pytorch_tpu.parallel.fsdp import (
+    create_fsdp_train_state,
+    fsdp_specs,
+    make_fsdp_lm_train_step,
+    make_fsdp_train_step,
+    param_shard_fraction,
+    shard_fsdp_batch,
+)
+from distributed_ml_pytorch_tpu.parallel.ulysses import (
+    make_ulysses_eval_fn,
+    make_ulysses_train_step,
+    ulysses_attention,
+)
 
 __all__ = [
+    "create_fsdp_train_state",
+    "fsdp_specs",
+    "make_fsdp_lm_train_step",
+    "make_fsdp_train_step",
+    "param_shard_fraction",
+    "shard_fsdp_batch",
+    "make_ulysses_eval_fn",
+    "make_ulysses_train_step",
+    "ulysses_attention",
     "PipelineLMConfig",
     "create_pp_train_state",
     "make_pp_train_step",

@@ -1,0 +1,219 @@
+"""Fully-sharded data parallelism (ZeRO-3) via GSPMD sharding annotations.
+
+The reference's only parallelism is asynchronous data parallelism with
+replicated workers (SURVEY.md §2.4); every worker holds a full model copy.
+This module adds the TPU-native way to *not* hold a full copy: parameters,
+gradients, and optimizer state are sharded over the same mesh axis as the
+batch, and XLA's SPMD partitioner inserts the all-gather (on use) and
+reduce-scatter (on gradients) that define ZeRO-3/FSDP — no hand-written
+collectives, no wrapper modules, no parameter flattening.
+
+How the partitioner is steered, precisely:
+
+- every parameter leaf is annotated with a shape-based ``PartitionSpec``
+  that shards its **largest dimension divisible by the axis size** over
+  ``data`` (:func:`fsdp_specs`) — the maxtext/scaling-book fsdp recipe;
+- the batch is sharded over the same ``data`` axis, so a contraction of a
+  batch-sharded activation with a same-axis-sharded weight cannot stay
+  sharded on both operands: GSPMD resolves it by all-gathering the weight
+  (the cheaper operand), computing data-parallel, and reduce-scattering
+  the weight's gradient back to its shard — exactly FSDP's unshard →
+  compute → reshard lifecycle, chosen by the compiler instead of a runtime;
+- optimizer state shards by the same shape-based rule (momentum mirrors the
+  param tree leaf-for-leaf), so the optimizer update runs entirely on
+  1/N-sized shards — the ZeRO memory saving;
+- the train step pins its output state to the same shardings and donates
+  the input, so the sharded state updates in place in HBM and parameters
+  are never resident unsharded between steps.
+
+Per-chip parameter memory drops from |θ| to |θ|/N (plus transient gathered
+weights during the step); the gradient allreduce of plain DDP
+(``parallel/sync.py``) becomes reduce-scatter + all-gather, the same bytes
+on the ICI ring, so throughput matches sync DP while memory scales.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_ml_pytorch_tpu.training.trainer import (
+    TrainState,
+    cross_entropy_loss,
+)
+
+
+def fsdp_specs(tree, axis_size: int, axis: str = "data"):
+    """Shape-based FSDP ``PartitionSpec`` tree: shard each leaf's largest
+    dimension that divides the axis size; replicate leaves with no such
+    dimension (scalars, small biases, odd shapes).
+
+    The rule is purely shape-driven, so one function covers any model family
+    (CNN kernels, transformer denses, embeddings) *and* whole ``TrainState``
+    trees — optimizer momentum mirrors param shapes leaf-for-leaf and picks
+    up the identical spec, which is what makes the optimizer update run on
+    shards (ZeRO-3) without per-optimizer knowledge.
+    """
+
+    def spec_for(leaf) -> P:
+        shape = getattr(leaf, "shape", ())
+        ndim = len(shape)
+        if ndim == 0:
+            return P()
+        # largest dim first; ties broken toward the trailing (lane) dim,
+        # which XLA tiles most efficiently
+        order = sorted(range(ndim), key=lambda i: (shape[i], i), reverse=True)
+        for i in order:
+            if shape[i] >= axis_size and shape[i] % axis_size == 0:
+                spec = [None] * ndim
+                spec[i] = axis
+                return P(*spec)
+        return P()
+
+    return jax.tree.map(spec_for, tree)
+
+
+def _state_shardings(mesh: Mesh, state_shapes, axis: str):
+    specs = fsdp_specs(state_shapes, int(mesh.shape[axis]), axis)
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def create_fsdp_train_state(
+    init_fn: Callable[[jax.Array], TrainState],
+    rng: jax.Array,
+    mesh: Mesh,
+    axis: str = "data",
+):
+    """Init a ``TrainState`` already sharded per :func:`fsdp_specs`.
+
+    ``init_fn(rng) -> TrainState`` is evaluated abstractly to derive the
+    shardings, then jitted with them as ``out_shardings`` — each device
+    materializes only its 1/N shard; the full parameter set never exists on
+    any one host or chip (how models too big for a chip are initialized).
+
+    Returns ``(state, shardings)``; the shardings tree is what
+    :func:`make_fsdp_train_step` pins its output to.
+    """
+    state_shapes = jax.eval_shape(init_fn, rng)
+    shardings = _state_shardings(mesh, state_shapes, axis)
+    state = jax.jit(init_fn, out_shardings=shardings)(rng)
+    return state, shardings
+
+
+def make_fsdp_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    shardings,
+    axis: str = "data",
+) -> Callable:
+    """Jitted FSDP CNN step: ``(state, images, labels, rng) → (state, loss)``.
+
+    Written in the *global* view (pjit idiom, like
+    ``parallel/tensor_parallel.py``; contrast ``parallel/sync.py``'s
+    shard_map idiom): ``images``/``labels`` are global batch arrays sharded
+    ``P(data)`` by :func:`shard_fsdp_batch`, the loss is the plain global
+    batch mean, and every collective — weight all-gather, gradient
+    reduce-scatter — is inserted by the partitioner from the state's
+    shardings. Semantically identical to ``make_sync_train_step`` (same
+    global-mean gradient, same update); only the memory layout differs.
+    """
+    batch_sharding = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+
+    def step(state: TrainState, images, labels, rng):
+        step_rng = jax.random.fold_in(rng, state.step)
+
+        def loss_fn(params):
+            logits = model.apply(
+                {"params": params}, images, train=True, rngs={"dropout": step_rng}
+            )
+            return cross_entropy_loss(logits, labels)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(params=params, opt_state=opt_state, step=state.step + 1), loss
+
+    return jax.jit(
+        step,
+        in_shardings=(shardings, batch_sharding, batch_sharding, rep),
+        out_shardings=(shardings, rep),
+        donate_argnums=(0,),
+    )
+
+
+def make_fsdp_lm_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    shardings,
+    axis: str = "data",
+) -> Callable:
+    """Jitted FSDP LM step: ``(state, tokens, targets) → (state, loss)``.
+
+    Same partitioner-driven ZeRO-3 lifecycle as :func:`make_fsdp_train_step`,
+    with the LM loss convention shared with the sp/tp paths
+    (``seq_parallel.next_token_targets``: the final position is masked by
+    position), so dp/sp/tp/fsdp runs are comparable on the same data.
+    """
+    batch_sharding = NamedSharding(mesh, P(axis, None))
+    rep = NamedSharding(mesh, P())
+
+    def step(state: TrainState, tokens, targets):
+        def loss_fn(params):
+            logits = model.apply({"params": params}, tokens)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+            mask = jnp.ones_like(ce).at[:, -1].set(0.0)
+            return jnp.sum(ce * mask) / jnp.sum(mask)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(params=params, opt_state=opt_state, step=state.step + 1), loss
+
+    return jax.jit(
+        step,
+        in_shardings=(shardings, batch_sharding, batch_sharding),
+        out_shardings=(shardings, rep),
+        donate_argnums=(0,),
+    )
+
+
+def shard_fsdp_batch(mesh: Mesh, *arrays, axis: str = "data"):
+    """Place global host batch arrays on the mesh, leading dim over ``axis``.
+
+    Delegates to ``sync.put_sharded`` so the multi-host path (per-process
+    local slices assembled into one global array) works identically to every
+    other parallelism module's batch placement.
+    """
+    from distributed_ml_pytorch_tpu.parallel.sync import put_sharded
+
+    out: Tuple = tuple(
+        put_sharded(mesh, a, P(*((axis,) + (None,) * (a.ndim - 1))))
+        for a in arrays
+    )
+    return out if len(out) > 1 else out[0]
+
+
+def param_shard_fraction(state: TrainState, mesh: Mesh, axis: str = "data") -> float:
+    """Measured per-device parameter-memory fraction: bytes of one device's
+    addressable param shards over the full (unsharded) param bytes. ≈1/N when
+    the big leaves shard; the observability hook tests and benchmarks use to
+    verify ZeRO is actually engaged rather than trusting annotations."""
+    dev = mesh.devices.flat[0]
+    local = 0
+    total = 0
+    for leaf in jax.tree.leaves(state.params):
+        total += leaf.size * leaf.dtype.itemsize
+        for shard in leaf.addressable_shards:
+            if shard.device == dev:
+                local += shard.data.size * leaf.dtype.itemsize
+    return local / total if total else 1.0

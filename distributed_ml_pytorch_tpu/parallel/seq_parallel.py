@@ -94,16 +94,20 @@ def make_sp_train_step(
     mesh: Mesh,
     data_axis: str = "data",
     seq_axis: str = "seq",
+    attn_binder: Callable = None,
 ) -> Callable:
     """Build the jitted dp×sp LM step: ``(state, tokens, targets) → (state, loss)``.
 
     ``model`` is a ``TransformerLM`` (or compatible) config; its attention is
-    rebound to ring attention over ``seq_axis``. ``tokens``/``targets`` are
+    rebound over ``seq_axis`` by ``attn_binder(model, seq_axis, p)`` — ring
+    attention by default; ``parallel/ulysses.py`` passes its all-to-all
+    binder to reuse this step (sharding, loss, and update are identical —
+    only attention's collective pattern differs). ``tokens``/``targets`` are
     global (batch, seq) int arrays sharded ``P(data, seq)``; batch must divide
     ``mesh.shape[data]`` and seq ``mesh.shape[seq]``.
     """
     p = int(mesh.shape[seq_axis])
-    sp_model = _bind_ring(model, seq_axis, p)
+    sp_model = (attn_binder or _bind_ring)(model, seq_axis, p)
     axes = (data_axis, seq_axis)
 
     def shard_fn(state: TrainState, tokens, targets):
@@ -136,12 +140,14 @@ def shard_lm_batch(mesh: Mesh, tokens, targets, data_axis="data", seq_axis="seq"
 
 
 def make_sp_eval_fn(
-    model, mesh: Mesh, data_axis: str = "data", seq_axis: str = "seq"
+    model, mesh: Mesh, data_axis: str = "data", seq_axis: str = "seq",
+    attn_binder: Callable = None,
 ) -> Callable:
     """Cached jitted eval: ``(params, tokens, targets) → global masked-mean CE``
-    under the same dp×sp sharding and loss definition as the train step."""
+    under the same dp×sp sharding and loss definition as the train step.
+    ``attn_binder`` as in :func:`make_sp_train_step`."""
     p = int(mesh.shape[seq_axis])
-    sp_model = _bind_ring(model, seq_axis, p)
+    sp_model = (attn_binder or _bind_ring)(model, seq_axis, p)
     axes = (data_axis, seq_axis)
 
     def shard_fn(params, tokens, targets):

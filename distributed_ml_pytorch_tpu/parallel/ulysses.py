@@ -1,0 +1,119 @@
+"""Ulysses-style sequence parallelism: all-to-all head↔sequence re-sharding.
+
+The framework's other long-context path (``parallel/seq_parallel.py``) keeps
+the sequence sharded through attention and rotates K/V around the ring
+(``parallel/ring.py``). This module implements the alternative communication
+pattern (DeepSpeed-Ulysses): attention inputs arrive sequence-sharded,
+an ``all_to_all`` re-shards them **head-sharded with the full sequence
+local**, each device runs ordinary full-sequence causal attention over its
+n_heads/p heads, and a second ``all_to_all`` restores sequence sharding for
+the (position-local) rest of the block.
+
+Trade-offs vs ring attention, which is why a framework carries both:
+
+- communication is 4 all-to-alls per attention (q, k, v in; out back) of
+  size O(b·S·d/p) each, independent of the number of ring steps — cheaper
+  than the ring's p K/V rotations when p is large and ICI all-to-all
+  bandwidth is good (a TPU torus does all-to-all natively);
+- the full sequence is materialized per device *only inside attention* for
+  1/p of the heads — activation memory still scales, but peak attention
+  working set is O(S²/blocks) per head group rather than O((S/p)²) per ring
+  step, so ring attention reaches longer sequences; Ulysses is faster in
+  the regime where S/p chunks are too small to feed the MXU efficiently;
+- parallelism degree is capped by n_heads (p must divide it); ring
+  attention has no such cap.
+
+Everything outside attention (loss, positions, sharding, trainer) is shared
+with the ring path — the attention function is the only moving part, which
+is exactly the injectable-``attn_fn`` design of ``models/transformer.py``.
+
+The reference has no sequence axis (SURVEY.md §5.7); both SP paths are
+capability extensions built on XLA collectives over ICI.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import optax
+from jax.sharding import Mesh
+
+from distributed_ml_pytorch_tpu.models.transformer import default_attn_fn
+from distributed_ml_pytorch_tpu.parallel.seq_parallel import (
+    make_sp_eval_fn,
+    make_sp_train_step,
+)
+
+
+def ulysses_attention(q, k, v, axis: str, axis_size: int):
+    """Exact causal attention over a sequence sharded on mesh axis ``axis``.
+
+    Inside ``shard_map``, ``q``/``k``/``v`` are local ``(b, h, S/p, hd)``
+    chunks with all heads. Two tiled ``all_to_all``s bracket the compute:
+
+    1. split the head axis p ways, concatenate the sequence axis →
+       ``(b, h/p, S, hd)``: full sequence, 1/p of the heads. Chunks
+       concatenate in mesh-axis order, which is global sequence order
+       (``shard_lm_batch`` shards the sequence contiguously), so causal
+       masking over the gathered axis is exact;
+    2. run the ordinary blockwise causal kernel (``ops/attention.py``) —
+       attention is embarrassingly parallel over heads;
+    3. the inverse ``all_to_all`` (split sequence, concatenate heads)
+       restores ``(b, h, S/p, hd)`` for the position-local residual/MLP.
+    """
+    if axis_size == 1:
+        return default_attn_fn(q, k, v)
+    if q.shape[1] % axis_size:
+        raise ValueError(
+            f"n_heads={q.shape[1]} is not divisible by the sequence axis size "
+            f"{axis_size} — Ulysses shards attention over heads"
+        )
+    a2a = partial(jax.lax.all_to_all, axis_name=axis, tiled=True)
+    qh, kh, vh = (a2a(t, split_axis=1, concat_axis=2) for t in (q, k, v))
+    out = default_attn_fn(qh, kh, vh)  # (b, h/p, S, hd), causal
+    return a2a(out, split_axis=2, concat_axis=1)
+
+
+def _bind_ulysses(model, seq_axis: str, p: int):
+    if model.n_heads % p:
+        raise ValueError(
+            f"n_heads={model.n_heads} must be divisible by the '{seq_axis}' "
+            f"axis size {p} for Ulysses sequence parallelism (use the ring "
+            f"path, parallel/seq_parallel.py, when it is not)"
+        )
+    return model.clone(
+        attn_fn=partial(ulysses_attention, axis=seq_axis, axis_size=p)
+    )
+
+
+def make_ulysses_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+) -> Callable:
+    """Jitted dp×sp LM step with Ulysses attention:
+    ``(state, tokens, targets) → (state, loss)``.
+
+    Drop-in interchangeable with ``seq_parallel.make_sp_train_step`` — it IS
+    that step (same sharding via ``seq_parallel.shard_lm_batch``, same exact
+    global masked-mean loss, same replicated/donated state) with only the
+    attention binder swapped, so a trainer can pick per run whichever
+    communication pattern wins on the current (S, p, n_heads) point.
+    """
+    return make_sp_train_step(
+        model, tx, mesh, data_axis, seq_axis, attn_binder=_bind_ulysses
+    )
+
+
+def make_ulysses_eval_fn(
+    model, mesh: Mesh, data_axis: str = "data", seq_axis: str = "seq"
+) -> Callable:
+    """Cached jitted eval under Ulysses attention — same loss definition as
+    ``seq_parallel.make_sp_eval_fn`` so ring/Ulysses losses are comparable."""
+    return make_sp_eval_fn(
+        model, mesh, data_axis, seq_axis, attn_binder=_bind_ulysses
+    )

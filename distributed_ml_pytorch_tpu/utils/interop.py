@@ -135,12 +135,15 @@ def load_torch_state_dict(
     for i in order:
         path, leaf = flat[i]
         names = names_of(path)
+        # _convert_leaf only reads the flax leaf's shape; fetch the host copy
+        # once per leaf, not once per candidate tensor probe
+        leaf_np = np.asarray(leaf)
         converted = None
         for j in range(len(tensors)):
             if used[j]:
                 continue
             try:
-                converted = _convert_leaf(names, np.asarray(leaf), tensors[j])
+                converted = _convert_leaf(names, leaf_np, tensors[j])
             except ValueError:
                 continue
             used[j] = True
@@ -148,7 +151,7 @@ def load_torch_state_dict(
         if converted is None:
             raise ValueError(
                 "no state_dict tensor matches flax param {} with shape {}".format(
-                    "/".join(names), np.asarray(leaf).shape
+                    "/".join(names), leaf_np.shape
                 )
             )
         out_leaves[i] = converted
