@@ -45,6 +45,9 @@ p2p:
 bench:
 	$(PY) bench.py
 
+bench-all:
+	$(PY) bench_all.py
+
 test:
 	$(PY) -m pytest tests/ -x -q
 
@@ -60,4 +63,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch single tpu gpu sync local-sgd p2p bench test graph install dist
+.PHONY: first second server launch single tpu gpu sync local-sgd p2p bench bench-all test graph install dist

@@ -36,19 +36,22 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def make_batch(batch: int, seed: int = 0, k: int = 0):
-    """Synthetic CIFAR-shaped batch; ``k > 0`` stacks k distinct microbatches
-    on a leading axis (for the scanned trainer)."""
+def make_batch(batch: int, seed: int = 0, k: int = 0,
+               shape: tuple = (32, 32, 3), n_classes: int = 10):
+    """Synthetic image batch (CIFAR-shaped by default); ``k > 0`` stacks k
+    distinct microbatches on a leading axis (for the scanned trainer)."""
     rng = np.random.default_rng(seed)
     n = (k or 1) * batch
-    images = rng.normal(size=(n, 32, 32, 3)).astype(np.float32)
-    labels = (np.arange(n) % 10).astype(np.int32)
+    images = rng.normal(size=(n, *shape)).astype(np.float32)
+    labels = (np.arange(n) % n_classes).astype(np.int32)
     if k:
-        return images.reshape(k, batch, 32, 32, 3), labels.reshape(k, batch)
+        return images.reshape(k, batch, *shape), labels.reshape(k, batch)
     return images, labels
 
 
-def bench_jax(batch: int = BATCH, k: int = SCAN_K) -> float:
+def bench_jax(batch: int = BATCH, k: int = SCAN_K, model=None,
+              input_shape: tuple = (32, 32, 3), n_classes: int = 10,
+              n_long: int | None = None, trials: int | None = None) -> float:
     """Steady-state images/sec of the scanned AlexNet trainer on the default
     device.
 
@@ -74,14 +77,20 @@ def bench_jax(batch: int = BATCH, k: int = SCAN_K) -> float:
     # the RTT-differencing machinery exists for the tunneled TPU; on a local
     # CPU/GPU device a fraction of the workload measures the same thing in
     # seconds instead of tens of minutes
-    n_short, n_long, trials = N_SHORT, N_LONG, TRIALS
+    n_short = N_SHORT
     if jax.devices()[0].platform != "tpu":
-        k, n_long, trials = 10, 3, 2
+        if k == SCAN_K:  # shrink only the default workload, not a caller's k
+            k = 10
+        n_long, trials = n_long or 3, trials or 2
+    else:
+        n_long, trials = n_long or N_LONG, trials or TRIALS
 
-    model = AlexNet(num_classes=10)
-    state, tx = create_train_state(model, jax.random.key(0), lr=LR)
+    model = model if model is not None else AlexNet(num_classes=10)
+    state, tx = create_train_state(
+        model, jax.random.key(0), lr=LR, sample_shape=(1, *input_shape)
+    )
     train_scan = make_scan_train_step(model, tx)
-    images, labels = make_batch(batch, k=k)
+    images, labels = make_batch(batch, k=k, shape=input_shape, n_classes=n_classes)
     images = jax.device_put(images)
     labels = jax.device_put(labels)
     rng = jax.random.key(1)

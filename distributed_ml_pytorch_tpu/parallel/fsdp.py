@@ -49,8 +49,8 @@ from distributed_ml_pytorch_tpu.training.trainer import (
 
 def fsdp_specs(tree, axis_size: int, axis: str = "data"):
     """Shape-based FSDP ``PartitionSpec`` tree: shard each leaf's largest
-    dimension that divides the axis size; replicate leaves with no such
-    dimension (scalars, small biases, odd shapes).
+    dimension that is divisible by the axis size; replicate leaves with no
+    such dimension (scalars, small biases, odd shapes).
 
     The rule is purely shape-driven, so one function covers any model family
     (CNN kernels, transformer denses, embeddings) *and* whole ``TrainState``
@@ -107,6 +107,38 @@ def create_fsdp_train_state(
     return state, shardings
 
 
+def _make_fsdp_step(
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    shardings,
+    batch_spec: P,
+    loss_builder: Callable,
+    n_batch_args: int,
+) -> Callable:
+    """Shared FSDP step factory: the value_and_grad → update → replace body
+    and the jit sharding/donation wiring, parameterized by the loss.
+
+    ``loss_builder(state, *batch) -> loss_fn(params)`` closes over the batch;
+    everything else — weight all-gather, gradient reduce-scatter, in-place
+    donated state — is inserted by the partitioner from ``shardings``.
+    """
+    batch_sharding = NamedSharding(mesh, batch_spec)
+    rep = NamedSharding(mesh, P())
+
+    def step(state: TrainState, *batch):
+        loss, grads = jax.value_and_grad(loss_builder(state, *batch))(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(params=params, opt_state=opt_state, step=state.step + 1), loss
+
+    return jax.jit(
+        step,
+        in_shardings=(shardings,) + (batch_sharding,) * 2 + (rep,) * (n_batch_args - 2),
+        out_shardings=(shardings, rep),
+        donate_argnums=(0,),
+    )
+
+
 def make_fsdp_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -125,10 +157,8 @@ def make_fsdp_train_step(
     shardings. Semantically identical to ``make_sync_train_step`` (same
     global-mean gradient, same update); only the memory layout differs.
     """
-    batch_sharding = NamedSharding(mesh, P(axis))
-    rep = NamedSharding(mesh, P())
 
-    def step(state: TrainState, images, labels, rng):
+    def loss_builder(state, images, labels, rng):
         step_rng = jax.random.fold_in(rng, state.step)
 
         def loss_fn(params):
@@ -137,17 +167,9 @@ def make_fsdp_train_step(
             )
             return cross_entropy_loss(logits, labels)
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return state.replace(params=params, opt_state=opt_state, step=state.step + 1), loss
+        return loss_fn
 
-    return jax.jit(
-        step,
-        in_shardings=(shardings, batch_sharding, batch_sharding, rep),
-        out_shardings=(shardings, rep),
-        donate_argnums=(0,),
-    )
+    return _make_fsdp_step(tx, mesh, shardings, P(axis), loss_builder, 3)
 
 
 def make_fsdp_lm_train_step(
@@ -164,27 +186,17 @@ def make_fsdp_lm_train_step(
     (``seq_parallel.next_token_targets``: the final position is masked by
     position), so dp/sp/tp/fsdp runs are comparable on the same data.
     """
-    batch_sharding = NamedSharding(mesh, P(axis, None))
-    rep = NamedSharding(mesh, P())
 
-    def step(state: TrainState, tokens, targets):
+    def loss_builder(state, tokens, targets):
         def loss_fn(params):
             logits = model.apply({"params": params}, tokens)
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
             mask = jnp.ones_like(ce).at[:, -1].set(0.0)
             return jnp.sum(ce * mask) / jnp.sum(mask)
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return state.replace(params=params, opt_state=opt_state, step=state.step + 1), loss
+        return loss_fn
 
-    return jax.jit(
-        step,
-        in_shardings=(shardings, batch_sharding, batch_sharding),
-        out_shardings=(shardings, rep),
-        donate_argnums=(0,),
-    )
+    return _make_fsdp_step(tx, mesh, shardings, P(axis, None), loss_builder, 2)
 
 
 def shard_fsdp_batch(mesh: Mesh, *arrays, axis: str = "data"):
