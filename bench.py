@@ -49,7 +49,7 @@ def make_batch(batch: int, seed: int = 0, k: int = 0,
     return images, labels
 
 
-def bench_jax(batch: int = BATCH, k: int = SCAN_K, model=None,
+def bench_jax(batch: int = BATCH, k: int | None = None, model=None,
               input_shape: tuple = (32, 32, 3), n_classes: int = 10,
               n_long: int | None = None, trials: int | None = None) -> float:
     """Steady-state images/sec of the scanned AlexNet trainer on the default
@@ -79,10 +79,11 @@ def bench_jax(batch: int = BATCH, k: int = SCAN_K, model=None,
     # seconds instead of tens of minutes
     n_short = N_SHORT
     if jax.devices()[0].platform != "tpu":
-        if k == SCAN_K:  # shrink only the default workload, not a caller's k
+        if k is None:  # shrink only the default workload, not a caller's k
             k = 10
         n_long, trials = n_long or 3, trials or 2
     else:
+        k = SCAN_K if k is None else k
         n_long, trials = n_long or N_LONG, trials or TRIALS
 
     model = model if model is not None else AlexNet(num_classes=10)

@@ -45,8 +45,18 @@ from distributed_ml_pytorch_tpu.parallel.ulysses import (
     make_ulysses_train_step,
     ulysses_attention,
 )
+from distributed_ml_pytorch_tpu.parallel.composite import (
+    composite_specs,
+    create_composite_train_state,
+    make_composite_train_step,
+    shard_composite_batch,
+)
 
 __all__ = [
+    "composite_specs",
+    "create_composite_train_state",
+    "make_composite_train_step",
+    "shard_composite_batch",
     "create_fsdp_train_state",
     "fsdp_specs",
     "make_fsdp_lm_train_step",

@@ -1,0 +1,144 @@
+"""Composite 3-D sharding: data × FSDP × tensor parallelism on one mesh.
+
+The individual strategies each own a module (``sync.py`` dp, ``fsdp.py``
+zero-3, ``tensor_parallel.py`` megatron tp); real large-model training runs
+them *together* on one mesh — the scaling-book recipe: a ``(data, fsdp,
+model)`` mesh where
+
+- the batch is sharded over BOTH ``data`` and ``fsdp`` (they are one big
+  data-parallel group, split only by how parameters are laid out along it),
+- parameters carry Megatron column/row specs over ``model``
+  (``tensor_parallel.tp_param_specs``) and are additionally sharded over
+  ``fsdp`` along their largest still-unsharded dimension
+  (:func:`composite_specs`), optimizer state mirroring both,
+- XLA's partitioner derives every collective from those annotations: tp
+  all-reduces over ``model``, weight all-gathers + gradient reduce-scatters
+  over ``fsdp``, gradient all-reduce over ``data`` — this module contains
+  zero hand-written collectives.
+
+This is deliberately the pjit idiom end-state: the same ``TransformerLM``,
+the same loss as the sp/tp/fsdp paths, and the *entire* parallelization
+strategy expressed as one spec tree. The reference framework has only
+replicated async data parallelism (SURVEY.md §2.4); this is the capability
+that makes the TPU framework's distributed story first-class.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_ml_pytorch_tpu.parallel.fsdp import (
+    lm_loss_builder,
+    make_sharded_step,
+)
+from distributed_ml_pytorch_tpu.parallel.tensor_parallel import (
+    _check_divisibility,
+    tp_param_specs,
+)
+from distributed_ml_pytorch_tpu.training.trainer import TrainState
+
+
+def composite_specs(
+    tree,
+    fsdp_size: int,
+    model_axis: str = "model",
+    fsdp_axis: str = "fsdp",
+):
+    """Merge Megatron tp specs with FSDP sharding into one spec tree.
+
+    Start from ``tp_param_specs`` (column/row sharding over ``model_axis``),
+    then for every leaf shard its largest dimension NOT already claimed by
+    ``model_axis`` over ``fsdp_axis``, provided that dimension is divisible
+    by ``fsdp_size`` — the same shape rule as ``fsdp.fsdp_specs``, applied to
+    the dims tp left alone. Leaves with no eligible dimension keep their tp
+    spec (replicated or model-sharded only).
+    """
+    tp_specs = tp_param_specs(tree, model_axis)
+
+    def merge(leaf, spec: P) -> P:
+        shape = getattr(leaf, "shape", ())
+        ndim = len(shape)
+        if ndim == 0:
+            return spec
+        entries = list(spec) + [None] * (ndim - len(spec))
+        order = sorted(
+            (i for i in range(ndim) if entries[i] is None),
+            key=lambda i: (shape[i], i),
+            reverse=True,
+        )
+        for i in order:
+            if shape[i] >= fsdp_size and shape[i] % fsdp_size == 0:
+                entries[i] = fsdp_axis
+                return P(*entries)
+        return spec
+
+    return jax.tree.map(
+        merge, tree, tp_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def create_composite_train_state(
+    model,
+    rng: jax.Array,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    model_axis: str = "model",
+    fsdp_axis: str = "fsdp",
+    sample_len: int = 8,
+):
+    """Init a ``TrainState`` laid out per :func:`composite_specs` — created
+    already sharded (jit with ``out_shardings``), so no device ever holds a
+    full parameter copy. Returns ``(state, shardings)``."""
+    _check_divisibility(model, int(mesh.shape[model_axis]))
+    dummy = jnp.zeros((1, sample_len), jnp.int32)
+
+    def init_fn(rng):
+        params = model.init(rng, dummy)["params"]
+        return TrainState.create(params, tx)
+
+    state_shapes = jax.eval_shape(init_fn, rng)
+    specs = composite_specs(
+        state_shapes, int(mesh.shape[fsdp_axis]), model_axis, fsdp_axis
+    )
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    state = jax.jit(init_fn, out_shardings=shardings)(rng)
+    return state, shardings
+
+
+def make_composite_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    shardings,
+    data_axis: str = "data",
+    fsdp_axis: str = "fsdp",
+) -> Callable:
+    """Jitted 3-D (dp×fsdp×tp) LM step: ``(state, tokens, targets) → (state, loss)``.
+
+    Delegates to ``fsdp.make_sharded_step`` with ``fsdp.lm_loss_builder`` —
+    literally the same update body and LM loss as the fsdp-LM path, with the
+    batch sharded over the combined ``(data, fsdp)`` axes; the entire
+    difference between fsdp and 3-D composite training is the spec tree.
+    """
+    return make_sharded_step(
+        tx, mesh, shardings, P((data_axis, fsdp_axis), None),
+        lm_loss_builder(model), 2,
+    )
+
+
+def shard_composite_batch(
+    mesh: Mesh, tokens, targets, data_axis: str = "data", fsdp_axis: str = "fsdp"
+):
+    """Place a host (batch, seq) pair on the 3-D mesh: batch over data×fsdp."""
+    from distributed_ml_pytorch_tpu.parallel.sync import put_sharded
+
+    spec = P((data_axis, fsdp_axis), None)
+    return put_sharded(mesh, tokens, spec), put_sharded(mesh, targets, spec)

@@ -107,7 +107,7 @@ def create_fsdp_train_state(
     return state, shardings
 
 
-def _make_fsdp_step(
+def make_sharded_step(
     tx: optax.GradientTransformation,
     mesh: Mesh,
     shardings,
@@ -115,8 +115,11 @@ def _make_fsdp_step(
     loss_builder: Callable,
     n_batch_args: int,
 ) -> Callable:
-    """Shared FSDP step factory: the value_and_grad → update → replace body
-    and the jit sharding/donation wiring, parameterized by the loss.
+    """Shared GSPMD step factory: the value_and_grad → update → replace body
+    and the jit sharding/donation wiring, parameterized by the loss — used by
+    both FSDP steps here and the 3-D composite step
+    (``parallel/composite.py``), so the update semantics cannot diverge
+    between the annotation-driven paths.
 
     ``loss_builder(state, *batch) -> loss_fn(params)`` closes over the batch;
     everything else — weight all-gather, gradient reduce-scatter, in-place
@@ -169,7 +172,7 @@ def make_fsdp_train_step(
 
         return loss_fn
 
-    return _make_fsdp_step(tx, mesh, shardings, P(axis), loss_builder, 3)
+    return make_sharded_step(tx, mesh, shardings, P(axis), loss_builder, 3)
 
 
 def make_fsdp_lm_train_step(
@@ -187,6 +190,17 @@ def make_fsdp_lm_train_step(
     position), so dp/sp/tp/fsdp runs are comparable on the same data.
     """
 
+    return make_sharded_step(
+        tx, mesh, shardings, P(axis, None), lm_loss_builder(model), 2
+    )
+
+
+def lm_loss_builder(model) -> Callable:
+    """The shared LM loss (final position masked by position, the
+    ``seq_parallel.next_token_targets`` convention) as a
+    :func:`make_sharded_step` loss builder — one definition for the fsdp-LM
+    and composite paths."""
+
     def loss_builder(state, tokens, targets):
         def loss_fn(params):
             logits = model.apply({"params": params}, tokens)
@@ -196,7 +210,7 @@ def make_fsdp_lm_train_step(
 
         return loss_fn
 
-    return _make_fsdp_step(tx, mesh, shardings, P(axis, None), loss_builder, 2)
+    return loss_builder
 
 
 def shard_fsdp_batch(mesh: Mesh, *arrays, axis: str = "data"):

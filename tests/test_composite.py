@@ -1,0 +1,114 @@
+"""3-D composite sharding (data × fsdp × tensor) on the 8-device virtual mesh.
+
+The whole strategy is one spec tree; correctness means the 2×2×2-sharded
+training trajectory is numerically the unsharded one, while parameters are
+genuinely distributed over fsdp×model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_ml_pytorch_tpu.models import TransformerLM
+from distributed_ml_pytorch_tpu.parallel.composite import (
+    composite_specs,
+    create_composite_train_state,
+    make_composite_train_step,
+    shard_composite_batch,
+)
+from distributed_ml_pytorch_tpu.parallel.seq_parallel import next_token_targets
+from distributed_ml_pytorch_tpu.runtime.mesh import make_mesh
+from distributed_ml_pytorch_tpu.training.trainer import TrainState
+
+
+@pytest.fixture(scope="module")
+def mesh222():
+    return make_mesh({"data": 2, "fsdp": 2, "model": 2})
+
+
+def _lm():
+    return TransformerLM(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                         d_ff=64, max_len=64)
+
+
+def test_composite_specs_merge_tp_and_fsdp():
+    tree = {
+        "attn_q": jnp.zeros((32, 32)),   # tp: P(None, model) → fsdp takes dim 0
+        "ln_scale": jnp.zeros((32,)),    # tp: P() → fsdp takes dim 0
+        "tiny": jnp.zeros((3,)),         # nothing divisible → untouched
+    }
+    # fake tp specs by path rules won't trigger on these names → all P();
+    # the merge rule alone is what's under test for ln_scale/tiny
+    specs = composite_specs(tree, fsdp_size=2)
+    assert specs["ln_scale"] == P("fsdp")
+    assert specs["tiny"] == P()
+    # attn_q has no 'attn' path component here, so tp leaves it replicated
+    # and fsdp shards its largest dim (ties → trailing dim)
+    assert specs["attn_q"] == P(None, "fsdp")
+
+
+def test_composite_step_matches_single_device(mesh222):
+    lm = _lm()
+    tx = optax.sgd(0.05, momentum=0.9)
+    state_c, shardings = create_composite_train_state(
+        lm, jax.random.key(0), tx, mesh222
+    )
+
+    def init_fn(rng):
+        params = lm.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+        return TrainState.create(params, tx)
+
+    state_s = init_fn(jax.random.key(0))
+
+    tokens = np.random.default_rng(0).integers(0, 64, size=(8, 32)).astype(np.int32)
+    targets = next_token_targets(tokens)
+
+    comp_step = make_composite_train_step(lm, tx, mesh222, shardings)
+
+    @jax.jit
+    def single_step(state, tokens, targets):
+        def loss_fn(params):
+            logits = lm.apply({"params": params}, tokens)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+            mask = jnp.ones_like(ce).at[:, -1].set(0.0)
+            return jnp.sum(ce * mask) / jnp.sum(mask)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(params=params, opt_state=opt_state,
+                             step=state.step + 1), loss
+
+    tok_c, tgt_c = shard_composite_batch(mesh222, tokens, targets)
+    for _ in range(3):
+        state_s, loss_s = single_step(state_s, tokens, targets)
+        state_c, loss_c = comp_step(state_c, tok_c, tgt_c)
+        np.testing.assert_allclose(float(loss_s), float(loss_c), rtol=2e-5)
+
+    for a, b in zip(jax.tree.leaves(state_s.params), jax.tree.leaves(state_c.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=2e-6)
+
+
+def test_composite_params_are_distributed(mesh222):
+    """Every tp-ruled 2-D kernel (attn projections, MLP denses, lm_head) must
+    be sharded on BOTH the fsdp and model axes (4× memory reduction); every
+    other large leaf must at least be fsdp-sharded."""
+    lm = _lm()
+    state, shardings = create_composite_train_state(
+        lm, jax.random.key(1), optax.sgd(0.1), mesh222
+    )
+    flat = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    checked_both = 0
+    for path, leaf in flat:
+        if getattr(leaf, "ndim", 0) != 2 or leaf.shape[0] < 32:
+            continue
+        joined = "/".join(getattr(k, "key", str(k)) for k in path)
+        spec = leaf.sharding.spec
+        names = {s for s in spec if s is not None}
+        assert "fsdp" in names, f"{joined} not fsdp-sharded: {spec}"
+        if any(t in joined for t in ("attn", "Dense_", "lm_head")):
+            assert "model" in names, f"{joined} lost its tp sharding: {spec}"
+            checked_both += 1
+    assert checked_both >= 4  # q/k/v/o + MLP pairs + lm_head across blocks
