@@ -72,6 +72,17 @@ def tpu_phase() -> None:
              "images/sec", "cpu",
              "reference `make single` recipe re-measured in torch")
 
+    # config 1 (MXU-native leg) — the same flagship with bf16 activations
+    # (f32 params; the framework's --dtype bfloat16 path)
+    import jax.numpy as jnp
+
+    from distributed_ml_pytorch_tpu.models import AlexNet
+
+    ips_bf16 = bench_jax(model=AlexNet(num_classes=10, dtype=jnp.bfloat16))
+    emit(1, "alexnet_cifar10_train_throughput_bf16", ips_bf16,
+         "images/sec/chip", hw,
+         "same recipe with bfloat16 activations feeding the MXU natively")
+
     from distributed_ml_pytorch_tpu.models import get_resnet
 
     # config 4 (per-chip leg) — ResNet-18, CIFAR shapes, batch 64

@@ -67,7 +67,13 @@ def generate(
     b, p = prompt.shape
     total = p + max_new_tokens
     max_len = getattr(model, "max_len", None)
-    if max_len is not None and total > max_len:
+    # RoPE rotates by position instead of indexing a table, so max_len does
+    # not bound its positions — the guard protects only learned embeddings
+    if (
+        max_len is not None
+        and total > max_len
+        and getattr(model, "pos_encoding", "learned") != "rope"
+    ):
         raise ValueError(
             f"prompt + max_new_tokens = {total} exceeds the model's max_len "
             f"{max_len} — position embeddings would go out of range"

@@ -12,8 +12,10 @@ capability extension, not parity). The design is shaped by how it trains:
   module then computes exact full-sequence attention over sharded chunks.
   Nothing else in the model knows the sequence is distributed.
 - **Positions are an input**, not ``arange(seq)``: a device holding chunk
-  ``i`` of a sharded sequence feeds its global positions, so learned
-  position embeddings are correct under sharding.
+  ``i`` of a sharded sequence feeds its global positions, so position
+  encoding is correct under sharding — for the learned table AND for RoPE
+  (``pos_encoding="rope"``), which rotates q/k by global position inside
+  attention before any ring/Ulysses exchange.
 - Pre-LN blocks, GELU MLP, bf16-friendly (dtype threads through every
   dense/embed); weights stay f32 (master copies), activations cast.
 """
@@ -38,6 +40,31 @@ def default_attn_fn(q, k, v):
     return finalize_attention(acc, l).astype(q.dtype)
 
 
+def apply_rope(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding for one projection.
+
+    ``x`` is ``(batch, heads, seq, head_dim)``; ``positions`` carries the
+    GLOBAL position of every token ``(batch, seq)`` or ``(1, seq)`` — the
+    same positions-are-an-input design that makes learned embeddings
+    sharding-transparent makes RoPE exact under sequence sharding: each
+    device rotates its local chunk by its global offsets BEFORE ring/Ulysses
+    attention exchanges anything, and a decode step rotates by the cache
+    cursor's absolute position. Rotation happens in f32 (angles lose
+    precision fast in bf16); the result is cast back to ``x.dtype``.
+    """
+    half = x.shape[-1] // 2
+    if 2 * half != x.shape[-1]:
+        raise ValueError(f"rope needs an even head_dim, got {x.shape[-1]}")
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)  # (half,)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (b, s, half)
+    cos = jnp.cos(angles)[:, None]  # (b, 1, s, half) — broadcast over heads
+    sin = jnp.sin(angles)[:, None]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
 class MultiHeadAttention(nn.Module):
     """Causal MHA; with ``decode=True`` it maintains a K/V cache (flax
     ``"cache"`` collection) for incremental autoregressive decoding: each call
@@ -50,14 +77,20 @@ class MultiHeadAttention(nn.Module):
     attn_fn: Optional[Callable] = None
     decode: bool = False
     cache_size: int = 0
+    rope: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None):
         b, s, _ = x.shape
         head_dim = self.d_model // self.n_heads
         proj = lambda name: nn.Dense(self.d_model, use_bias=False, dtype=self.dtype, name=name)
         split = lambda t: t.reshape(b, s, self.n_heads, head_dim).transpose(0, 2, 1, 3)
         q, k, v = (split(proj(n)(x)) for n in ("q", "k", "v"))
+        if self.rope:
+            if positions is None:
+                raise ValueError("rope=True needs the tokens' global positions")
+            q = apply_rope(q, positions)
+            k = apply_rope(k, positions)  # cached k (decode) is stored rotated
         if self.decode:
             if self.attn_fn is not None:
                 raise ValueError(
@@ -104,14 +137,16 @@ class Block(nn.Module):
     attn_fn: Optional[Callable] = None
     decode: bool = False
     cache_size: int = 0
+    rope: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None):
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + MultiHeadAttention(
             self.d_model, self.n_heads, self.dtype, self.attn_fn,
-            decode=self.decode, cache_size=self.cache_size, name="attn",
-        )(h)
+            decode=self.decode, cache_size=self.cache_size, rope=self.rope,
+            name="attn",
+        )(h, positions)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.d_ff, dtype=self.dtype)(h)
         h = nn.gelu(h)
@@ -134,13 +169,18 @@ class TransformerLM(nn.Module):
     decode: bool = False
     cache_size: int = 0
     remat: bool = False
+    pos_encoding: str = "learned"  # "learned" (table) | "rope" (rotary in-attn)
 
     @nn.compact
     def __call__(self, tokens, positions=None):
+        if self.pos_encoding not in ("learned", "rope"):
+            raise ValueError(f"unknown pos_encoding {self.pos_encoding!r}")
+        use_rope = self.pos_encoding == "rope"
         if positions is None:
             positions = jnp.arange(tokens.shape[-1])[None, :]
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="tok_embed")(tokens)
-        x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype, name="pos_embed")(positions)
+        if not use_rope:
+            x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype, name="pos_embed")(positions)
         # remat: recompute each block's intra-block intermediates (attention
         # scores, d_ff tensors) in the backward pass instead of keeping them
         # in HBM; only the n_layers block-boundary residuals stay resident —
@@ -150,8 +190,8 @@ class TransformerLM(nn.Module):
         for i in range(self.n_layers):
             x = block_cls(
                 self.d_model, self.n_heads, self.d_ff, self.dtype, self.attn_fn,
-                decode=self.decode, cache_size=self.cache_size,
+                decode=self.decode, cache_size=self.cache_size, rope=use_rope,
                 name=f"block_{i}",
-            )(x)
+            )(x, positions)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head")(x)

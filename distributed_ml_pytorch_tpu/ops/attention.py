@@ -15,6 +15,14 @@ module is the long-context foundation the TPU framework adds as first-class:
   ``parallel/ring.py``), compiled by XLA, numerically identical.
 - ``attention_reference`` — the naive softmax(QKᵀ)V for tests.
 
+Why ``blockwise_attention`` (not the Pallas kernel) is the model default:
+measured on the real chip (v5 lite, causal, b=1 h=4 S=4096 d=64, differenced
+chained-dispatch timing), the XLA-compiled scan runs ~0.18 ms/call vs
+~1.2 ms for the dense reference and ~1.3 ms for ``flash_attention`` — XLA's
+fusion of the scan body already achieves the flash memory behavior and
+schedules the MXU better than this hand-written grid. The Pallas kernel
+stays as the explicit-kernel path (and the template for ops XLA can't fuse).
+
 All take ``(batch, heads, seq, head_dim)`` and an optional causal mask.
 ``NEG_INF`` is a large-finite mask value rather than ``-inf`` so fully-masked
 rows (which ring attention produces on not-yet-arrived chunks) stay NaN-free;

@@ -33,6 +33,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_ml_pytorch_tpu.parallel.fsdp import (
+    largest_shardable_dim,
     lm_loss_builder,
     make_sharded_step,
 )
@@ -66,16 +67,12 @@ def composite_specs(
         if ndim == 0:
             return spec
         entries = list(spec) + [None] * (ndim - len(spec))
-        order = sorted(
-            (i for i in range(ndim) if entries[i] is None),
-            key=lambda i: (shape[i], i),
-            reverse=True,
-        )
-        for i in order:
-            if shape[i] >= fsdp_size and shape[i] % fsdp_size == 0:
-                entries[i] = fsdp_axis
-                return P(*entries)
-        return spec
+        taken = tuple(i for i in range(ndim) if entries[i] is not None)
+        i = largest_shardable_dim(shape, fsdp_size, taken)
+        if i is None:
+            return spec
+        entries[i] = fsdp_axis
+        return P(*entries)
 
     return jax.tree.map(
         merge, tree, tp_specs, is_leaf=lambda x: isinstance(x, P)

@@ -61,20 +61,33 @@ def fsdp_specs(tree, axis_size: int, axis: str = "data"):
 
     def spec_for(leaf) -> P:
         shape = getattr(leaf, "shape", ())
-        ndim = len(shape)
-        if ndim == 0:
+        if not shape:
             return P()
-        # largest dim first; ties broken toward the trailing (lane) dim,
-        # which XLA tiles most efficiently
-        order = sorted(range(ndim), key=lambda i: (shape[i], i), reverse=True)
-        for i in order:
-            if shape[i] >= axis_size and shape[i] % axis_size == 0:
-                spec = [None] * ndim
-                spec[i] = axis
-                return P(*spec)
-        return P()
+        i = largest_shardable_dim(shape, axis_size)
+        if i is None:
+            return P()
+        spec = [None] * len(shape)
+        spec[i] = axis
+        return P(*spec)
 
     return jax.tree.map(spec_for, tree)
+
+
+def largest_shardable_dim(shape, axis_size: int, taken=()) -> int | None:
+    """Index of the largest dimension divisible by ``axis_size`` that is not
+    already claimed (``taken``), or None. Ties break toward the trailing
+    (lane) dim, which XLA tiles most efficiently. The single dim-selection
+    policy shared by :func:`fsdp_specs` and ``composite.composite_specs`` so
+    the two paths cannot diverge."""
+    order = sorted(
+        (i for i in range(len(shape)) if i not in taken),
+        key=lambda i: (shape[i], i),
+        reverse=True,
+    )
+    for i in order:
+        if shape[i] >= axis_size and shape[i] % axis_size == 0:
+            return i
+    return None
 
 
 def _state_shardings(mesh: Mesh, state_shapes, axis: str):

@@ -1,0 +1,137 @@
+"""Rotary position embeddings: relative-position property, sharding
+transparency (RoPE must be exact under ring/Ulysses sequence sharding because
+rotation uses global positions before any exchange), and cached decode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_ml_pytorch_tpu.models.generate import init_cache
+from distributed_ml_pytorch_tpu.models.transformer import TransformerLM, apply_rope
+from distributed_ml_pytorch_tpu.parallel.seq_parallel import (
+    create_lm_train_state,
+    make_sp_train_step,
+    next_token_targets,
+    shard_lm_batch,
+)
+from distributed_ml_pytorch_tpu.runtime.mesh import make_mesh
+
+
+def rope_lm(**kw):
+    cfg = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+               max_len=128, pos_encoding="rope")
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+def test_rope_scores_depend_only_on_relative_position():
+    """q·k after rotation must be invariant to shifting both positions by a
+    constant — the property that makes RoPE extrapolate."""
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(1, 2, 8, 16)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(1, 2, 8, 16)).astype(np.float32))
+    pos = jnp.arange(8)[None, :]
+    scores = jnp.einsum("bhsd,bhtd->bhst", apply_rope(q, pos), apply_rope(k, pos))
+    shifted = jnp.einsum(
+        "bhsd,bhtd->bhst", apply_rope(q, pos + 37), apply_rope(k, pos + 37)
+    )
+    np.testing.assert_allclose(np.asarray(scores), np.asarray(shifted),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_rope_requires_even_head_dim():
+    q = jnp.zeros((1, 1, 4, 5))
+    with pytest.raises(ValueError, match="even head_dim"):
+        apply_rope(q, jnp.arange(4)[None, :])
+
+
+def test_rope_model_has_no_position_table():
+    lm = rope_lm()
+    params = lm.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    assert "pos_embed" not in params
+    # and the learned variant does have one
+    learned = TransformerLM(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_len=128)
+    lparams = learned.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    assert "pos_embed" in lparams
+
+
+def test_rope_sp_training_matches_single_device():
+    """Ring-attention SP over a rope model == unsharded training: each chunk
+    rotates by its global offsets, so the sharded math is identical."""
+    mesh = make_mesh({"data": 2, "seq": 4})
+    lm = rope_lm()
+    tx = optax.sgd(0.05)
+    state_p = create_lm_train_state(lm, jax.random.key(0), tx)
+    state_s = create_lm_train_state(lm, jax.random.key(0), tx)
+
+    tokens = np.random.default_rng(1).integers(0, 64, size=(4, 64)).astype(np.int32)
+    targets = next_token_targets(tokens)
+    tok, tgt = shard_lm_batch(mesh, tokens, targets)
+    sp_step = make_sp_train_step(lm, tx, mesh)
+
+    @jax.jit
+    def single_step(state, tokens, targets):
+        def loss_fn(params):
+            logits = lm.apply({"params": params}, tokens)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+            mask = jnp.ones_like(ce).at[:, -1].set(0.0)
+            return jnp.sum(ce * mask) / jnp.sum(mask)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(params=params, opt_state=opt_state,
+                             step=state.step + 1), loss
+
+    for _ in range(2):
+        state_s, loss_s = single_step(state_s, tokens, targets)
+        state_p, loss_p = sp_step(state_p, tok, tgt)
+        np.testing.assert_allclose(float(loss_s), float(loss_p), rtol=1e-5)
+
+    for a, b in zip(jax.tree.leaves(state_s.params), jax.tree.leaves(state_p.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6)
+
+
+def test_rope_generate_extends_past_max_len():
+    """RoPE has no position table, so decoding past max_len is legal (the
+    learned-embedding guard in generate() must not fire)."""
+    from distributed_ml_pytorch_tpu.models.generate import generate
+
+    model = rope_lm(max_len=16)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    prompt = jnp.asarray(
+        np.random.default_rng(2).integers(0, 64, size=(1, 12)), jnp.int32
+    )
+    out = generate(model, params, prompt, max_new_tokens=8)  # total 20 > 16
+    assert out.shape == (1, 20)
+
+
+def test_rope_incremental_decode_matches_full_forward():
+    """Cached decode stores ROTATED keys; step-by-step logits must equal the
+    full causal forward at every position."""
+    model = rope_lm(max_len=64)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(2, 10)), jnp.int32
+    )
+    full_logits = model.apply({"params": params}, tokens)
+
+    dec = model.clone(decode=True, cache_size=10, attn_fn=None)
+    cache = init_cache(model, 2, 10)
+    got = []
+    for t in range(10):
+        logits, mutated = dec.apply(
+            {"params": params, "cache": cache},
+            tokens[:, t : t + 1],
+            jnp.full((2, 1), t, jnp.int32),
+            mutable=["cache"],
+        )
+        cache = mutated["cache"]
+        got.append(logits[:, 0])
+    got = jnp.stack(got, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(full_logits), rtol=2e-4, atol=2e-5
+    )
