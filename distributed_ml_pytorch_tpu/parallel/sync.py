@@ -120,14 +120,25 @@ def make_sync_train_step(
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def train_sync(args, mesh: Mesh | None = None) -> Tuple[TrainState, MetricsLogger]:
-    """Synchronous data-parallel training loop.
+def train_data_parallel(
+    args,
+    mesh: Mesh | None,
+    strategy: Callable,
+    label: str,
+) -> Tuple[TrainState, MetricsLogger]:
+    """Shared data-parallel training driver (sync-DP and FSDP).
 
     ``--batch-size`` is the **per-device** batch (matching the reference's
     per-worker batch of 64, ``example/main.py:142``); the global batch is
     ``batch_size × mesh size``. Each epoch reshuffles; on multi-host meshes
     every controller loads only its strided shard of the training set and
     feeds its per-process slice of each global batch.
+
+    ``strategy(model, tx, mesh, state) -> (state, sharded_step, suffix)``
+    owns everything layout-specific: placing the (possibly ckpt-restored)
+    state on the mesh, and wrapping the jitted step so it shards each host
+    batch itself. Everything else — data, model, LR schedule, grad accum,
+    checkpoint/resume, the epoch loop, telemetry — is one copy here.
     """
     from distributed_ml_pytorch_tpu.data import get_dataset, shard_for_process
     from distributed_ml_pytorch_tpu.models import get_model
@@ -167,22 +178,14 @@ def train_sync(args, mesh: Mesh | None = None) -> Tuple[TrainState, MetricsLogge
         grad_accum=grad_accum,
         optimizer=getattr(args, "optimizer", "sgd"),
     )
-    # restore (if resuming) before replication: orbax then re-places the
-    # restored arrays under the replicated sharding like any fresh init
+    # restore (if resuming) BEFORE mesh placement: orbax hands back host
+    # arrays and the strategy then lays them out like a fresh init
     ckpt, state, start_epoch, start_iter = setup_checkpoint(
         args, state, len(x_train) // per_proc_batch
     )
-    state = replicate(mesh, state)
-    train_step = make_sync_train_step(model, tx, mesh)
+    state, sharded_step, suffix = strategy(model, tx, mesh, state)
     eval_step = make_eval_fn(model)
     logger = MetricsLogger(getattr(args, "log_dir", "log"))
-    rng = replicate(mesh, jax.random.key(getattr(args, "seed", 0) + 1))
-
-    # reuse the shared epoch/skip/checkpoint loop: shard each host batch onto
-    # the mesh in the step wrapper, and iterate per-process-sized batches
-    def sharded_step(state, bx, by, _rng):
-        bx, by = shard_batch(mesh, bx, by)
-        return train_step(state, bx, by, rng)
 
     loop_args = copy.copy(args)
     loop_args.batch_size = per_proc_batch
@@ -208,5 +211,27 @@ def train_sync(args, mesh: Mesh | None = None) -> Tuple[TrainState, MetricsLogge
     finally:
         if ckpt is not None:
             ckpt.close()
-    print("Finished sync-DP training ({:.1f}s, {} devices)".format(time.time() - t0, n_dev))
+    print(
+        "Finished {} training ({:.1f}s, {} devices{})".format(
+            label, time.time() - t0, n_dev, suffix
+        )
+    )
     return state, logger
+
+
+def train_sync(args, mesh: Mesh | None = None) -> Tuple[TrainState, MetricsLogger]:
+    """Synchronous data-parallel training loop (replicated params, in-graph
+    gradient psum) — see :func:`train_data_parallel` for the shared driver."""
+
+    def strategy(model, tx, mesh, state):
+        state = replicate(mesh, state)
+        train_step = make_sync_train_step(model, tx, mesh)
+        rng = replicate(mesh, jax.random.key(getattr(args, "seed", 0) + 1))
+
+        def sharded_step(state, bx, by, _rng):
+            bx, by = shard_batch(mesh, bx, by)
+            return train_step(state, bx, by, rng)
+
+        return state, sharded_step, ""
+
+    return train_data_parallel(args, mesh, strategy, "sync-DP")

@@ -63,9 +63,11 @@ def build_parser() -> argparse.ArgumentParser:
     # --- TPU-era extensions ---
     p.add_argument("--backend", type=str, default="auto", choices=["auto", "tpu", "cpu"],
                    help="compute backend (auto = jax default platform)")
-    p.add_argument("--mode", type=str, default="ps", choices=["ps", "sync", "local-sgd"],
+    p.add_argument("--mode", type=str, default="ps",
+                   choices=["ps", "sync", "local-sgd", "fsdp"],
                    help="distributed strategy: async parameter server (reference core), "
-                        "sync psum allreduce, or compiled local-SGD averaging")
+                        "sync psum allreduce, compiled local-SGD averaging, or "
+                        "fully-sharded data parallel (ZeRO-3: 1/N params per device)")
     p.add_argument("--model", type=str, default="alexnet",
                    choices=["alexnet", "lenet", "resnet18", "resnet50"],
                    help="model architecture (reference hardcodes AlexNet, example/main.py:41)")
@@ -234,20 +236,20 @@ def main(argv=None) -> int:
             print(f"error: --mode ps is unavailable in this build: {e}", file=sys.stderr)
             return 2
         return run_ps_process(args)
-    elif args.mode == "sync":
-        from distributed_ml_pytorch_tpu.parallel.sync import train_sync
-
-        _announce_dataset(args)
-        _state, logger = train_sync(args)
-        path = logger.to_csv("node{}.csv".format(jax.process_index()))
-        print("wrote", path)
-        print("Finished Training")
-        return 0
     else:
-        from distributed_ml_pytorch_tpu.parallel.local_sgd import train_local_sgd
+        # mesh-based modes share one epilogue; each trainer returns
+        # (state, MetricsLogger)
+        if args.mode == "sync":
+            from distributed_ml_pytorch_tpu.parallel.sync import train_sync as train_fn
+        elif args.mode == "fsdp":
+            from distributed_ml_pytorch_tpu.parallel.fsdp import train_fsdp as train_fn
+        else:
+            from distributed_ml_pytorch_tpu.parallel.local_sgd import (
+                train_local_sgd as train_fn,
+            )
 
         _announce_dataset(args)
-        _state, logger = train_local_sgd(args)
+        _state, logger = train_fn(args)
         path = logger.to_csv("node{}.csv".format(jax.process_index()))
         print("wrote", path)
         print("Finished Training")

@@ -87,6 +87,24 @@ def test_fsdp_param_memory_is_actually_sharded(mesh8):
     assert frac < 0.2, f"expected ≈1/8 of params per device, measured {frac:.3f}"
 
 
+def test_fsdp_training_loop_end_to_end(mesh8, tmp_path):
+    """--mode fsdp through the real loop: loss decreases, telemetry written,
+    params measurably sharded (the loop prints the measured fraction)."""
+    from distributed_ml_pytorch_tpu.parallel.fsdp import train_fsdp
+    from distributed_ml_pytorch_tpu.training.cli import build_parser
+
+    args = build_parser().parse_args([
+        "--mode", "fsdp", "--epochs", "1", "--synthetic-data",
+        "--synthetic-train-size", "128", "--synthetic-test-size", "32",
+        "--batch-size", "2", "--model", "lenet", "--lr", "0.05",
+        "--log-interval", "100", "--log-dir", str(tmp_path),
+    ])
+    state, logger = train_fsdp(args, mesh8)
+    assert int(state.step) == 128 // (2 * 8)
+    records = logger.records
+    assert records and records[-1]["training_loss"] < records[0]["training_loss"]
+
+
 def test_fsdp_lm_matches_single_device_and_shards_momentum(mesh8):
     """Transformer FSDP with momentum: trajectory matches unsharded, and the
     optimizer's momentum buffers (the biggest ZeRO saving) are sharded."""
