@@ -100,6 +100,71 @@ def tpu_phase() -> None:
          "v4-32 this environment lacks — sharded program validated by "
          "dryrun_multichip")
 
+    # config 6 (capability extension, no reference counterpart) — long-context
+    # Transformer-LM training throughput at seq 8192
+    tok_s = bench_lm_long_context()
+    emit(6, "transformer_lm_seq8192_train_throughput", tok_s, "tokens/sec/chip",
+         hw, "default TransformerLM (512d/8h/6L), bf16 activations, per-block "
+         "remat, RoPE, batch 1 x seq 8192; capability extension — the "
+         "reference has no sequence models (SURVEY.md §5.7)")
+
+
+def bench_lm_long_context(seq: int = 8192) -> float:
+    """Differenced steady-state tokens/sec of one LM train step on the
+    default device (chained through the donated state: each dispatch's
+    params feed the next, so the final scalar fetch forces the whole chain)."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_ml_pytorch_tpu.models import TransformerLM
+    from distributed_ml_pytorch_tpu.parallel.fsdp import lm_loss_builder
+    from distributed_ml_pytorch_tpu.parallel.seq_parallel import (
+        create_lm_train_state,
+        next_token_targets,
+    )
+
+    lm = TransformerLM(dtype=jnp.bfloat16, remat=True, pos_encoding="rope")
+    tx = optax.sgd(1e-3)
+    state = create_lm_train_state(lm, jax.random.key(0), tx)
+    tokens = np.random.default_rng(0).integers(
+        0, lm.vocab_size, size=(1, seq)
+    ).astype(np.int32)
+    targets = jnp.asarray(next_token_targets(tokens))
+    tokens = jnp.asarray(tokens)
+    loss_builder = lm_loss_builder(lm)  # the shared masked-LM loss convention
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_builder(state, tokens, targets))(
+            state.params
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(params=params, opt_state=opt_state,
+                             step=state.step + 1), loss
+
+    def chain(n):
+        nonlocal state
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            state, loss = step(state, tokens, targets)
+        float(loss)
+        return time.perf_counter() - t0
+
+    chain(2)  # compile + warm
+    n_short, n_long = 1, 11
+    short = min(chain(n_short) for _ in range(3))
+    long_ = min(chain(n_long) for _ in range(3))
+    per_step = (long_ - short) / (n_long - n_short)
+    rate = seq / per_step
+    log(f"lm long-context: {per_step * 1e3:.1f} ms/step at seq {seq} → "
+        f"{rate:.0f} tokens/s")
+    return rate
+
 
 def ps_phase() -> None:
     # config 3 — 1 server + 4 workers, real processes, TCP transport

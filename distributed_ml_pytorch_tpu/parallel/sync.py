@@ -177,6 +177,8 @@ def train_data_parallel(
         momentum=getattr(args, "momentum", 0.0),
         grad_accum=grad_accum,
         optimizer=getattr(args, "optimizer", "sgd"),
+        weight_decay=getattr(args, "weight_decay", None),
+        grad_clip=getattr(args, "grad_clip", 0.0),
     )
     # restore (if resuming) BEFORE mesh placement: orbax hands back host
     # arrays and the strategy then lays them out like a fresh init

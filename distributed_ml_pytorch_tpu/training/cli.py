@@ -119,6 +119,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(example/main.py:44)")
     p.add_argument("--momentum", type=float, default=0.0, metavar="M",
                    help="sgd momentum (the reference hardcodes 0.0)")
+    p.add_argument("--weight-decay", type=float, default=None, metavar="WD",
+                   help="weight decay: decoupled (AdamW-style) for adamw, "
+                        "classic L2 for sgd/adam; unset keeps each "
+                        "optimizer's default (adamw: optax's 1e-4), 0 disables")
+    p.add_argument("--grad-clip", type=float, default=0.0, metavar="NORM",
+                   help="clip gradients to this global norm before the "
+                        "optimizer update; 0 disables")
     p.add_argument("--lr-schedule", type=str, default="constant",
                    choices=("constant", "inverse-epoch", "cosine"),
                    help="learning-rate schedule; the reference configures "
@@ -195,6 +202,8 @@ def main(argv=None) -> int:
             ("--lr-schedule", args.lr_schedule != "constant"),
             ("--optimizer", args.optimizer != "sgd"),
             ("--momentum", args.momentum != 0.0),
+            ("--weight-decay", args.weight_decay is not None),
+            ("--grad-clip", args.grad_clip != 0.0),
         ):
             if bad:
                 print(

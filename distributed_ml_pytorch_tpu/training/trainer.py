@@ -90,21 +90,45 @@ def make_lr_schedule(
     raise ValueError(f"unknown lr schedule {kind!r} (constant|inverse-epoch|cosine)")
 
 
-def make_optimizer(name: str, lr, momentum: float = 0.0) -> optax.GradientTransformation:
+def make_optimizer(
+    name: str,
+    lr,
+    momentum: float = 0.0,
+    weight_decay: float | None = None,
+    grad_clip: float = 0.0,
+) -> optax.GradientTransformation:
     """Optimizer registry for the ``--optimizer`` flag.
 
     ``sgd`` is the reference's recipe (``optim.SGD(lr, momentum=0.0)``,
     ``example/main.py:44``); ``adam`` and ``adamw`` are extensions. ``lr``
     may be a float or an optax schedule.
+
+    ``grad_clip > 0`` prepends global-norm clipping. ``weight_decay`` is
+    decoupled (AdamW-style) for ``adamw``; for ``sgd``/``adam`` it is
+    classic L2 regularization (``optax.add_decayed_weights`` folded into the
+    gradient before the update rule). ``None`` (the default) keeps each
+    optimizer's own default — in particular adamw retains optax's 1e-4 —
+    while an explicit ``0.0`` disables decay.
     """
     name = name.lower()
     if name == "sgd":
-        return optax.sgd(lr, momentum=momentum if momentum else None)
-    if name == "adam":
-        return optax.adam(lr)
-    if name == "adamw":
-        return optax.adamw(lr)
-    raise ValueError(f"unknown optimizer {name!r} (sgd|adam|adamw)")
+        base = optax.sgd(lr, momentum=momentum if momentum else None)
+    elif name == "adam":
+        base = optax.adam(lr)
+    elif name == "adamw":
+        base = optax.adamw(lr) if weight_decay is None else optax.adamw(
+            lr, weight_decay=weight_decay
+        )
+    else:
+        raise ValueError(f"unknown optimizer {name!r} (sgd|adam|adamw)")
+    chain = []
+    if grad_clip and grad_clip > 0:
+        chain.append(optax.clip_by_global_norm(grad_clip))
+    if weight_decay and name in ("sgd", "adam"):
+        chain.append(optax.add_decayed_weights(weight_decay))
+    if not chain:
+        return base
+    return optax.chain(*chain, base)
 
 
 def create_train_state(
@@ -115,6 +139,8 @@ def create_train_state(
     sample_shape=(1, 32, 32, 3),
     grad_accum: int = 1,
     optimizer: str = "sgd",
+    weight_decay: float | None = None,
+    grad_clip: float = 0.0,
 ) -> Tuple[TrainState, optax.GradientTransformation]:
     """Initialize params + optimizer (reference ``optim.SGD(lr, momentum=0.0)``,
     ``example/main.py:44``). ``lr`` may be a float or an optax schedule
@@ -125,7 +151,7 @@ def create_train_state(
     is applied — the effective batch grows without growing per-step HBM.
     """
     params = model.init(rng, jnp.zeros(sample_shape))["params"]
-    tx = make_optimizer(optimizer, lr, momentum)
+    tx = make_optimizer(optimizer, lr, momentum, weight_decay, grad_clip)
     if int(grad_accum) > 1:
         tx = optax.MultiSteps(tx, every_k_schedule=int(grad_accum))
     return TrainState.create(params, tx), tx
@@ -466,6 +492,8 @@ def train_single(args) -> Tuple[TrainState, MetricsLogger]:
         momentum=getattr(args, "momentum", 0.0),
         grad_accum=grad_accum,
         optimizer=getattr(args, "optimizer", "sgd"),
+        weight_decay=getattr(args, "weight_decay", None),
+        grad_clip=getattr(args, "grad_clip", 0.0),
     )
     train_step = make_train_step(model, tx)
     scan_step = (

@@ -1,0 +1,78 @@
+"""Optimizer registry: weight decay and gradient clipping semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_ml_pytorch_tpu.training.trainer import make_optimizer
+
+
+def _one_update(tx, grads, params):
+    state = tx.init(params)
+    updates, _ = tx.update(grads, state, params)
+    return updates
+
+
+def test_grad_clip_bounds_update_norm():
+    params = {"w": jnp.zeros((4,))}
+    grads = {"w": jnp.full((4,), 100.0)}  # global norm 200
+    tx = make_optimizer("sgd", lr=1.0, grad_clip=1.0)
+    upd = _one_update(tx, grads, params)
+    norm = float(jnp.linalg.norm(upd["w"]))
+    assert norm == pytest.approx(1.0, rel=1e-5)  # lr 1.0 × clipped norm 1.0
+
+
+def test_grad_clip_leaves_small_gradients_alone():
+    params = {"w": jnp.zeros((2,))}
+    grads = {"w": jnp.asarray([0.3, 0.4])}  # norm 0.5 < 1.0
+    tx = make_optimizer("sgd", lr=1.0, grad_clip=1.0)
+    upd = _one_update(tx, grads, params)
+    np.testing.assert_allclose(np.asarray(upd["w"]), [-0.3, -0.4], rtol=1e-6)
+
+
+def test_sgd_weight_decay_is_l2():
+    """With zero gradients, the update must be -lr * wd * param."""
+    params = {"w": jnp.asarray([2.0, -4.0])}
+    grads = {"w": jnp.zeros((2,))}
+    tx = make_optimizer("sgd", lr=0.1, weight_decay=0.01)
+    upd = _one_update(tx, grads, params)
+    np.testing.assert_allclose(
+        np.asarray(upd["w"]), [-0.1 * 0.01 * 2.0, -0.1 * 0.01 * -4.0], rtol=1e-5
+    )
+
+
+def test_adamw_decay_is_decoupled():
+    """adamw with wd must match optax.adamw exactly (decoupled decay, not
+    gradient L2)."""
+    params = {"w": jnp.asarray([2.0, -4.0])}
+    grads = {"w": jnp.asarray([0.5, 0.5])}
+    got = _one_update(make_optimizer("adamw", lr=0.1, weight_decay=0.01), grads, params)
+    want = _one_update(optax.adamw(0.1, weight_decay=0.01), grads, params)
+    np.testing.assert_allclose(np.asarray(got["w"]), np.asarray(want["w"]), rtol=1e-6)
+
+
+def test_adamw_default_keeps_optax_decay():
+    """Unset weight_decay must preserve adamw's own default (1e-4), so the
+    adamw/adam distinction survives the new knob."""
+    params = {"w": jnp.asarray([2.0, -4.0])}
+    grads = {"w": jnp.asarray([0.5, 0.5])}
+    got = _one_update(make_optimizer("adamw", lr=0.1), grads, params)
+    want = _one_update(optax.adamw(0.1), grads, params)
+    np.testing.assert_allclose(np.asarray(got["w"]), np.asarray(want["w"]), rtol=1e-6)
+    plain_adam = _one_update(optax.adam(0.1), grads, params)
+    assert not np.allclose(np.asarray(got["w"]), np.asarray(plain_adam["w"]))
+
+
+def test_no_knobs_returns_bare_optimizer():
+    """Default path must stay the reference recipe: plain sgd, no chain."""
+    params = {"w": jnp.asarray([1.0])}
+    grads = {"w": jnp.asarray([3.0])}
+    got = _one_update(make_optimizer("sgd", lr=0.008), grads, params)
+    np.testing.assert_allclose(np.asarray(got["w"]), [-0.008 * 3.0], rtol=1e-6)
+
+
+def test_unknown_optimizer_rejected():
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        make_optimizer("rmsprop", lr=0.1)
