@@ -1,0 +1,28 @@
+"""The LM example must run every parallelism mode end-to-end and learn."""
+
+import pytest
+
+from examples.train_lm import main
+
+
+@pytest.mark.parametrize(
+    "mode", ["single", "sp", "ulysses", "fsdp", "tp", "composite"]
+)
+def test_train_lm_example_runs(mode, capsys):
+    rc = main([
+        "--mode", mode, "--steps", "4", "--batch", "4", "--seq", "32",
+        "--vocab", "64", "--d-model", "32", "--n-heads", "8",
+        "--n-layers", "1", "--d-ff", "64",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "final loss" in out
+
+
+def test_train_lm_example_loss_decreases(capsys):
+    main(["--mode", "single", "--steps", "10", "--batch", "8", "--seq", "32",
+          "--vocab", "64", "--d-model", "32", "--n-heads", "4",
+          "--n-layers", "1", "--d-ff", "64", "--lr", "0.1"])
+    out = capsys.readouterr().out
+    losses = [float(l.split("loss")[-1]) for l in out.splitlines() if "  step" in l]
+    assert losses[-1] < losses[0]
