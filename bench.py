@@ -128,24 +128,13 @@ def bench_jax(batch: int = BATCH, k: int | None = None, model=None,
     return rate
 
 
-def bench_torch_cpu(batch: int = BATCH, steps: int = BASELINE_STEPS) -> float | None:
-    """images/sec of the reference workload (torch CPU, same recipe).
+def make_torch_alexnet():
+    """The reference's CIFAR AlexNet as one torch Sequential (SURVEY.md C7) —
+    the single spec shared by the throughput baseline here and the
+    steps-to-accuracy comparison in ``bench_all.py``."""
+    import torch.nn as tnn
 
-    The model is the reference's CIFAR AlexNet re-stated from its architecture
-    spec (SURVEY.md C7: five convs 3→64 k11 s4 p5 / 64→192 k5 p2 / 192→384 k3
-    p1 / 384→256 k3 p1 / 256→256 k3 p1, three 2×2 maxpools, Linear(256, 10)).
-    """
-    try:
-        import torch
-        import torch.nn as tnn
-        import torch.nn.functional as F
-    except Exception as e:  # torch unavailable: no measured baseline
-        log(f"torch baseline unavailable: {e}")
-        return None
-
-    torch.manual_seed(0)
-
-    model = tnn.Sequential(
+    return tnn.Sequential(
         tnn.Conv2d(3, 64, 11, stride=4, padding=5), tnn.ReLU(),
         tnn.MaxPool2d(2, 2),
         tnn.Conv2d(64, 192, 5, padding=2), tnn.ReLU(),
@@ -157,6 +146,24 @@ def bench_torch_cpu(batch: int = BATCH, steps: int = BASELINE_STEPS) -> float | 
         tnn.Flatten(),
         tnn.Linear(256, 10),
     )
+
+
+def bench_torch_cpu(batch: int = BATCH, steps: int = BASELINE_STEPS) -> float | None:
+    """images/sec of the reference workload (torch CPU, same recipe).
+
+    The model is the reference's CIFAR AlexNet re-stated from its architecture
+    spec (SURVEY.md C7: five convs 3→64 k11 s4 p5 / 64→192 k5 p2 / 192→384 k3
+    p1 / 384→256 k3 p1 / 256→256 k3 p1, three 2×2 maxpools, Linear(256, 10)).
+    """
+    try:
+        import torch
+        import torch.nn.functional as F
+    except Exception as e:  # torch unavailable: no measured baseline
+        log(f"torch baseline unavailable: {e}")
+        return None
+
+    torch.manual_seed(0)
+    model = make_torch_alexnet()
     opt = torch.optim.SGD(model.parameters(), lr=LR, momentum=0.0)
     images_np, labels_np = make_batch(batch)
     images = torch.from_numpy(images_np.transpose(0, 3, 1, 2).copy())  # NCHW

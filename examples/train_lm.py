@@ -52,6 +52,11 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.steps < 1:
         parser.error("--steps must be >= 1")
+    if args.d_model % args.n_heads:
+        parser.error(
+            f"--d-model {args.d_model} must be divisible by --n-heads "
+            f"{args.n_heads} (attention splits d_model into heads)"
+        )
 
     import math
 
@@ -137,7 +142,9 @@ def main(argv=None) -> int:
         )
         from distributed_ml_pytorch_tpu.runtime.mesh import make_mesh
 
-        d_model_axis = math.gcd(n_dev, args.n_heads)
+        # the model axis must divide every dimension tp shards
+        d_model_axis = math.gcd(math.gcd(n_dev, args.n_heads),
+                                math.gcd(args.d_ff, args.vocab))
         d_data = math.gcd(n_dev // d_model_axis, args.batch)
         mesh = make_mesh(
             {"data": d_data, "model": d_model_axis},
@@ -155,10 +162,12 @@ def main(argv=None) -> int:
         )
         from distributed_ml_pytorch_tpu.runtime.mesh import make_mesh
 
+        # the model axis must divide heads/d_ff/vocab; 1 when they're odd
+        d_model_c = math.gcd(2, math.gcd(args.n_heads, math.gcd(args.d_ff, args.vocab)))
         if n_dev >= 8:
-            shape = {"data": 2, "fsdp": 2, "model": 2}
+            shape = {"data": 2, "fsdp": 2, "model": d_model_c}
         elif n_dev >= 4:
-            shape = {"data": 1, "fsdp": 2, "model": 2}
+            shape = {"data": 1, "fsdp": 2, "model": d_model_c}
         else:
             shape = {"data": 1, "fsdp": 1, "model": 1}
         # the batch shards over the combined (data, fsdp) axes
@@ -175,7 +184,10 @@ def main(argv=None) -> int:
         batch = shard_composite_batch(mesh, tokens, targets)
         desc = "x".join(str(v) for v in shape.values()) + " dp x fsdp x tp"
 
-    print(f"training {args.n_layers}-layer LM ({desc}, {len(jax.devices())} devices)")
+    print(
+        f"training {args.n_layers}-layer LM "
+        f"({desc}, {mesh.devices.size} of {n_dev} devices)"
+    )
     t0 = time.perf_counter()
     loss = None
     for i in range(args.steps):
