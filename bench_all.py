@@ -86,7 +86,11 @@ def tpu_phase() -> None:
     # config 1 (north-star metric #2) — steps to target accuracy, both
     # frameworks, identical batch stream
     jax_steps, torch_steps, torch_status = bench_steps_to_accuracy()
-    if jax_steps is not None:
+    if jax_steps is None:
+        emit(1, "steps_to_99pct_test_accuracy", -1, "steps", hw,
+             "did NOT reach the target within the 2000-step cap — "
+             "investigate before trusting other rows (-1 = cap hit)")
+    else:
         torch_part = {
             "measured": f"torch on the identical batch stream took "
                         f"{torch_steps} steps",
@@ -169,6 +173,10 @@ def bench_steps_to_accuracy(target: float = 0.99, max_steps: int = 2000,
             jax_steps = (chunk + 1) * eval_every
             break
     log(f"steps-to-{target:.0%}: jax {jax_steps}")
+    if jax_steps is None:
+        # the comparison leg is moot (and minutes of CPU) when the primary
+        # leg missed the target — report the cap-hit instead of discarding
+        return None, None, "skipped"
 
     torch_steps, torch_status = None, "cap"
     try:

@@ -59,6 +59,53 @@ def switch_route(
     return dispatch, combine
 
 
+def topk_route(
+    router_probs: jnp.ndarray,
+    capacity: int,
+    k: int = 2,
+    normalize: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """GShard-style top-k routing with per-expert capacity, dense shapes.
+
+    Each token is dispatched to its ``k`` highest-probability experts.
+    Capacity slots are granted **rank-major**: every token's rank-0 choice
+    is queued before any token's rank-1 choice, so second choices are the
+    first dropped under pressure (GShard's priority rule). ``normalize``
+    rescales the k gates to sum to 1 (standard for k≥2); with ``k=1,
+    normalize=False`` this reduces exactly to :func:`switch_route`.
+
+    Returns ``(dispatch [B,S,E,C], combine [B,S,E,C])`` — identical
+    contracts to :func:`switch_route`, so ``MoEMLP``'s einsums (and the
+    ``expert``-axis sharding that turns them into all-to-alls) are unchanged.
+    """
+    b, s, e = router_probs.shape
+    if not 1 <= k <= e:
+        raise ValueError(f"top-k routing needs 1 <= k <= n_experts, got k={k}, e={e}")
+    gate_sk, idx = jax.lax.top_k(router_probs, k)                   # [B,S,K], rank-sorted
+    oh_ks = jnp.moveaxis(
+        jax.nn.one_hot(idx, e, dtype=router_probs.dtype), 2, 1
+    )                                                               # [B,K,S,E]
+    # queue position per (choice, token): exclusive cumsum over the combined
+    # rank-major (K·S) axis — rank 0 occupies slots before any rank 1
+    flat = oh_ks.reshape(b, k * s, e)
+    pos = jnp.cumsum(flat, axis=1) - flat                           # [B,K*S,E]
+    kept = (pos < capacity) * flat
+    slot = jax.nn.one_hot(
+        jnp.sum(pos * flat, axis=-1).astype(jnp.int32), capacity,
+        dtype=router_probs.dtype,
+    )                                                               # [B,K*S,C]
+    disp_flat = kept[..., None] * slot[:, :, None, :]               # [B,K*S,E,C]
+    dispatch_k = disp_flat.reshape(b, k, s, e, capacity)
+    dispatch = jnp.sum(dispatch_k, axis=1)                          # [B,S,E,C]
+    gate_ks = jnp.moveaxis(gate_sk, 2, 1)                           # [B,K,S]
+    if normalize:
+        gate_ks = gate_ks / jnp.maximum(
+            jnp.sum(gate_ks, axis=1, keepdims=True), 1e-9
+        )
+    combine = jnp.sum(dispatch_k * gate_ks[..., None, None], axis=1)
+    return dispatch, combine
+
+
 def load_balance_loss(router_probs: jnp.ndarray) -> jnp.ndarray:
     """Switch aux loss (eq. 4): E · Σ_e (fraction argmax-routed to e) · (mean prob of e).
 
@@ -90,15 +137,20 @@ class MoEMLP(nn.Module):
     n_experts: int = 4
     capacity_factor: float = 2.0
     dtype: jnp.dtype = jnp.float32
+    router_top_k: int = 1  # 1 = Switch; ≥2 = GShard top-k with gate renorm
 
     @nn.compact
     def __call__(self, x):
         b, s, d = x.shape
         e = self.n_experts
-        capacity = max(1, int(self.capacity_factor * s / e))
+        # top-k emits k assignments per token, so capacity provisions k·S/E
+        capacity = max(1, int(self.capacity_factor * self.router_top_k * s / e))
         router = nn.Dense(e, use_bias=False, dtype=self.dtype, name="router")
         probs = jax.nn.softmax(router(x).astype(jnp.float32), axis=-1).astype(x.dtype)
-        dispatch, combine = switch_route(probs, capacity)
+        if self.router_top_k == 1:
+            dispatch, combine = switch_route(probs, capacity)
+        else:
+            dispatch, combine = topk_route(probs, capacity, k=self.router_top_k)
         self.sow("losses", "load_balance", load_balance_loss(probs))
 
         w_up = self.param(
@@ -126,6 +178,7 @@ class MoEBlock(nn.Module):
     n_experts: int = 4
     capacity_factor: float = 2.0
     dtype: jnp.dtype = jnp.float32
+    router_top_k: int = 1
 
     @nn.compact
     def __call__(self, x):
@@ -134,7 +187,7 @@ class MoEBlock(nn.Module):
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + MoEMLP(
             self.d_model, self.d_ff, self.n_experts, self.capacity_factor,
-            self.dtype, name="moe",
+            self.dtype, router_top_k=self.router_top_k, name="moe",
         )(h)
         return x
 
@@ -152,6 +205,7 @@ class MoETransformerLM(nn.Module):
     max_len: int = 131072
     dtype: jnp.dtype = jnp.float32
     remat: bool = False
+    router_top_k: int = 1
 
     @nn.compact
     def __call__(self, tokens, positions=None):
@@ -163,7 +217,8 @@ class MoETransformerLM(nn.Module):
         for i in range(self.n_layers):
             x = block_cls(
                 self.d_model, self.n_heads, self.d_ff, self.n_experts,
-                self.capacity_factor, self.dtype, name=f"block_{i}",
+                self.capacity_factor, self.dtype,
+                router_top_k=self.router_top_k, name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head")(x)

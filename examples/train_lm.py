@@ -162,17 +162,13 @@ def main(argv=None) -> int:
         )
         from distributed_ml_pytorch_tpu.runtime.mesh import make_mesh
 
-        # the model axis must divide heads/d_ff/vocab; 1 when they're odd
+        # the model axis must divide heads/d_ff/vocab (1 when they're odd);
+        # whatever it doesn't use goes to the combined data x fsdp group,
+        # which must divide the batch
         d_model_c = math.gcd(2, math.gcd(args.n_heads, math.gcd(args.d_ff, args.vocab)))
-        if n_dev >= 8:
-            shape = {"data": 2, "fsdp": 2, "model": d_model_c}
-        elif n_dev >= 4:
-            shape = {"data": 1, "fsdp": 2, "model": d_model_c}
-        else:
-            shape = {"data": 1, "fsdp": 1, "model": 1}
-        # the batch shards over the combined (data, fsdp) axes
-        while args.batch % (shape["data"] * shape["fsdp"]):
-            shape["fsdp" if shape["fsdp"] > 1 else "data"] //= 2
+        combined = math.gcd(n_dev // d_model_c, args.batch)
+        d_data = 2 if combined % 2 == 0 and combined > 1 else 1
+        shape = {"data": d_data, "fsdp": combined // d_data, "model": d_model_c}
         n_used = 1
         for v in shape.values():
             n_used *= v
