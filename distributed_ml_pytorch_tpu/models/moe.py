@@ -44,13 +44,17 @@ def switch_route(
     """
     b, s, e = router_probs.shape
     expert_idx = jnp.argmax(router_probs, axis=-1)                 # [B,S]
-    expert_onehot = jax.nn.one_hot(expert_idx, e, dtype=router_probs.dtype)
+    # queue positions are COUNTS — int32, never the activation dtype: a
+    # bf16 cumsum loses integer exactness past 256 and collides slots
+    onehot_i = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)
     # position of each token within its expert's queue (exclusive cumsum
     # over the sequence), computed densely per expert
-    pos_in_expert = jnp.cumsum(expert_onehot, axis=1) - expert_onehot  # [B,S,E]
-    kept = (pos_in_expert < capacity) * expert_onehot               # [B,S,E]
+    pos_in_expert = jnp.cumsum(onehot_i, axis=1) - onehot_i         # [B,S,E]
+    kept = ((pos_in_expert < capacity) & (onehot_i > 0)).astype(
+        router_probs.dtype
+    )                                                               # [B,S,E]
     slot = jax.nn.one_hot(
-        jnp.sum(pos_in_expert * expert_onehot, axis=-1).astype(jnp.int32), capacity,
+        jnp.sum(pos_in_expert * onehot_i, axis=-1), capacity,
         dtype=router_probs.dtype,
     )                                                               # [B,S,C]
     dispatch = kept[..., None] * slot[:, :, None, :]                # [B,S,E,C]
@@ -82,16 +86,17 @@ def topk_route(
     if not 1 <= k <= e:
         raise ValueError(f"top-k routing needs 1 <= k <= n_experts, got k={k}, e={e}")
     gate_sk, idx = jax.lax.top_k(router_probs, k)                   # [B,S,K], rank-sorted
-    oh_ks = jnp.moveaxis(
-        jax.nn.one_hot(idx, e, dtype=router_probs.dtype), 2, 1
-    )                                                               # [B,K,S,E]
+    # queue positions are COUNTS — int32, never the activation dtype: a bf16
+    # cumsum loses integer exactness past 256 and collides slots (the K·S
+    # combined axis reaches that twice as fast as top-1)
+    oh_ks = jnp.moveaxis(jax.nn.one_hot(idx, e, dtype=jnp.int32), 2, 1)  # [B,K,S,E]
     # queue position per (choice, token): exclusive cumsum over the combined
     # rank-major (K·S) axis — rank 0 occupies slots before any rank 1
     flat = oh_ks.reshape(b, k * s, e)
     pos = jnp.cumsum(flat, axis=1) - flat                           # [B,K*S,E]
-    kept = (pos < capacity) * flat
+    kept = ((pos < capacity) & (flat > 0)).astype(router_probs.dtype)
     slot = jax.nn.one_hot(
-        jnp.sum(pos * flat, axis=-1).astype(jnp.int32), capacity,
+        jnp.sum(pos * flat, axis=-1), capacity,
         dtype=router_probs.dtype,
     )                                                               # [B,K*S,C]
     disp_flat = kept[..., None] * slot[:, :, None, :]               # [B,K*S,E,C]

@@ -1,4 +1,5 @@
 from distributed_ml_pytorch_tpu.parallel.sync import (
+    make_sync_scan_step,
     make_sync_train_step,
     shard_batch,
     train_sync,
@@ -78,6 +79,7 @@ __all__ = [
     "make_tp_train_step",
     "shard_tp_batch",
     "tp_param_specs",
+    "make_sync_scan_step",
     "make_sync_train_step",
     "shard_batch",
     "train_sync",

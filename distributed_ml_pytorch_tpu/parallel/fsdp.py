@@ -267,7 +267,8 @@ def train_fsdp(args, mesh: Mesh | None = None):
             bx, by = shard_fsdp_batch(mesh, bx, by)
             return train_step(state, bx, by, rng)
 
-        return state, sharded_step, f", {frac:.3f} of params/device"
+        # no scanned dispatcher yet — the CLI rejects --steps-per-dispatch>1
+        return state, sharded_step, None, f", {frac:.3f} of params/device"
 
     return train_data_parallel(args, mesh, strategy, "FSDP")
 

@@ -79,36 +79,43 @@ def replicate(mesh: Mesh, tree: Pytree) -> Pytree:
     return jax.jit(lambda t: t, out_shardings=sharding)(tree)
 
 
+def _sync_step_body(model, tx, axis: str, state: TrainState, images, labels, rng):
+    """Per-device DDP step body (inside ``shard_map``), shared by the
+    per-step and scanned dispatchers. The dropout rng folds in ``state.step``
+    and the device index, so both dispatchers produce the same stream."""
+    step_rng = jax.random.fold_in(
+        jax.random.fold_in(rng, state.step), jax.lax.axis_index(axis)
+    )
+
+    def loss_fn(params):
+        logits = model.apply(
+            {"params": params}, images, train=True, rngs={"dropout": step_rng}
+        )
+        return cross_entropy_loss(logits, labels)
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    # THE allreduce. Params enter replicated (invariant over the mesh) and
+    # data enters sharded, so differentiation itself inserts the cross-
+    # device psum of gradients — the transpose of the implicit pvary under
+    # shard_map's varying-axes tracking. That psum IS the DDP allreduce,
+    # compiled to an ICI collective (the reference's out-of-tree gloo C++
+    # transport re-expressed as an XLA collective — SURVEY.md §2.2).
+    # Normalize the sum of per-shard means into the global-batch mean:
+    n = jax.lax.psum(1, axis)
+    grads = jax.tree.map(lambda g: g / n, grads)
+    loss = jax.lax.pmean(loss, axis)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return state.replace(params=params, opt_state=opt_state, step=state.step + 1), loss
+
+
 def make_sync_train_step(
     model, tx: optax.GradientTransformation, mesh: Mesh, axis: str = "data"
 ) -> Callable:
     """Build the jitted DDP step: local grads + ``pmean`` allreduce + SGD."""
 
     def shard_fn(state: TrainState, images, labels, rng):
-        step_rng = jax.random.fold_in(
-            jax.random.fold_in(rng, state.step), jax.lax.axis_index(axis)
-        )
-
-        def loss_fn(params):
-            logits = model.apply(
-                {"params": params}, images, train=True, rngs={"dropout": step_rng}
-            )
-            return cross_entropy_loss(logits, labels)
-
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        # THE allreduce. Params enter replicated (invariant over the mesh) and
-        # data enters sharded, so differentiation itself inserts the cross-
-        # device psum of gradients — the transpose of the implicit pvary under
-        # shard_map's varying-axes tracking. That psum IS the DDP allreduce,
-        # compiled to an ICI collective (the reference's out-of-tree gloo C++
-        # transport re-expressed as an XLA collective — SURVEY.md §2.2).
-        # Normalize the sum of per-shard means into the global-batch mean:
-        n = jax.lax.psum(1, axis)
-        grads = jax.tree.map(lambda g: g / n, grads)
-        loss = jax.lax.pmean(loss, axis)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return state.replace(params=params, opt_state=opt_state, step=state.step + 1), loss
+        return _sync_step_body(model, tx, axis, state, images, labels, rng)
 
     sharded = jax.shard_map(
         shard_fn,
@@ -117,6 +124,31 @@ def make_sync_train_step(
         out_specs=(P(), P()),
     )
     # Donate the state so params/opt-state update in place in HBM.
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_sync_scan_step(
+    model, tx: optax.GradientTransformation, mesh: Mesh, axis: str = "data"
+) -> Callable:
+    """K DDP steps in ONE compiled program: ``lax.scan`` over a stacked
+    ``[K, batch, ...]`` input *inside* the ``shard_map`` region, so each scan
+    iteration runs the identical body (psum allreduce included) as
+    :func:`make_sync_train_step` — host dispatch amortizes over K without
+    changing the math (``--steps-per-dispatch`` for ``--mode sync``).
+    Returns ``(state, losses[K])``."""
+
+    def shard_fn(state: TrainState, images, labels, rng):
+        def body(st, batch):
+            return _sync_step_body(model, tx, axis, st, batch[0], batch[1], rng)
+
+        return jax.lax.scan(body, state, (images, labels))
+
+    sharded = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis), P(None, axis), P()),
+        out_specs=(P(), P()),
+    )
     return jax.jit(sharded, donate_argnums=(0,))
 
 
@@ -185,7 +217,7 @@ def train_data_parallel(
     ckpt, state, start_epoch, start_iter = setup_checkpoint(
         args, state, len(x_train) // per_proc_batch
     )
-    state, sharded_step, suffix = strategy(model, tx, mesh, state)
+    state, sharded_step, scan_fn, suffix = strategy(model, tx, mesh, state)
     eval_step = make_eval_fn(model)
     logger = MetricsLogger(getattr(args, "log_dir", "log"))
 
@@ -209,6 +241,7 @@ def train_data_parallel(
             ckpt=ckpt,
             start_epoch=start_epoch,
             start_iter=start_iter,
+            scan_step=scan_fn,
         )
     finally:
         if ckpt is not None:
@@ -228,12 +261,19 @@ def train_sync(args, mesh: Mesh | None = None) -> Tuple[TrainState, MetricsLogge
     def strategy(model, tx, mesh, state):
         state = replicate(mesh, state)
         train_step = make_sync_train_step(model, tx, mesh)
+        scan_step = make_sync_scan_step(model, tx, mesh)
         rng = replicate(mesh, jax.random.key(getattr(args, "seed", 0) + 1))
 
         def sharded_step(state, bx, by, _rng):
             bx, by = shard_batch(mesh, bx, by)
             return train_step(state, bx, by, rng)
 
-        return state, sharded_step, ""
+        def sharded_scan(state, bxs, bys, _rng):
+            # stacked [K, batch, ...]: shard the batch (second) axis
+            bxs = put_sharded(mesh, bxs, P(None, "data", *([None] * (bxs.ndim - 2))))
+            bys = put_sharded(mesh, bys, P(None, "data", *([None] * (bys.ndim - 2))))
+            return scan_step(state, bxs, bys, rng)
+
+        return state, sharded_step, sharded_scan, ""
 
     return train_data_parallel(args, mesh, strategy, "sync-DP")

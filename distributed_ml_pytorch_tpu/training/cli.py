@@ -136,7 +136,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "optimizer update (optax.MultiSteps) — effective "
                         "batch K×batch-size without K× activation HBM")
     p.add_argument("--steps-per-dispatch", type=int, default=1, metavar="K",
-                   help="fuse up to K consecutive SGD steps into one compiled "
+                   help="(single-process and --mode sync) "
+                        "fuse up to K consecutive SGD steps into one compiled "
                         "program (lax.scan) in the single-process trainer — "
                         "amortizes host dispatch; per-step CSV logging and "
                         "eval cadence are preserved")
@@ -204,6 +205,7 @@ def main(argv=None) -> int:
             ("--momentum", args.momentum != 0.0),
             ("--weight-decay", args.weight_decay is not None),
             ("--grad-clip", args.grad_clip != 0.0),
+            ("--steps-per-dispatch", args.steps_per_dispatch > 1),
         ):
             if bad:
                 print(
@@ -212,6 +214,16 @@ def main(argv=None) -> int:
                     file=sys.stderr,
                 )
                 return 2
+
+    if args.mode == "fsdp" and args.steps_per_dispatch > 1:
+        # fsdp has no scanned dispatcher yet; silently training per-step
+        # would misrepresent the measured regime
+        print(
+            "error: --steps-per-dispatch is not supported in --mode fsdp yet "
+            "(use --mode sync or --no-distributed)",
+            file=sys.stderr,
+        )
+        return 2
 
     if args.profile_dir and args.mode in ("ps", "local-sgd"):
         # tracing is wired into the shared training loop (single / sync);

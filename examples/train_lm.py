@@ -166,6 +166,8 @@ def main(argv=None) -> int:
         # whatever it doesn't use goes to the combined data x fsdp group,
         # which must divide the batch
         d_model_c = math.gcd(2, math.gcd(args.n_heads, math.gcd(args.d_ff, args.vocab)))
+        if d_model_c > n_dev:
+            d_model_c = 1  # fewer devices than the model axis wants
         combined = math.gcd(n_dev // d_model_c, args.batch)
         d_data = 2 if combined % 2 == 0 and combined > 1 else 1
         shape = {"data": d_data, "fsdp": combined // d_data, "model": d_model_c}

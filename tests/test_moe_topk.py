@@ -78,8 +78,11 @@ def test_rank_major_capacity_priority():
 
 
 def test_top2_lm_trains_and_matches_ep_sharding():
-    """The ep-sharded top-2 MoE step must equal the unsharded step exactly
-    (same contract as the existing top-1 ep test)."""
+    """The ep-sharded top-2 MoE step must equal the unsharded (1×1 mesh)
+    step exactly — the same contract as the existing top-1 ep test, now for
+    k=2's doubled dispatch traffic."""
+    import jax as _jax
+
     from distributed_ml_pytorch_tpu.parallel.expert_parallel import (
         create_ep_train_state,
         make_ep_train_step,
@@ -88,20 +91,31 @@ def test_top2_lm_trains_and_matches_ep_sharding():
     from distributed_ml_pytorch_tpu.parallel.seq_parallel import next_token_targets
     from distributed_ml_pytorch_tpu.runtime.mesh import make_mesh
 
-    mesh = make_mesh({"data": 2, "expert": 4})
     moe = MoETransformerLM(
         vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
         n_experts=4, max_len=64, router_top_k=2,
     )
     tx = optax.sgd(0.05)
-    state = create_ep_train_state(moe, jax.random.key(0), tx, mesh)
     tokens = np.random.default_rng(2).integers(0, 64, size=(4, 32)).astype(np.int32)
     targets = next_token_targets(tokens)
-    tok, tgt = shard_ep_batch(mesh, tokens, targets)
-    step = make_ep_train_step(moe, tx, mesh)
-    losses = []
-    for _ in range(3):
-        state, (loss, aux) = step(state, tok, tgt)
-        losses.append(float(loss))
-    assert losses[-1] < losses[0]
-    assert np.isfinite(losses).all()
+
+    mesh_s = make_mesh({"data": 1, "expert": 1}, devices=_jax.devices()[:1])
+    mesh_p = make_mesh({"data": 2, "expert": 4})
+    states, losses = {}, {}
+    for name, mesh in (("unsharded", mesh_s), ("sharded", mesh_p)):
+        state = create_ep_train_state(moe, jax.random.key(0), tx, mesh)
+        tok, tgt = shard_ep_batch(mesh, tokens, targets)
+        step = make_ep_train_step(moe, tx, mesh)
+        ls = []
+        for _ in range(3):
+            state, (loss, _aux) = step(state, tok, tgt)
+            ls.append(float(loss))
+        states[name], losses[name] = state, ls
+
+    assert losses["sharded"][-1] < losses["sharded"][0]
+    np.testing.assert_allclose(losses["unsharded"], losses["sharded"], rtol=1e-5)
+    for a, b in zip(
+        jax.tree.leaves(states["unsharded"].params),
+        jax.tree.leaves(states["sharded"].params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6)

@@ -13,7 +13,9 @@ from distributed_ml_pytorch_tpu.data import load_cifar10
 from distributed_ml_pytorch_tpu.models import LeNet, AlexNet
 from distributed_ml_pytorch_tpu.parallel.p2p import p2p_send_recv, p2p_shift, run_demo
 from distributed_ml_pytorch_tpu.parallel.sync import (
+    make_sync_scan_step,
     make_sync_train_step,
+    put_sharded,
     replicate,
     shard_batch,
 )
@@ -44,6 +46,37 @@ def test_sync_step_matches_single_device(mesh8):
 
     for a, b in zip(jax.tree.leaves(state_s.params), jax.tree.leaves(state_p.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6)
+
+
+def test_sync_scan_matches_per_step_exactly(mesh8):
+    """K scanned DDP steps in one dispatch == K per-step dispatches (same
+    body, same rng stream) — the --steps-per-dispatch contract for sync."""
+    from jax.sharding import PartitionSpec as P
+
+    x, y, *_ = load_cifar10(n_train=192, n_test=16, synthetic=True)
+    model = AlexNet()
+    state_a, tx = create_train_state(model, jax.random.key(0), lr=0.05)
+    state_a = replicate(mesh8, state_a)
+    state_b = replicate(mesh8, state_a)
+    rng = replicate(mesh8, jax.random.key(5))
+
+    step = make_sync_train_step(model, tx, mesh8)
+    scan = make_sync_scan_step(model, tx, mesh8)
+
+    K, B = 3, 64
+    per_losses = []
+    for i in range(K):
+        bx, by = shard_batch(mesh8, x[i * B:(i + 1) * B], y[i * B:(i + 1) * B])
+        state_a, loss = step(state_a, bx, by, rng)
+        per_losses.append(float(loss))
+
+    bxs = put_sharded(mesh8, x[: K * B].reshape(K, B, 32, 32, 3), P(None, "data"))
+    bys = put_sharded(mesh8, y[: K * B].reshape(K, B), P(None, "data"))
+    state_b, losses = scan(state_b, bxs, bys, rng)
+
+    np.testing.assert_allclose(per_losses, np.asarray(losses), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
 
 
 def test_sync_step_loss_decreases(mesh8):
