@@ -292,6 +292,60 @@ def ps_phase() -> None:
          "startup+compile included (the reference's launch pattern)")
 
 
+def transport_phase() -> None:
+    """Config 7 (native-runtime evidence): PS control-plane round-trip rate
+    of the in-tree C++ transport vs the Python one, same wire format, same
+    AlexNet-gradient-sized payload, echo server in a real separate process."""
+    import subprocess
+    import sys as _sys
+
+    from distributed_ml_pytorch_tpu.launch import _free_port, cpu_platform_env
+    from distributed_ml_pytorch_tpu.utils.messaging import MessageCode, make_transport
+
+    payload = np.zeros(2_472_266, np.float32)  # raveled AlexNet size
+    n_iter = 30
+    server_src = (
+        "import sys\n"
+        "from distributed_ml_pytorch_tpu.utils.messaging import make_transport\n"
+        "t = make_transport(0, 2, port=int(sys.argv[1]), kind=sys.argv[2])\n"
+        f"for _ in range({n_iter} + 2):\n"
+        "    sender, code, payload = t.recv(timeout=120)\n"
+        "    t.send(code, payload, dst=sender)\n"
+        "t.close()\n"
+    )
+    for kind in ("native", "python"):
+        port = _free_port()
+        srv = subprocess.Popen(
+            [_sys.executable, "-c", server_src, port, kind],
+            env=cpu_platform_env(),
+        )
+        t = None
+        try:
+            t = make_transport(1, 2, port=int(port), kind=kind, connect_timeout=120)
+            for _ in range(2):  # warm both directions
+                t.send(MessageCode.GradientUpdate, payload)
+                t.recv(timeout=120)
+            t0 = time.perf_counter()
+            for _ in range(n_iter):
+                t.send(MessageCode.GradientUpdate, payload)
+                t.recv(timeout=120)
+            dt = time.perf_counter() - t0
+            rate = n_iter / dt
+            mbps = 2 * payload.nbytes * rate / 1e6
+            emit(7, f"ps_transport_roundtrip_{kind}", rate, "roundtrips/sec",
+                 "2 processes, localhost TCP",
+                 f"9.9 MB gradient payload echo ({mbps:.0f} MB/s both ways); "
+                 "capability-extension evidence for the in-tree C++ transport")
+        except Exception as e:
+            log(f"transport bench ({kind}) failed: {e}")
+        finally:
+            if t is not None:
+                t.close()
+            if srv.poll() is None:
+                srv.kill()
+            srv.wait()
+
+
 def cpu_mesh_phase() -> None:
     """Virtual-device measurements — runs LAST (re-initializing the backend
     onto CPU is one-way within a process)."""
@@ -365,6 +419,7 @@ def cpu_mesh_phase() -> None:
 def main() -> None:
     tpu_phase()
     ps_phase()
+    transport_phase()
     cpu_mesh_phase()
     log(f"bench_all: {len(RESULTS)} measurements")
 

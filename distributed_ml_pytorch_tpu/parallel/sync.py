@@ -166,10 +166,12 @@ def train_data_parallel(
     every controller loads only its strided shard of the training set and
     feeds its per-process slice of each global batch.
 
-    ``strategy(model, tx, mesh, state) -> (state, sharded_step, suffix)``
-    owns everything layout-specific: placing the (possibly ckpt-restored)
-    state on the mesh, and wrapping the jitted step so it shards each host
-    batch itself. Everything else — data, model, LR schedule, grad accum,
+    ``strategy(model, tx, mesh, state) -> (state, sharded_step, scan_fn,
+    suffix)`` owns everything layout-specific: placing the (possibly
+    ckpt-restored) state on the mesh, and wrapping the jitted step so it
+    shards each host batch itself; ``scan_fn`` is the chunked
+    (``--steps-per-dispatch``) dispatcher or ``None`` when the strategy has
+    none. Everything else — data, model, LR schedule, grad accum,
     checkpoint/resume, the epoch loop, telemetry — is one copy here.
     """
     from distributed_ml_pytorch_tpu.data import get_dataset, shard_for_process

@@ -81,8 +81,6 @@ def test_top2_lm_trains_and_matches_ep_sharding():
     """The ep-sharded top-2 MoE step must equal the unsharded (1×1 mesh)
     step exactly — the same contract as the existing top-1 ep test, now for
     k=2's doubled dispatch traffic."""
-    import jax as _jax
-
     from distributed_ml_pytorch_tpu.parallel.expert_parallel import (
         create_ep_train_state,
         make_ep_train_step,
@@ -99,7 +97,7 @@ def test_top2_lm_trains_and_matches_ep_sharding():
     tokens = np.random.default_rng(2).integers(0, 64, size=(4, 32)).astype(np.int32)
     targets = next_token_targets(tokens)
 
-    mesh_s = make_mesh({"data": 1, "expert": 1}, devices=_jax.devices()[:1])
+    mesh_s = make_mesh({"data": 1, "expert": 1}, devices=jax.devices()[:1])
     mesh_p = make_mesh({"data": 2, "expert": 4})
     states, losses = {}, {}
     for name, mesh in (("unsharded", mesh_s), ("sharded", mesh_p)):
