@@ -142,14 +142,51 @@ def make_sharded_step(
     rep = NamedSharding(mesh, P())
 
     def step(state: TrainState, *batch):
-        loss, grads = jax.value_and_grad(loss_builder(state, *batch))(state.params)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return state.replace(params=params, opt_state=opt_state, step=state.step + 1), loss
+        return _apply_update(tx, loss_builder, state, *batch)
 
     return jax.jit(
         step,
         in_shardings=(shardings,) + (batch_sharding,) * 2 + (rep,) * (n_batch_args - 2),
+        out_shardings=(shardings, rep),
+        donate_argnums=(0,),
+    )
+
+
+def _apply_update(tx, loss_builder, state: TrainState, *batch):
+    """The one update body (value_and_grad → tx.update → apply_updates →
+    replace) shared by the per-step and scanned sharded dispatchers."""
+    loss, grads = jax.value_and_grad(loss_builder(state, *batch))(state.params)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return state.replace(params=params, opt_state=opt_state, step=state.step + 1), loss
+
+
+def make_sharded_scan_step(
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    shardings,
+    batch_spec: P,
+    loss_builder: Callable,
+) -> Callable:
+    """Scanned companion to :func:`make_sharded_step`: K updates in ONE
+    compiled program over ``[K, ...]``-stacked global batches (leading scan
+    axis replicated, batch axes sharded per ``batch_spec``), state pinned to
+    its shardings and donated. The scan body is the same
+    ``loss_builder``-driven update, so per-step and chunked dispatch cannot
+    diverge. Returns ``(state, losses[K])``."""
+    stacked = NamedSharding(mesh, P(None, *batch_spec))
+    rep = NamedSharding(mesh, P())
+
+    def scan_step(state: TrainState, *stacked_batches):
+        def body(st, batch):
+            return _apply_update(tx, loss_builder, st, *batch)
+
+        return jax.lax.scan(body, state, stacked_batches)
+
+    n_batch = 2  # (images|tokens, labels|targets)
+    return jax.jit(
+        scan_step,
+        in_shardings=(shardings,) + (stacked,) * n_batch,
         out_shardings=(shardings, rep),
         donate_argnums=(0,),
     )
@@ -174,6 +211,16 @@ def make_fsdp_train_step(
     global-mean gradient, same update); only the memory layout differs.
     """
 
+    return make_sharded_step(
+        tx, mesh, shardings, P(axis), cnn_loss_builder(model), 3
+    )
+
+
+def cnn_loss_builder(model) -> Callable:
+    """The shared CNN loss (dropout rng folded by ``state.step``) as a
+    :func:`make_sharded_step` loss builder — one definition for the per-step
+    and chunked fsdp dispatchers."""
+
     def loss_builder(state, images, labels, rng):
         step_rng = jax.random.fold_in(rng, state.step)
 
@@ -185,7 +232,7 @@ def make_fsdp_train_step(
 
         return loss_fn
 
-    return make_sharded_step(tx, mesh, shardings, P(axis), loss_builder, 3)
+    return loss_builder
 
 
 def make_fsdp_lm_train_step(
@@ -255,6 +302,8 @@ def train_fsdp(args, mesh: Mesh | None = None):
     from distributed_ml_pytorch_tpu.parallel.sync import train_data_parallel
 
     def strategy(model, tx, mesh, state):
+        from distributed_ml_pytorch_tpu.parallel.sync import put_sharded
+
         shardings = _state_shardings(
             mesh, jax.eval_shape(lambda s: s, state), axis="data"
         )
@@ -267,8 +316,21 @@ def train_fsdp(args, mesh: Mesh | None = None):
             bx, by = shard_fsdp_batch(mesh, bx, by)
             return train_step(state, bx, by, rng)
 
-        # no scanned dispatcher yet — the CLI rejects --steps-per-dispatch>1
-        return state, sharded_step, None, f", {frac:.3f} of params/device"
+        # chunked (--steps-per-dispatch) dispatcher: the SAME loss builder
+        # as the per-step path, with this loop's rng bound (the builder
+        # folds state.step, so both dispatchers produce one stream)
+        base_builder = cnn_loss_builder(model)
+        scan_jit = make_sharded_scan_step(
+            tx, mesh, shardings, P("data"),
+            lambda st, bx, by: base_builder(st, bx, by, rng),
+        )
+
+        def sharded_scan(state, bxs, bys, _rng):
+            bxs = put_sharded(mesh, bxs, P(None, "data", *([None] * (bxs.ndim - 2))))
+            bys = put_sharded(mesh, bys, P(None, "data", *([None] * (bys.ndim - 2))))
+            return scan_jit(state, bxs, bys)
+
+        return state, sharded_step, sharded_scan, f", {frac:.3f} of params/device"
 
     return train_data_parallel(args, mesh, strategy, "FSDP")
 

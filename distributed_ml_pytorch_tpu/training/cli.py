@@ -136,9 +136,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "optimizer update (optax.MultiSteps) — effective "
                         "batch K×batch-size without K× activation HBM")
     p.add_argument("--steps-per-dispatch", type=int, default=1, metavar="K",
-                   help="(single-process and --mode sync) "
-                        "fuse up to K consecutive SGD steps into one compiled "
-                        "program (lax.scan) in the single-process trainer — "
+                   help="(single-process, --mode sync, and --mode fsdp) "
+                        "fuse up to K consecutive SGD steps into one "
+                        "compiled program (lax.scan) — "
                         "amortizes host dispatch; per-step CSV logging and "
                         "eval cadence are preserved")
     p.add_argument("--heartbeat-interval", type=float, default=1.0, metavar="SEC",
@@ -214,16 +214,6 @@ def main(argv=None) -> int:
                     file=sys.stderr,
                 )
                 return 2
-
-    if args.mode == "fsdp" and args.steps_per_dispatch > 1:
-        # fsdp has no scanned dispatcher yet; silently training per-step
-        # would misrepresent the measured regime
-        print(
-            "error: --steps-per-dispatch is not supported in --mode fsdp yet "
-            "(use --mode sync or --no-distributed)",
-            file=sys.stderr,
-        )
-        return 2
 
     if args.profile_dir and args.mode in ("ps", "local-sgd"):
         # tracing is wired into the shared training loop (single / sync);
