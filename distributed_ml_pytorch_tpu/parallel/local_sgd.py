@@ -34,7 +34,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_ml_pytorch_tpu.training.trainer import (
     TrainState,
-    create_train_state,
     cross_entropy_loss,
     evaluate,
     make_eval_fn,
@@ -81,10 +80,20 @@ def make_local_sgd_round(
             return st.replace(params=params, opt_state=opt_state, step=st.step + 1), loss
 
         state, losses = jax.lax.scan(body, state, (images, labels))
+
         # the periodic synchronization: one parameter pmean per round turns the
-        # diverged per-device params back into a replicated (invariant) state
-        params = jax.tree.map(lambda p: jax.lax.pmean(p, axis), state.params)
-        opt_state = jax.tree.map(lambda s: jax.lax.pmean(s, axis), state.opt_state)
+        # diverged per-device params back into a replicated (invariant) state.
+        # Integer leaves (adam's / a schedule's int32 `count`, the step) are
+        # identical across devices and must NOT be pmean'd — pmean(int32)
+        # returns float32, which would silently recompile round 2 and break
+        # bias-correction counts past 2^24.
+        def average(leaf):
+            if jnp.issubdtype(leaf.dtype, jnp.integer):
+                return jax.lax.pmax(leaf, axis)
+            return jax.lax.pmean(leaf, axis)
+
+        params = jax.tree.map(average, state.params)
+        opt_state = jax.tree.map(average, state.opt_state)
         step = jax.lax.pmax(state.step, axis)  # identical on all devices
         state = state.replace(params=params, opt_state=opt_state, step=step)
         return state, jax.lax.pmean(losses, axis)
@@ -134,7 +143,10 @@ def train_local_sgd(args, mesh: Mesh | None = None) -> Tuple[TrainState, Metrics
         getattr(args, "model", "alexnet"),
         dtype=jnp.bfloat16 if getattr(args, "dtype", "float32") == "bfloat16" else jnp.float32,
     )
-    state, tx = create_train_state(model, jax.random.key(getattr(args, "seed", 0)), args.lr)
+    from distributed_ml_pytorch_tpu.training.trainer import state_from_args
+
+    per_proc_batch = global_batch // n_proc
+    state, tx = state_from_args(args, model, len(x_train) // per_proc_batch)
     state = replicate(mesh, state)
     round_fn = make_local_sgd_round(model, tx, mesh)
     eval_step = make_eval_fn(model)

@@ -33,7 +33,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_ml_pytorch_tpu.training.trainer import (
     TrainState,
-    create_train_state,
     cross_entropy_loss,
     make_eval_fn,
     run_training_loop,
@@ -191,29 +190,12 @@ def train_data_parallel(
         dtype=jnp.bfloat16 if getattr(args, "dtype", "float32") == "bfloat16" else jnp.float32,
     )
     from distributed_ml_pytorch_tpu.training.trainer import (
-        make_lr_schedule,
         setup_checkpoint,
+        state_from_args,
     )
 
     per_proc_batch = global_batch // n_proc
-    grad_accum = int(getattr(args, "grad_accum", 1) or 1)
-    lr = make_lr_schedule(
-        getattr(args, "lr_schedule", "constant"),
-        args.lr,
-        # schedule steps = optimizer updates (MultiSteps emits one per K)
-        steps_per_epoch=max(1, len(x_train) // per_proc_batch // grad_accum),
-        total_epochs=args.epochs,
-    )
-    state, tx = create_train_state(
-        model,
-        jax.random.key(getattr(args, "seed", 0)),
-        lr,
-        momentum=getattr(args, "momentum", 0.0),
-        grad_accum=grad_accum,
-        optimizer=getattr(args, "optimizer", "sgd"),
-        weight_decay=getattr(args, "weight_decay", None),
-        grad_clip=getattr(args, "grad_clip", 0.0),
-    )
+    state, tx = state_from_args(args, model, len(x_train) // per_proc_batch)
     # restore (if resuming) BEFORE mesh placement: orbax hands back host
     # arrays and the strategy then lays them out like a fresh init
     ckpt, state, start_epoch, start_iter = setup_checkpoint(

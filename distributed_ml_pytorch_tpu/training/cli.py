@@ -195,10 +195,13 @@ def main(argv=None) -> int:
         )
         return 2
 
-    if args.mode in ("ps", "local-sgd"):
-        # these knobs are wired into the single-process and sync trainers;
-        # silently ignoring them would mislead (constant-lr / 1x batch runs)
-        for flag, bad in (
+    # knobs not wired into a mode are rejected loudly — silently ignoring
+    # them would mislead (constant-lr / 1x batch runs). ps keeps plain SGD
+    # (DownPour parity: the worker optimizer IS the reference recipe);
+    # local-sgd wires the optimizer/schedule knobs but not grad-accum or
+    # chunked dispatch (its rounds already scan sync_every steps).
+    if args.mode == "ps":
+        gated = (
             ("--grad-accum", args.grad_accum > 1),
             ("--lr-schedule", args.lr_schedule != "constant"),
             ("--optimizer", args.optimizer != "sgd"),
@@ -206,14 +209,22 @@ def main(argv=None) -> int:
             ("--weight-decay", args.weight_decay is not None),
             ("--grad-clip", args.grad_clip != 0.0),
             ("--steps-per-dispatch", args.steps_per_dispatch > 1),
-        ):
-            if bad:
-                print(
-                    "error: {} is not supported in --mode {} yet "
-                    "(use --mode sync or --no-distributed)".format(flag, args.mode),
-                    file=sys.stderr,
-                )
-                return 2
+        )
+    elif args.mode == "local-sgd":
+        gated = (
+            ("--grad-accum", args.grad_accum > 1),
+            ("--steps-per-dispatch", args.steps_per_dispatch > 1),
+        )
+    else:
+        gated = ()
+    for flag, bad in gated:
+        if bad:
+            print(
+                "error: {} is not supported in --mode {} yet "
+                "(use --mode sync or --no-distributed)".format(flag, args.mode),
+                file=sys.stderr,
+            )
+            return 2
 
     if args.profile_dir and args.mode in ("ps", "local-sgd"):
         # tracing is wired into the shared training loop (single / sync);

@@ -157,6 +157,36 @@ def create_train_state(
     return TrainState.create(params, tx), tx
 
 
+def state_from_args(args, model, steps_per_epoch: int):
+    """Build ``(state, tx)`` from the CLI argument surface — the ONE place
+    the optimizer/schedule/accumulation knobs are read, shared by the
+    single-process, sync/fsdp, and local-sgd trainers so a new knob cannot
+    be silently dropped by one mode.
+
+    ``steps_per_epoch`` is in raw batches; with ``--grad-accum K`` the LR
+    schedule advances once per K micro-batches (``optax.MultiSteps`` emits
+    one optimizer update per K), so the schedule's epoch is measured in
+    optimizer updates.
+    """
+    grad_accum = int(getattr(args, "grad_accum", 1) or 1)
+    lr = make_lr_schedule(
+        getattr(args, "lr_schedule", "constant"),
+        args.lr,
+        steps_per_epoch=max(1, int(steps_per_epoch) // grad_accum),
+        total_epochs=args.epochs,
+    )
+    return create_train_state(
+        model,
+        jax.random.key(getattr(args, "seed", 0)),
+        lr,
+        momentum=getattr(args, "momentum", 0.0),
+        grad_accum=grad_accum,
+        optimizer=getattr(args, "optimizer", "sgd"),
+        weight_decay=getattr(args, "weight_decay", None),
+        grad_clip=getattr(args, "grad_clip", 0.0),
+    )
+
+
 def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Mean softmax cross-entropy (reference ``F.cross_entropy``, ``example/main.py:71``)."""
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
@@ -476,25 +506,7 @@ def train_single(args) -> Tuple[TrainState, MetricsLogger]:
         dtype=jnp.bfloat16 if getattr(args, "dtype", "float32") == "bfloat16" else jnp.float32,
     )
     steps_per_epoch = max(1, len(x_train) // args.batch_size)
-    grad_accum = int(getattr(args, "grad_accum", 1) or 1)
-    lr = make_lr_schedule(
-        getattr(args, "lr_schedule", "constant"),
-        args.lr,
-        # MultiSteps advances the inner schedule once per K micro-batches, so
-        # the schedule's epoch must be measured in optimizer updates
-        steps_per_epoch=max(1, steps_per_epoch // grad_accum),
-        total_epochs=args.epochs,
-    )
-    state, tx = create_train_state(
-        model,
-        jax.random.key(getattr(args, "seed", 0)),
-        lr,
-        momentum=getattr(args, "momentum", 0.0),
-        grad_accum=grad_accum,
-        optimizer=getattr(args, "optimizer", "sgd"),
-        weight_decay=getattr(args, "weight_decay", None),
-        grad_clip=getattr(args, "grad_clip", 0.0),
-    )
+    state, tx = state_from_args(args, model, steps_per_epoch)
     train_step = make_train_step(model, tx)
     scan_step = (
         make_scan_train_step(model, tx)
