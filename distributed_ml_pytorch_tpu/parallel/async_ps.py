@@ -76,6 +76,8 @@ class ParameterServer:
         transport: Optional[Transport] = None,
         n_workers: Optional[int] = None,
         worker_timeout: Optional[float] = None,
+        ckpt_dir: Optional[str] = None,
+        ckpt_every: int = 500,
     ):
         if params is not None:
             self.central = np.asarray(params, dtype=np.float32).copy()
@@ -88,6 +90,13 @@ class ParameterServer:
         self.worker_timeout = worker_timeout
         self.failed_workers: set = set()
         self.message_counts = {code: 0 for code in MessageCode}
+        # preemption safety for the central params (the only training state
+        # the topology cannot recover: a worker rejoins and re-pulls, but a
+        # restarted server would otherwise reset to fresh init)
+        self.ckpt_dir = ckpt_dir
+        self.ckpt_every = int(ckpt_every or 0)
+        self._push_count = 0
+        self._restored = False
         from distributed_ml_pytorch_tpu.utils.failure import StalenessAuditor
 
         self.staleness = StalenessAuditor()
@@ -96,6 +105,46 @@ class ParameterServer:
     def stop(self) -> None:
         self._stop.set()
 
+    def _ckpt_path(self) -> str:
+        import os
+
+        return os.path.join(self.ckpt_dir, "ps_central.npy")
+
+    def save_checkpoint(self) -> None:
+        """Atomically persist the central flat params (write-then-rename, so
+        a preemption mid-write can never leave a torn checkpoint)."""
+        if not self.ckpt_dir:
+            return
+        import os
+
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+        path = self._ckpt_path()
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.save(f, self.central)
+        os.replace(tmp, path)
+
+    def maybe_restore(self) -> bool:
+        """Adopt a previously-saved central vector; False if none exists.
+        A size mismatch (different model) fails loudly — silently training a
+        fresh init while claiming to resume is the one wrong answer."""
+        if not self.ckpt_dir:
+            return False
+        import os
+
+        path = self._ckpt_path()
+        if not os.path.exists(path):
+            return False
+        arr = np.load(path)
+        if arr.shape != self.central.shape:
+            raise ValueError(
+                f"checkpoint at {path} holds {arr.shape[0]} params but the "
+                f"model ravels to {self.central.shape[0]} — wrong --model?"
+            )
+        self.central = arr.astype(np.float32)
+        self._restored = True
+        return True
+
     def handle(self, sender: int, code: MessageCode, payload: np.ndarray) -> None:
         _LOGGER.info("Processing message: %s", code.name)
         self.message_counts[code] = self.message_counts.get(code, 0) + 1
@@ -103,13 +152,28 @@ class ParameterServer:
             # workers pre-scale by -lr (Asynchronous.py:55) → server-side add
             self.central += payload
             self.staleness.on_push(sender)
+            self._push_count += 1
+            if self.ckpt_dir and self.ckpt_every and (
+                self._push_count % self.ckpt_every == 0
+            ):
+                self.save_checkpoint()
         elif code == MessageCode.ParameterRequest:
             send_message(
                 MessageCode.ParameterUpdate, self.central, dst=sender, transport=self.transport
             )
             self.staleness.on_pull(sender)
         elif code == MessageCode.ParameterUpdate:
-            self.central = payload.astype(np.float32).copy()
+            if self._restored:
+                # a restored server must not let a fresh worker's
+                # construction-time install stomp the checkpoint; answer
+                # with the authoritative params instead (the worker's
+                # listener swaps them in between steps — the rejoin flow)
+                send_message(
+                    MessageCode.ParameterUpdate, self.central, dst=sender,
+                    transport=self.transport,
+                )
+            else:
+                self.central = payload.astype(np.float32).copy()
 
     def run(self, timeout: Optional[float] = None) -> None:
         """Serve until all workers finish (or ``stop()``/``timeout``).
@@ -165,6 +229,7 @@ class ParameterServer:
                     break
                 continue
             self.handle(sender, code, payload)
+        self.save_checkpoint()  # final state survives a clean shutdown too
         line = self.staleness.report()
         if line:
             print("parameter server:", line)
@@ -423,7 +488,11 @@ def run_server(args, transport: Transport) -> ParameterServer:
         transport=transport,
         n_workers=args.world_size - 1,
         worker_timeout=getattr(args, "worker_timeout", 0.0) or None,
+        ckpt_dir=getattr(args, "ckpt_dir", "") or None,
+        ckpt_every=getattr(args, "ckpt_every", 500),
     )
+    if getattr(args, "resume", False) and server.maybe_restore():
+        print("parameter server: resumed central params from", server._ckpt_path())
     server.run()
     if server.failed_workers:
         print(

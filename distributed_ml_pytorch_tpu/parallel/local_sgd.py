@@ -37,6 +37,7 @@ from distributed_ml_pytorch_tpu.training.trainer import (
     cross_entropy_loss,
     evaluate,
     make_eval_fn,
+    state_from_args,
 )
 from distributed_ml_pytorch_tpu.utils.metrics import MetricsLogger, print_eval_line
 
@@ -143,8 +144,6 @@ def train_local_sgd(args, mesh: Mesh | None = None) -> Tuple[TrainState, Metrics
         getattr(args, "model", "alexnet"),
         dtype=jnp.bfloat16 if getattr(args, "dtype", "float32") == "bfloat16" else jnp.float32,
     )
-    from distributed_ml_pytorch_tpu.training.trainer import state_from_args
-
     per_proc_batch = global_batch // n_proc
     state, tx = state_from_args(args, model, len(x_train) // per_proc_batch)
     state = replicate(mesh, state)
@@ -158,7 +157,7 @@ def train_local_sgd(args, mesh: Mesh | None = None) -> Tuple[TrainState, Metrics
     for epoch in range(args.epochs):
         print("Training for epoch {}".format(epoch))
         for rx, ry in _round_batches(
-            x_train, y_train, global_batch // n_proc, k, getattr(args, "seed", 0), epoch
+            x_train, y_train, per_proc_batch, k, getattr(args, "seed", 0), epoch
         ):
             rx = put_sharded(mesh, rx, P(None, "data", None, None, None))
             ry = put_sharded(mesh, ry, P(None, "data"))

@@ -92,9 +92,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="checkpoint directory (empty = checkpointing off; "
                         "reference has no checkpointing at all, SURVEY.md §5.4)")
     p.add_argument("--ckpt-every", type=int, default=500, metavar="N",
-                   help="save a checkpoint every N global steps")
+                   help="save a checkpoint every N global steps (--mode ps: "
+                        "every N gradient pushes, summed across workers)")
     p.add_argument("--ckpt-keep", type=int, default=3, metavar="N",
-                   help="retain the newest N checkpoints")
+                   help="retain the newest N checkpoints (ignored by --mode "
+                        "ps, which keeps one atomically-replaced file)")
     p.add_argument("--resume", action="store_true", default=False,
                    help="resume from the latest checkpoint in --ckpt-dir")
     p.add_argument("--profile-dir", type=str, default="",
@@ -184,13 +186,15 @@ def main(argv=None) -> int:
         print("Finished Training")
         return 0
 
-    if args.ckpt_dir and args.mode in ("ps", "local-sgd"):
-        # checkpointing is wired into the single-process and sync trainers;
-        # fail loudly rather than silently training without preemption safety
+    if args.ckpt_dir and args.mode == "local-sgd":
+        # checkpointing is wired into the single-process, sync/fsdp, and ps
+        # trainers (ps: the SERVER checkpoints its central params; a worker
+        # recovers by rejoining and re-pulling, --rejoin); fail loudly
+        # rather than silently training without preemption safety
         print(
-            "error: --ckpt-dir is not supported in --mode {} yet; "
+            "error: --ckpt-dir is not supported in --mode local-sgd yet; "
             "no checkpoints would be written (use --mode sync, or drop "
-            "--ckpt-dir to train without preemption safety)".format(args.mode),
+            "--ckpt-dir to train without preemption safety)",
             file=sys.stderr,
         )
         return 2

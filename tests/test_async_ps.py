@@ -37,6 +37,70 @@ def test_server_gradient_update_adds():
     np.testing.assert_allclose(server.central, flat + delta, rtol=1e-6)
 
 
+def test_server_checkpoint_cadence_and_restore(tmp_path):
+    """Central params persist every ckpt_every pushes (atomic write) and a
+    fresh server adopts them — PS preemption safety: workers recover by
+    rejoining, only the server's state would otherwise be lost."""
+    _, params = _lenet_params()
+    flat = np.asarray(ravel_model_params(params))
+    server = ParameterServer(params=flat, ckpt_dir=str(tmp_path), ckpt_every=2)
+    delta = np.random.default_rng(1).normal(size=flat.shape).astype(np.float32)
+
+    server.handle(1, MessageCode.GradientUpdate, delta)
+    assert not (tmp_path / "ps_central.npy").exists()  # cadence not reached
+    server.handle(2, MessageCode.GradientUpdate, delta)
+    assert (tmp_path / "ps_central.npy").exists()
+
+    fresh = ParameterServer(params=flat, ckpt_dir=str(tmp_path))
+    assert fresh.maybe_restore()
+    np.testing.assert_allclose(fresh.central, flat + 2 * delta, rtol=1e-6)
+
+
+def test_server_restore_rejects_wrong_model(tmp_path):
+    _, params = _lenet_params()
+    flat = np.asarray(ravel_model_params(params))
+    server = ParameterServer(params=flat, ckpt_dir=str(tmp_path))
+    server.save_checkpoint()
+    other = ParameterServer(params=flat[:100].copy(), ckpt_dir=str(tmp_path))
+    with pytest.raises(ValueError, match="wrong --model"):
+        other.maybe_restore()
+
+
+def test_restored_server_survives_fresh_worker_install(tmp_path):
+    """A resumed server must NOT be stomped by a non-rejoin worker's
+    construction-time ParameterUpdate install — it answers with the
+    authoritative (restored) params instead."""
+    from distributed_ml_pytorch_tpu.utils.messaging import InProcessTransport
+
+    _, params = _lenet_params()
+    flat = np.asarray(ravel_model_params(params))
+    saved = flat + 7.0
+    writer = ParameterServer(params=saved.copy(), ckpt_dir=str(tmp_path))
+    writer.save_checkpoint()
+
+    world = InProcessTransport.create_world(2)
+    server = ParameterServer(
+        params=flat, transport=world[0], ckpt_dir=str(tmp_path)
+    )
+    assert server.maybe_restore()
+
+    fresh_init = np.zeros_like(flat)
+    server.handle(1, MessageCode.ParameterUpdate, fresh_init)
+    np.testing.assert_allclose(server.central, saved, rtol=1e-6)  # not stomped
+    # and the worker got the restored params back
+    sender, code, payload = world[1].recv(timeout=5)
+    assert code == MessageCode.ParameterUpdate
+    np.testing.assert_allclose(payload, saved, rtol=1e-6)
+
+
+def test_server_restore_without_checkpoint_is_noop(tmp_path):
+    _, params = _lenet_params()
+    flat = np.asarray(ravel_model_params(params))
+    server = ParameterServer(params=flat, ckpt_dir=str(tmp_path))
+    assert not server.maybe_restore()
+    np.testing.assert_allclose(server.central, flat)
+
+
 def test_server_parameter_request_replies():
     world = InProcessTransport.create_world(2)
     _, params = _lenet_params()
