@@ -36,6 +36,45 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+class Rate(float):
+    """images/sec (or tokens/sec) that also carries the leg's FLOPs story:
+    ``.flops_per_step`` (XLA's count for one step), ``.tflops`` (achieved),
+    ``.mfu`` (fraction of the chip's bf16 peak) — any may be None when the
+    backend doesn't report flops or the device kind has no peak entry."""
+
+    flops_per_step: float | None = None
+    tflops: float | None = None
+    mfu: float | None = None
+
+    @staticmethod
+    def make(value: float, flops_per_step, step_seconds) -> "Rate":
+        from distributed_ml_pytorch_tpu.utils.flops import utilization
+
+        r = Rate(value)
+        r.flops_per_step = flops_per_step
+        r.tflops, r.mfu = utilization(flops_per_step, step_seconds)
+        return r
+
+    def mfu_note(self) -> str:
+        """Human fragment for BASELINE notes: '12.3 TFLOP/s, 6.2% MFU'."""
+        if self.tflops is None:
+            return "flops not reported by backend"
+        if self.mfu is None:
+            return f"{self.tflops:.1f} TFLOP/s (no peak table for device)"
+        return f"{self.tflops:.1f} TFLOP/s, {self.mfu:.1%} MFU"
+
+    def record_fields(self) -> dict:
+        """The FLOPs story as JSON record fields — the single serialization
+        used by bench.py's headline and bench_all's emit."""
+        rec = {}
+        if self.tflops is not None:
+            rec["flops_per_step"] = self.flops_per_step
+            rec["tflops"] = round(self.tflops, 2)
+            if self.mfu is not None:
+                rec["mfu"] = round(self.mfu, 4)
+        return rec
+
+
 def make_batch(batch: int, seed: int = 0, k: int = 0,
                shape: tuple = (32, 32, 3), n_classes: int = 10):
     """Synthetic image batch (CIFAR-shaped by default); ``k > 0`` stacks k
@@ -120,11 +159,17 @@ def bench_jax(batch: int = BATCH, k: int | None = None, model=None,
     # a single trial's jitter polluting both terms
     extra_steps = (n_long - n_short) * k
     per_step = (min(longs) - min(shorts)) / extra_steps
-    rate = batch / per_step
     dev = jax.devices()[0]
+    from distributed_ml_pytorch_tpu.utils.flops import compiled_flops
+
+    # XLA's cost_analysis counts a lax.scan body ONCE (not x trip count —
+    # verified against a bare scanned matmul), so the k-step scan program's
+    # reported flops ARE the per-step flops (+ negligible outside-body ops)
+    scan_flops = compiled_flops(train_scan, state, images, labels, rng)
+    rate = Rate.make(batch / per_step, scan_flops, per_step)
     log(f"jax [{dev.platform}]: min-min differenced steady state over {trials} "
         f"trials, batch {batch}, {k}-step scans → {per_step * 1e6:.1f} us/step, "
-        f"{rate:.1f} img/s, final loss {float(losses[-1]):.4f}")
+        f"{rate:.1f} img/s ({rate.mfu_note()}), final loss {float(losses[-1]):.4f}")
     return rate
 
 
@@ -195,12 +240,15 @@ def main() -> None:
     ips = bench_jax()
     base = bench_torch_cpu()
     vs = round(ips / base, 2) if base else None  # null = baseline not measurable here
-    print(json.dumps({
+    rec = {
         "metric": "alexnet_cifar10_train_throughput_per_chip",
         "value": round(ips, 1),
         "unit": "images/sec/chip",
         "vs_baseline": vs,
-    }), flush=True)
+    }
+    if isinstance(ips, Rate):
+        rec.update(ips.record_fields())
+    print(json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,5 +1,6 @@
 from distributed_ml_pytorch_tpu.data.cifar10 import (
     CIFAR10_CLASSES,
+    download_cifar10,
     get_dataset,
     load_cifar10,
     synthetic_cifar10,
@@ -10,6 +11,7 @@ from distributed_ml_pytorch_tpu.data.cifar10 import (
 
 __all__ = [
     "CIFAR10_CLASSES",
+    "download_cifar10",
     "get_dataset",
     "load_cifar10",
     "synthetic_cifar10",

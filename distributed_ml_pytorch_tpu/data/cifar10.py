@@ -21,8 +21,10 @@ Layout is NHWC (TPU-native), not the reference's NCHW.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
+import shutil
 import tarfile
 from typing import Iterator, Tuple
 
@@ -34,6 +36,80 @@ CIFAR10_CLASSES = (
 
 _BATCHES_DIR = "cifar-10-batches-py"
 _TARBALL = "cifar-10-python.tar.gz"
+
+# canonical distribution + its published md5 (the same pair torchvision's
+# CIFAR10(download=True) verifies against — reference ``example/main.py:24``)
+CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+CIFAR10_MD5 = "c58f30108f718f92721af3b95e74349a"
+
+
+def download_cifar10(root: str, url: str | None = None,
+                     md5: str | None = None,
+                     timeout: float = 30.0) -> str:
+    """Guarded CIFAR-10 acquisition (reference ``example/main.py:24``
+    ``download=True``): fetch the tarball to ``root``, verify its md5,
+    install atomically (.part → rename), extract, and return the batches
+    directory. Raises on network failure or checksum mismatch — callers
+    decide whether the synthetic stand-in is an acceptable fallback.
+
+    ``url`` may be any scheme urllib supports; tests exercise the full
+    verify/extract path with a fabricated archive over ``file://``.
+    """
+    import urllib.request
+
+    # resolved at call time (not def time) so tests/deployments can point
+    # the module-level URL/MD5 at a mirror
+    url = CIFAR10_URL if url is None else url
+    md5 = CIFAR10_MD5 if md5 is None else md5
+
+    os.makedirs(root, exist_ok=True)
+    dest = os.path.join(root, _TARBALL)
+    if not os.path.isfile(dest):
+        # per-process .part name: N launcher ranks may race this download
+        # (launch_world spawns workers that all call get_dataset); each
+        # fetches privately and the os.replace installs atomically —
+        # last-finisher wins with identical, verified bytes
+        part = f"{dest}.{os.getpid()}.part"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp, \
+                    open(part, "wb") as f:
+                shutil.copyfileobj(resp, f)
+            if md5:
+                digest = hashlib.md5()
+                with open(part, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        digest.update(chunk)
+                if digest.hexdigest() != md5:
+                    raise ValueError(
+                        f"checksum mismatch for {url}: got {digest.hexdigest()}, "
+                        f"want {md5} — refusing to install"
+                    )
+            os.replace(part, dest)  # atomic: readers never see a torn tarball
+        finally:
+            if os.path.exists(part):
+                os.remove(part)
+    d = os.path.join(root, _BATCHES_DIR)
+    if os.path.isdir(d):  # already installed: don't re-extract 170 MB
+        return d
+    # extract into a private dir, then one atomic rename: concurrent ranks
+    # must never read a half-extracted batches dir
+    tmp_extract = f"{d}.{os.getpid()}.extract"
+    with tarfile.open(dest, "r:gz") as tf:
+        tf.extractall(tmp_extract, filter="data")
+    extracted = os.path.join(tmp_extract, _BATCHES_DIR)
+    if not os.path.isdir(extracted):
+        shutil.rmtree(tmp_extract, ignore_errors=True)
+        raise FileNotFoundError(
+            f"archive at {dest} did not contain {_BATCHES_DIR}/"
+        )
+    try:
+        os.rename(extracted, d)
+    except OSError:
+        if not os.path.isdir(d):  # a real failure, not "another rank won"
+            raise
+    finally:
+        shutil.rmtree(tmp_extract, ignore_errors=True)
+    return d
 
 
 def _normalize(images_u8: np.ndarray) -> np.ndarray:
@@ -105,21 +181,38 @@ def synthetic_cifar10(
 
 def load_cifar10(
     root: str = "./data", synthetic: bool | None = None, seed: int = 0,
-    n_train: int = 50000, n_test: int = 10000,
+    n_train: int = 50000, n_test: int = 10000, download: bool | None = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, bool]:
     """Return ``(x_train, y_train, x_test, y_test, is_synthetic)``, normalized.
 
     ``synthetic=None`` auto-detects: real data if on disk under ``root``
     (reference downloads to ``./data``, ``example/main.py:24-25``), else the
     deterministic stand-in.
+
+    ``download=None`` attempts the network fetch exactly when the caller
+    demanded real data (``synthetic=False``) and it isn't on disk — a
+    deployed user gets the dataset with zero manual steps, while offline
+    auto-detect runs never stall on a dead network. ``download=True``
+    forces the attempt even under auto-detect; failures then fall back to
+    the stand-in (auto-detect semantics) instead of raising.
     """
     loaded = None
     if synthetic is not True:
         loaded = _load_pickle_batches(root)
+        if loaded is None and (download or (download is None and synthetic is False)):
+            try:
+                download_cifar10(root)
+                loaded = _load_pickle_batches(root)
+            except Exception as e:
+                if synthetic is False:
+                    raise FileNotFoundError(
+                        f"CIFAR-10 not under {root!r} and download failed: {e}"
+                    ) from e
         if loaded is None and synthetic is False:
             raise FileNotFoundError(
                 f"CIFAR-10 not found under {root!r} (no {_BATCHES_DIR}/ or {_TARBALL}); "
-                "this environment has no network egress — pass synthetic=True or None"
+                "pass download=True (or fix the network), or synthetic=True/None "
+                "for the deterministic stand-in"
             )
     if loaded is not None:
         x_train, y_train, x_test, y_test = loaded
@@ -137,6 +230,7 @@ def get_dataset(args) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         synthetic=True if getattr(args, "synthetic_data", False) else None,
         n_train=getattr(args, "synthetic_train_size", 50000),
         n_test=getattr(args, "synthetic_test_size", 10000),
+        download=True if getattr(args, "download", False) else None,
     )
     return x_train, y_train, x_test, y_test
 

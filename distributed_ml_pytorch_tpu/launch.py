@@ -47,12 +47,36 @@ def cpu_platform_env(base: dict | None = None, n_devices: int = 1) -> dict:
     return env
 
 
+def rank_env(rank: int, *, cpu: bool = True,
+             tpu_worker_rank: int | None = None) -> dict:
+    """Per-rank environment for the PS topology.
+
+    The control plane is host-side, so by default every rank runs on the CPU
+    platform (N local processes must not fight over one chip). Passing
+    ``tpu_worker_rank`` pins exactly that rank to the process's default
+    (accelerator) platform — the DownPour layout the reference was built
+    for: a central server plus workers that actually train on the
+    accelerator (``asgd/optim/Asynchronous.py:42-70``), with push/pull
+    crossing the device↔host boundary at the step cadence.
+    """
+    if tpu_worker_rank is not None:
+        # pinning means EXCLUSIVE chip access: every other rank goes to the
+        # CPU platform even under cpu=False, or N processes would fight over
+        # libtpu's single-owner device and crash — the exact failure the
+        # flag exists to prevent
+        if rank == tpu_worker_rank:
+            return dict(os.environ)  # default platform: the TPU when present
+        return cpu_platform_env()
+    return cpu_platform_env() if cpu else dict(os.environ)
+
+
 def launch_world(
     world_size: int,
     extra_args: List[str],
     *,
     port: str | None = None,
     cpu: bool = True,
+    tpu_worker_rank: int | None = None,
     poll_interval: float = 0.2,
 ) -> int:
     """Spawn 1 server + (world_size-1) workers; returns the worst exit code.
@@ -61,17 +85,30 @@ def launch_world(
     still running, the rest are killed — a crashed worker must not leave the
     server blocked in accept()/run() forever.
     """
+    if tpu_worker_rank is not None and not 1 <= tpu_worker_rank < world_size:
+        # rank 0 is always the server (it never trains — pinning it wastes
+        # the chip and mislabels CPU numbers as TPU numbers); out-of-range
+        # ranks would silently pin nothing
+        raise ValueError(
+            f"tpu_worker_rank={tpu_worker_rank} must be a worker rank "
+            f"(1..{world_size - 1})"
+        )
     port = port or _free_port()
-    env = cpu_platform_env() if cpu else dict(os.environ)
     common = [
         sys.executable, "-m", "distributed_ml_pytorch_tpu.training.cli",
         "--mode", "ps", "--world-size", str(world_size), "--port", port,
     ] + list(extra_args)
+    envs = [
+        rank_env(r, cpu=cpu, tpu_worker_rank=tpu_worker_rank)
+        for r in range(world_size)
+    ]
     procs = [
-        subprocess.Popen(common + ["--rank", "0", "--server"], env=env)
+        subprocess.Popen(common + ["--rank", "0", "--server"], env=envs[0])
     ]
     for rank in range(1, world_size):
-        procs.append(subprocess.Popen(common + ["--rank", str(rank)], env=env))
+        procs.append(
+            subprocess.Popen(common + ["--rank", str(rank)], env=envs[rank])
+        )
     try:
         while True:
             codes = [p.poll() for p in procs]
@@ -105,10 +142,15 @@ def main(argv=None) -> int:
     parser.add_argument("--port", type=str, default=None)
     parser.add_argument("--tpu", action="store_true",
                         help="let processes use the default (TPU) platform instead of CPU")
+    parser.add_argument("--tpu-worker", type=int, default=None, metavar="RANK",
+                        help="pin this worker rank to the default (TPU) "
+                             "platform while the server and other ranks stay "
+                             "on CPU — the DownPour accelerator-worker layout")
     args, extra = parser.parse_known_args(argv)
     if extra and extra[0] == "--":
         extra = extra[1:]
-    return launch_world(args.world_size, extra, port=args.port, cpu=not args.tpu)
+    return launch_world(args.world_size, extra, port=args.port,
+                        cpu=not args.tpu, tpu_worker_rank=args.tpu_worker)
 
 
 if __name__ == "__main__":

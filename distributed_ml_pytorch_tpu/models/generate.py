@@ -32,6 +32,21 @@ def _decode_model(model, cache_size: int):
     return model.clone(decode=True, cache_size=cache_size, attn_fn=None)
 
 
+def _check_max_len(model, total: int) -> None:
+    """RoPE rotates by position instead of indexing a table, so max_len does
+    not bound its positions — the guard protects only learned embeddings."""
+    max_len = getattr(model, "max_len", None)
+    if (
+        max_len is not None
+        and total > max_len
+        and getattr(model, "pos_encoding", "learned") != "rope"
+    ):
+        raise ValueError(
+            f"prompt + max_new_tokens = {total} exceeds the model's max_len "
+            f"{max_len} — position embeddings would go out of range"
+        )
+
+
 def init_cache(model, batch: int, cache_size: int):
     """Allocate the per-layer K/V cache (zeros, cursor at 0) for ``batch``
     sequences of total length ``cache_size``."""
@@ -66,21 +81,73 @@ def generate(
     rng = rng if rng is not None else jax.random.key(0)
     b, p = prompt.shape
     total = p + max_new_tokens
-    max_len = getattr(model, "max_len", None)
-    # RoPE rotates by position instead of indexing a table, so max_len does
-    # not bound its positions — the guard protects only learned embeddings
-    if (
-        max_len is not None
-        and total > max_len
-        and getattr(model, "pos_encoding", "learned") != "rope"
-    ):
-        raise ValueError(
-            f"prompt + max_new_tokens = {total} exceeds the model's max_len "
-            f"{max_len} — position embeddings would go out of range"
-        )
+    _check_max_len(model, total)
     if max_new_tokens < 1:
         return prompt
     cache = init_cache(model, b, total)
+    dec = _decode_model(model, total)
+    return _generate_jit(
+        dec, int(max_new_tokens), float(temperature), params, cache, prompt, rng
+    )
+
+
+def generate_tp(
+    model,
+    params,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    mesh,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    data_axis: str = "data",
+    model_axis: str = "model",
+) -> jnp.ndarray:
+    """Tensor-parallel decode: ``generate`` semantics on a dp×tp mesh.
+
+    Capability symmetry with the training-side TP
+    (``parallel/tensor_parallel.py``): the same Megatron layout serves
+    inference — params sharded by :func:`tp_param_specs` (q/k/v column-,
+    o row-, lm_head vocab-sharded), batch over ``data_axis``, and the K/V
+    cache sharded over *heads* on ``model_axis`` (heads follow the q/k/v
+    column shards, so cache append + cached attention stay device-local;
+    the per-block all-reduce on attention/MLP outputs is inserted by XLA).
+    The compiled program is the same prefill+scan as :func:`generate` —
+    GSPMD propagates the shardings through it; greedy decode is therefore
+    bit-identical to the single-device path (tested).
+    """
+    from distributed_ml_pytorch_tpu.parallel.tensor_parallel import (
+        _check_divisibility,
+        tp_param_specs,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    _check_divisibility(model, int(mesh.shape[model_axis]))
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 sampling needs an rng key")
+    rng = rng if rng is not None else jax.random.key(0)
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    _check_max_len(model, total)  # same guard as generate()
+    if max_new_tokens < 1:
+        return prompt
+
+    param_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), tp_param_specs(params, model_axis),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    params = jax.device_put(params, param_shardings)
+
+    def cache_spec(path, leaf):
+        name = getattr(path[-1], "key", str(path[-1]))
+        if name in ("cached_k", "cached_v"):  # (b, heads, cache, head_dim)
+            return NamedSharding(mesh, P(data_axis, model_axis, None, None))
+        return NamedSharding(mesh, P())  # cursor
+
+    cache = init_cache(model, b, total)
+    cache = jax.device_put(
+        cache, jax.tree_util.tree_map_with_path(cache_spec, cache)
+    )
+    prompt = jax.device_put(prompt, NamedSharding(mesh, P(data_axis, None)))
     dec = _decode_model(model, total)
     return _generate_jit(
         dec, int(max_new_tokens), float(temperature), params, cache, prompt, rng

@@ -97,6 +97,7 @@ class ParameterServer:
         self.ckpt_every = int(ckpt_every or 0)
         self._push_count = 0
         self._restored = False
+        self.rejected_installs = 0
         from distributed_ml_pytorch_tpu.utils.failure import StalenessAuditor
 
         self.staleness = StalenessAuditor()
@@ -167,7 +168,19 @@ class ParameterServer:
                 # a restored server must not let a fresh worker's
                 # construction-time install stomp the checkpoint; answer
                 # with the authoritative params instead (the worker's
-                # listener swaps them in between steps — the rejoin flow)
+                # listener swaps them in between steps — the rejoin flow).
+                # NOTE: _restored is PERMANENT — every later ParameterUpdate
+                # from any worker is likewise answered, never applied. Only
+                # construction-time installs use this message today; a future
+                # protocol change that sends ParameterUpdate to the server
+                # mid-run must account for this (counted + logged so the
+                # rejection is observable, not silent).
+                self.rejected_installs += 1
+                _LOGGER.info(
+                    "restored server: rejecting install #%d from worker %d, "
+                    "answering with authoritative params",
+                    self.rejected_installs, sender,
+                )
                 send_message(
                     MessageCode.ParameterUpdate, self.central, dst=sender,
                     transport=self.transport,
@@ -247,17 +260,25 @@ class Listener(MessageListener):
         super().__init__(transport=transport)
         self._lock = threading.Lock()
         self._latest: Optional[np.ndarray] = None
+        self._got_update = threading.Event()
 
     def receive(self, sender: int, message_code: MessageCode, parameter: np.ndarray) -> None:
         _LOGGER.info("Processing message: %s", message_code.name)
         if message_code == MessageCode.ParameterUpdate:
             with self._lock:
                 self._latest = parameter
+            self._got_update.set()
 
     def take_latest(self) -> Optional[np.ndarray]:
         with self._lock:
             latest, self._latest = self._latest, None
         return latest
+
+    def wait_for_update(self, timeout: float) -> bool:
+        """Block until at least one ParameterUpdate has ever arrived (it may
+        already be consumed); False on timeout. Lets a worker synchronize on
+        the server's authoritative install before its first step."""
+        return self._got_update.wait(timeout)
 
 
 class Asynchronous:
@@ -279,6 +300,7 @@ class Asynchronous:
         transport: Optional[Transport] = None,
         heartbeat: Optional["HeartbeatSender"] = None,
         rejoin: bool = False,
+        install_timeout: float = 5.0,
     ):
         if lr < 0.0:
             raise ValueError("Invalid learning rate: {}".format(lr))
@@ -301,21 +323,41 @@ class Asynchronous:
         self._flat_n = int(ravel_model_params(params).shape[0])
         self._pad = (-self._flat_n) % LANES
         self.accum = jnp.zeros(self._flat_n + self._pad, jnp.float32)
+        # the listener attaches BEFORE anything is sent, so a server reply
+        # (e.g. a restored server answering the install below) can never
+        # race the listener's start — it no longer relies on the transport
+        # buffering messages until the thread attaches
+        self.listener = Listener(transport=transport)
+        self.listener.start()
         if rejoin:
             # elastic restart: ADOPT the server's current central params
-            # instead of stomping them with this process's fresh init — the
-            # pull lands in the listener mailbox and installs at the first
-            # step boundary
+            # instead of stomping them with this process's fresh init. The
+            # reply is awaited (bounded) so the rejoined worker's first step
+            # already runs on central params; on timeout it proceeds locally
+            # and the normal failure path applies.
             send_message(
                 MessageCode.ParameterRequest, np.zeros(0, np.float32), transport=transport
             )
+            if not self.listener.wait_for_update(timeout=install_timeout):
+                print(
+                    "worker: rejoin pull unanswered after {:.1f}s — starting "
+                    "from local init (server slow or down)".format(install_timeout),
+                    file=sys.stderr,
+                )
         else:
-            # install this worker's initial params as the central params (:34)
+            # install this worker's initial params as the central params (:34).
+            # If the server was RESUMED from a checkpoint it rejects this and
+            # answers with its authoritative vector, which the listener
+            # installs at the first step boundary. Any push issued before
+            # that reply lands carries lr-scaled deltas computed at the fresh
+            # init — a one-round-trip transient that is ACCEPTED async
+            # staleness (DownPour tolerates stale deltas by design; keeping
+            # construction to the reference's single install message,
+            # Asynchronous.py:34, outweighs closing it with an extra
+            # handshake).
             send_message(
                 MessageCode.ParameterUpdate, ravel_model_params(params), transport=transport
             )
-        self.listener = Listener(transport=transport)
-        self.listener.start()
         # a dead server degrades the worker to purely-local SGD (see _send).
         # The heartbeat (if any) is owned by the process entry, started before
         # any jit compile — liveness must reflect process health, not compile

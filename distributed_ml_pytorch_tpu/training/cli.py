@@ -78,6 +78,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="CIFAR-10 location (reference downloads here, example/main.py:24)")
     p.add_argument("--synthetic-data", action="store_true", default=False,
                    help="force the deterministic synthetic dataset")
+    p.add_argument("--download", action="store_true", default=False,
+                   help="fetch real CIFAR-10 (checksum-verified) into "
+                        "--data-root when missing; failures fall back to the "
+                        "synthetic stand-in (the reference always downloads, "
+                        "example/main.py:24 — default-off here so offline "
+                        "runs never stall on a dead network)")
     p.add_argument("--synthetic-train-size", type=int, default=50000)
     p.add_argument("--synthetic-test-size", type=int, default=10000)
     p.add_argument("--log-dir", type=str, default="log")

@@ -10,7 +10,15 @@ and steady-state tokens/sec. It is the documented entry into the LM API:
     python -m examples.train_lm --mode ulysses # all-to-all head re-sharding
     python -m examples.train_lm --mode fsdp    # ZeRO-3 sharded state
     python -m examples.train_lm --mode tp      # Megatron GSPMD shardings
+    python -m examples.train_lm --mode pp      # GPipe stages over layers
+    python -m examples.train_lm --mode moe     # dp x ep Switch-MoE experts
     python -m examples.train_lm --mode composite  # 3-D dp x fsdp x tp
+
+Every mode supports ``--steps-per-dispatch K`` (K steps fused into one
+compiled program via ``lax.scan`` over the mode's own sharded step — the
+same chunked-dispatch idea as the CNN trainer's flag) and checkpoint/resume
+via ``--ckpt-dir``/``--ckpt-every``/``--resume`` (orbax, sharding-aware:
+states restore directly into the mode's device layout).
 
 On one host, meshes come up on whatever devices exist (use
 ``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8``
@@ -29,8 +37,22 @@ import numpy as np
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--mode", default="single",
-                   choices=["single", "sp", "ulysses", "fsdp", "tp", "composite"])
+                   choices=["single", "sp", "ulysses", "fsdp", "tp", "pp",
+                            "moe", "composite"])
     p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--n-experts", type=int, default=4,
+                   help="(--mode moe) experts per MoE layer")
+    p.add_argument("--microbatches", type=int, default=4,
+                   help="(--mode pp) GPipe microbatches per step")
+    p.add_argument("--steps-per-dispatch", type=int, default=1, metavar="K",
+                   help="fuse K steps (distinct batches) into one compiled "
+                        "program via lax.scan; --steps must divide by K")
+    p.add_argument("--ckpt-dir", type=str, default="",
+                   help="enable orbax checkpointing under this directory")
+    p.add_argument("--ckpt-every", type=int, default=100,
+                   help="save every N global steps")
+    p.add_argument("--resume", action="store_true", default=False,
+                   help="restore the latest checkpoint from --ckpt-dir")
     p.add_argument("--batch", type=int, default=8, help="global batch (sequences)")
     p.add_argument("--seq", type=int, default=256, help="global sequence length")
     p.add_argument("--vocab", type=int, default=512)
@@ -45,6 +67,46 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-block rematerialization (long sequences)")
     p.add_argument("--seed", type=int, default=0)
     return p
+
+
+def _scalar_loss(metrics) -> float:
+    """Last scalar loss out of any mode's metrics: moe returns (loss, aux),
+    chunked dispatch returns per-step stacks — take the primary, then the
+    final element."""
+    if isinstance(metrics, tuple):
+        metrics = metrics[0]
+    return float(np.asarray(metrics).reshape(-1)[-1])
+
+
+def _stack_sharded(samples):
+    """Stack identically-sharded per-step arrays onto a leading scan axis,
+    keeping each step's sharding (spec lifted to ``P(None, *spec)``).
+    Host-only inputs (e.g. pp's microbatched numpy arrays) stay numpy —
+    jit shards them on entry."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    host = np.stack([np.asarray(a) for a in samples])
+    sh = getattr(samples[0], "sharding", None)
+    if isinstance(sh, NamedSharding):
+        host = jax.device_put(
+            host, NamedSharding(sh.mesh, PartitionSpec(None, *sh.spec))
+        )
+    return host
+
+
+def _make_chunked_step(step):
+    """K steps in one compiled program: ``lax.scan`` over the mode's own
+    step (jit-of-jit inlines it; inner donation is subsumed by the outer)."""
+    from functools import partial
+
+    import jax
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def chunked(state, tokens_k, targets_k):
+        return jax.lax.scan(lambda s, b: step(s, *b), state, (tokens_k, targets_k))
+
+    return chunked
 
 
 def main(argv=None) -> int:
@@ -103,7 +165,7 @@ def main(argv=None) -> int:
         state = create_lm_train_state(lm, jax.random.key(args.seed), tx)
         make = make_sp_train_step if args.mode == "sp" else make_ulysses_train_step
         step = make(lm, tx, mesh)
-        batch = shard_lm_batch(mesh, tokens, targets)
+        shard = lambda t, g: shard_lm_batch(mesh, t, g)
         desc = f"{d_data}x{d_seq} dp x seq ({'ring' if args.mode == 'sp' else 'all-to-all'})"
     elif args.mode in ("single", "fsdp"):
         from distributed_ml_pytorch_tpu.parallel.fsdp import (
@@ -129,7 +191,7 @@ def main(argv=None) -> int:
             init_fn, jax.random.key(args.seed), mesh
         )
         step = make_fsdp_lm_train_step(lm, tx, mesh, shardings)
-        batch = shard_fsdp_batch(mesh, tokens, targets)
+        shard = lambda t, g: shard_fsdp_batch(mesh, t, g)
         desc = "single-device" if args.mode == "single" else (
             f"{n_fsdp}-way fsdp "
             f"({param_shard_fraction(state, mesh):.3f} of params/device)"
@@ -152,8 +214,57 @@ def main(argv=None) -> int:
         )
         state = create_tp_train_state(lm, jax.random.key(args.seed), tx, mesh)
         step = make_tp_train_step(lm, tx, mesh)
-        batch = shard_tp_batch(mesh, tokens, targets)
+        shard = lambda t, g: shard_tp_batch(mesh, t, g)
         desc = f"{d_data}x{d_model_axis} dp x tp"
+    elif args.mode == "pp":
+        from jax.sharding import Mesh
+
+        from distributed_ml_pytorch_tpu.parallel.pipeline import (
+            PipelineLMConfig,
+            create_pp_train_state,
+            make_pp_train_step,
+            microbatch,
+        )
+
+        # stages must divide the layer count; microbatches must divide batch
+        n_stages = math.gcd(n_dev, args.n_layers)
+        n_mb = math.gcd(args.microbatches, args.batch)
+        cfg = PipelineLMConfig(
+            vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+            n_layers=args.n_layers, d_ff=args.d_ff, max_len=max(args.seq, 256),
+        )
+        mesh = Mesh(np.array(jax.devices()[:n_stages]), ("stage",))
+        state = create_pp_train_state(cfg, jax.random.key(args.seed), tx, mesh)
+        step = make_pp_train_step(cfg, tx, mesh, n_microbatches=n_mb)
+        shard = lambda t, g: microbatch(t, g, n_mb)
+        desc = f"{n_stages}-stage GPipe, {n_mb} microbatches"
+    elif args.mode == "moe":
+        from distributed_ml_pytorch_tpu.models.moe import MoETransformerLM
+        from distributed_ml_pytorch_tpu.parallel.expert_parallel import (
+            create_ep_train_state,
+            make_ep_train_step,
+            shard_ep_batch,
+        )
+        from distributed_ml_pytorch_tpu.runtime.mesh import make_mesh
+
+        # experts divide over the expert axis; batch over the data axis
+        d_expert = math.gcd(n_dev, args.n_experts)
+        d_data = math.gcd(n_dev // d_expert, args.batch)
+        mesh = make_mesh(
+            {"data": d_data, "expert": d_expert},
+            devices=jax.devices()[: d_data * d_expert],
+        )
+        moe = MoETransformerLM(
+            vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+            n_layers=args.n_layers, d_ff=args.d_ff, n_experts=args.n_experts,
+            max_len=max(args.seq, 256),
+            dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+            remat=args.remat,
+        )
+        state = create_ep_train_state(moe, jax.random.key(args.seed), tx, mesh)
+        step = make_ep_train_step(moe, tx, mesh)
+        shard = lambda t, g: shard_ep_batch(mesh, t, g)
+        desc = f"{d_data}x{d_expert} dp x ep ({args.n_experts} experts)"
     else:  # composite
         from distributed_ml_pytorch_tpu.parallel.composite import (
             create_composite_train_state,
@@ -179,27 +290,65 @@ def main(argv=None) -> int:
             lm, jax.random.key(args.seed), tx, mesh
         )
         step = make_composite_train_step(lm, tx, mesh, shardings)
-        batch = shard_composite_batch(mesh, tokens, targets)
+        shard = lambda t, g: shard_composite_batch(mesh, t, g)
         desc = "x".join(str(v) for v in shape.values()) + " dp x fsdp x tp"
+
+    k = args.steps_per_dispatch
+    if k < 1:
+        parser.error("--steps-per-dispatch must be >= 1")
+    if args.steps % k:
+        parser.error(f"--steps {args.steps} must divide by "
+                     f"--steps-per-dispatch {k}")
+    if k > 1:
+        # K distinct host batches stacked on a scan axis, each sharded the
+        # way this mode shards a single batch (spec lifted to P(None, *spec))
+        pairs = []
+        for _ in range(k):
+            t = rng.integers(0, args.vocab,
+                             size=(args.batch, args.seq)).astype(np.int32)
+            pairs.append(shard(t, next_token_targets(t)))
+        batch = tuple(_stack_sharded(leaves) for leaves in zip(*pairs))
+        step = _make_chunked_step(step)
+    else:
+        batch = shard(tokens, targets)
+
+    ckpt, start_step = None, 0
+    if args.ckpt_dir:
+        from distributed_ml_pytorch_tpu.utils.checkpoint import (
+            Checkpointer,
+            maybe_restore,
+        )
+
+        ckpt = Checkpointer(args.ckpt_dir, save_interval_steps=args.ckpt_every)
+        if args.resume:
+            state, start_step = maybe_restore(ckpt, state)
+            if start_step:
+                print(f"resumed from checkpoint step {start_step}")
 
     print(
         f"training {args.n_layers}-layer LM "
         f"({desc}, {mesh.devices.size} of {n_dev} devices)"
     )
+    n_disp = args.steps // k
     t0 = time.perf_counter()
     loss = None
-    for i in range(args.steps):
+    for i in range(n_disp):
         state, loss = step(state, *batch)
         if i == 0:
             jax.block_until_ready(loss)
             t0 = time.perf_counter()  # exclude compile from the rate
-        if i % max(1, args.steps // 5) == 0:
-            print(f"  step {i:4d}  loss {float(loss):.4f}")
-    final = float(loss)
+        if ckpt is not None:
+            ckpt.save(start_step + (i + 1) * k, state)
+        if i % max(1, n_disp // 5) == 0:
+            print(f"  step {i * k:4d}  loss {_scalar_loss(loss):.4f}")
+    final = _scalar_loss(loss)
     dt = time.perf_counter() - t0
-    rate = (args.steps - 1) * args.batch * args.seq / dt if args.steps > 1 else 0.0
+    rate = (n_disp - 1) * k * args.batch * args.seq / dt if n_disp > 1 else 0.0
     print(f"final loss {final:.4f}; ~{rate:.0f} tokens/s "
           f"(naive wall-clock, see bench_all.py for the differenced method)")
+    if ckpt is not None:
+        ckpt.save(start_step + args.steps, state, force=True)
+        ckpt.close()
     return 0
 
 

@@ -6,7 +6,7 @@ from examples.train_lm import main
 
 
 @pytest.mark.parametrize(
-    "mode", ["single", "sp", "ulysses", "fsdp", "tp", "composite"]
+    "mode", ["single", "sp", "ulysses", "fsdp", "tp", "pp", "moe", "composite"]
 )
 def test_train_lm_example_runs(mode, capsys):
     rc = main([
@@ -17,6 +17,30 @@ def test_train_lm_example_runs(mode, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "final loss" in out
+
+
+@pytest.mark.parametrize("mode", ["single", "tp", "pp", "moe"])
+def test_train_lm_chunked_dispatch(mode, capsys):
+    rc = main([
+        "--mode", mode, "--steps", "4", "--steps-per-dispatch", "2",
+        "--batch", "4", "--seq", "32", "--vocab", "64", "--d-model", "32",
+        "--n-heads", "8", "--n-layers", "1", "--d-ff", "64",
+    ])
+    assert rc == 0
+    assert "final loss" in capsys.readouterr().out
+
+
+@pytest.mark.parametrize("mode", ["fsdp", "moe"])
+def test_train_lm_checkpoint_resume(mode, tmp_path, capsys):
+    base = ["--mode", mode, "--batch", "4", "--seq", "32", "--vocab", "64",
+            "--d-model", "32", "--n-heads", "8", "--n-layers", "1",
+            "--d-ff", "64", "--ckpt-dir", str(tmp_path / "ckpt"),
+            "--ckpt-every", "1"]
+    assert main(base + ["--steps", "3"]) == 0
+    capsys.readouterr()
+    assert main(base + ["--steps", "2", "--resume"]) == 0
+    out = capsys.readouterr().out
+    assert "resumed from checkpoint step 3" in out
 
 
 def test_train_lm_example_loss_decreases(capsys):

@@ -127,3 +127,33 @@ def test_temperature_requires_rng_and_max_len_enforced():
         generate(model, params, prompt, 4, temperature=0.7)
     with pytest.raises(ValueError, match="max_len"):
         generate(model, params, prompt, 63)  # 2 + 63 > max_len 64
+
+
+def test_tp_sharded_decode_matches_single_device():
+    """Greedy TP decode on a 2x4 dp x tp mesh must be bit-identical to the
+    single-device path — same compiled program, shardings propagated."""
+    from distributed_ml_pytorch_tpu.models.generate import generate_tp
+    from distributed_ml_pytorch_tpu.runtime.mesh import make_mesh
+
+    model = tiny_lm()
+    params = trained_ish_params(model)
+    prompt = jnp.asarray(
+        np.random.default_rng(1).integers(0, 64, size=(2, 6)), jnp.int32
+    )
+    want = generate(model, params, prompt, 8)
+    mesh = make_mesh({"data": 2, "model": 4})
+    got = generate_tp(model, params, prompt, 8, mesh)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tp_decode_rejects_indivisible_heads():
+    from distributed_ml_pytorch_tpu.models.generate import generate_tp
+    from distributed_ml_pytorch_tpu.runtime.mesh import make_mesh
+
+    model = TransformerLM(
+        vocab_size=64, d_model=30, n_heads=3, n_layers=1, d_ff=64, max_len=64
+    )
+    params = model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    mesh = make_mesh({"data": 1, "model": 2}, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="divisible"):
+        generate_tp(model, params, jnp.zeros((1, 2), jnp.int32), 4, mesh)

@@ -1,0 +1,114 @@
+"""Multi-host code paths, tested without multi-host (VERDICT r1 #6).
+
+This jax build cannot federate CPU processes into one global device set
+(see tests/test_multihost_bootstrap.py for what IS runnable), so the
+``process_count > 1`` branches are covered at their seams: monkeypatch
+``jax.process_count`` / ``jax.make_array_from_process_local_data`` and
+assert the routing, shardings, and per-process slices that a pod run
+would produce."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_ml_pytorch_tpu.data import shard_for_process
+from distributed_ml_pytorch_tpu.parallel.sync import put_sharded, shard_batch
+from distributed_ml_pytorch_tpu.runtime.mesh import make_mesh
+
+
+@pytest.fixture
+def mesh():
+    return make_mesh({"data": 8})
+
+
+def test_put_sharded_single_process_is_device_put(mesh):
+    x = np.arange(16, dtype=np.float32).reshape(16, 1)
+    out = put_sharded(mesh, x, P("data", None))
+    assert out.sharding == NamedSharding(mesh, P("data", None))
+    np.testing.assert_array_equal(np.asarray(out), x)
+
+
+def test_put_sharded_multiprocess_branch_routes_local_data(mesh, monkeypatch):
+    """With process_count > 1, the array must go through
+    make_array_from_process_local_data with the exact sharding and the
+    process-LOCAL slice — never through plain device_put."""
+    calls = {}
+    sentinel = object()
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+    def fake_assemble(sharding, array):
+        calls["sharding"] = sharding
+        calls["array"] = array
+        return sentinel
+
+    monkeypatch.setattr(jax, "make_array_from_process_local_data", fake_assemble)
+
+    def forbidden_device_put(*a, **k):  # the single-process path must not run
+        raise AssertionError("device_put used on the multi-process branch")
+
+    monkeypatch.setattr(jax, "device_put", forbidden_device_put)
+
+    local = np.arange(8, dtype=np.float32).reshape(8, 1)  # this host's slice
+    out = put_sharded(mesh, local, P("data", None))
+    assert out is sentinel
+    assert calls["sharding"] == NamedSharding(mesh, P("data", None))
+    assert calls["array"] is local
+
+
+def test_shard_batch_multiprocess_specs_per_array(mesh, monkeypatch):
+    """shard_batch must lift each array's leading axis to the data axis —
+    images (b,h,w,c) → P(data,None,None,None), labels (b,) → P(data)."""
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    seen = []
+    monkeypatch.setattr(
+        jax, "make_array_from_process_local_data",
+        lambda sharding, array: seen.append((sharding.spec, array.shape)) or array,
+    )
+    images = np.zeros((8, 32, 32, 3), np.float32)
+    labels = np.zeros((8,), np.int32)
+    shard_batch(mesh, images, labels)
+    assert seen == [
+        (P("data", None, None, None), (8, 32, 32, 3)),
+        (P("data"), (8,)),
+    ]
+
+
+def test_shard_for_process_feeds_put_sharded_consistently(mesh, monkeypatch):
+    """Integration of the per-host loader with the assembly seam: each
+    simulated process passes its strided shard, and the union of what
+    reaches make_array_from_process_local_data is exactly the global batch,
+    each share under the same global sharding."""
+    global_x = np.arange(16, dtype=np.float32).reshape(16, 1)
+    global_y = np.arange(16, dtype=np.int32)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    received = []
+    monkeypatch.setattr(
+        jax, "make_array_from_process_local_data",
+        lambda sharding, array: received.append((sharding, array)) or array,
+    )
+    for rank in (0, 1):
+        lx, ly = shard_for_process(global_x, global_y, rank, 2)
+        assert len(lx) == 8  # half the global batch per host
+        shard_batch(mesh, lx, ly)
+    shardings = {s for s, _ in received}
+    assert shardings == {
+        NamedSharding(mesh, P("data", None)),
+        NamedSharding(mesh, P("data")),
+    }
+    label_payloads = [a for _, a in received if a.ndim == 1]
+    union = np.sort(np.concatenate(label_payloads))
+    np.testing.assert_array_equal(union, global_y)  # disjoint, complete
+
+
+def test_assembly_seam_matches_device_put_single_process(mesh):
+    """The real make_array_from_process_local_data (1 process: local = global)
+    must agree with device_put — validating that the branch the stubs cover
+    produces the same array contents where both paths are runnable."""
+    x = np.arange(32, dtype=np.float32).reshape(16, 2)
+    sharding = NamedSharding(mesh, P("data", None))
+    a = jax.device_put(x, sharding)
+    b = jax.make_array_from_process_local_data(sharding, x)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert a.sharding == b.sharding
