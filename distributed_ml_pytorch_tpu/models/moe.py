@@ -24,7 +24,7 @@ router toward uniform load so drops stay rare.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -184,11 +184,13 @@ class MoEBlock(nn.Module):
     capacity_factor: float = 2.0
     dtype: jnp.dtype = jnp.float32
     router_top_k: int = 1
+    attn_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x):
         h = nn.LayerNorm(dtype=self.dtype)(x)
-        x = x + MultiHeadAttention(self.d_model, self.n_heads, self.dtype, name="attn")(h)
+        x = x + MultiHeadAttention(self.d_model, self.n_heads, self.dtype,
+                                   self.attn_fn, name="attn")(h)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + MoEMLP(
             self.d_model, self.d_ff, self.n_experts, self.capacity_factor,
@@ -211,6 +213,7 @@ class MoETransformerLM(nn.Module):
     dtype: jnp.dtype = jnp.float32
     remat: bool = False
     router_top_k: int = 1
+    attn_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, tokens, positions=None):
@@ -223,7 +226,8 @@ class MoETransformerLM(nn.Module):
             x = block_cls(
                 self.d_model, self.n_heads, self.d_ff, self.n_experts,
                 self.capacity_factor, self.dtype,
-                router_top_k=self.router_top_k, name=f"block_{i}",
+                router_top_k=self.router_top_k, attn_fn=self.attn_fn,
+                name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head")(x)

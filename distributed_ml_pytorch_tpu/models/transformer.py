@@ -28,16 +28,15 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from distributed_ml_pytorch_tpu.ops.attention import (
-    blockwise_attention,
-    finalize_attention,
-)
+from distributed_ml_pytorch_tpu.ops.attention import auto_attention
 
 
 def default_attn_fn(q, k, v):
-    """Causal attention over the local (= full, when unsharded) sequence."""
-    acc, _m, l = blockwise_attention(q, k, v, causal=True)
-    return finalize_attention(acc, l).astype(q.dtype)
+    """Causal attention over the local (= full, when unsharded) sequence:
+    the Pallas flash kernel on TPU when the shape fits its blocking (the
+    measured 17.8× win over the scan at GPT-2 shapes — ops/attention.py),
+    the differentiable blockwise scan everywhere else."""
+    return auto_attention(q, k, v, causal=True)
 
 
 def apply_rope(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array:

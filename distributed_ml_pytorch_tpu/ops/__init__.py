@@ -4,9 +4,10 @@ The reference's only in-tree "kernel" work is the per-step O(|θ|) flat
 accumulate / SGD apply on the raveled model (``asgd/optim/Asynchronous.py:
 54-55,68``); everything else lives in libtorch. Here those flat-vector ops are
 Pallas TPU kernels (``fused_update``), and the attention stack that the
-long-context path needs (``attention``) provides a Pallas flash-attention
-forward plus a differentiable blockwise (online-softmax) formulation used by
-ring attention (``parallel/ring.py``).
+long-context path needs (``attention``) provides a differentiable Pallas
+flash-attention kernel (forward + custom_vjp backward) plus the blockwise
+(online-softmax) scan formulation used by ring attention
+(``parallel/ring.py``) and as the small-shape/off-TPU fallback.
 """
 
 from distributed_ml_pytorch_tpu.ops.fused_update import (
@@ -15,6 +16,7 @@ from distributed_ml_pytorch_tpu.ops.fused_update import (
 )
 from distributed_ml_pytorch_tpu.ops.attention import (
     attention_reference,
+    auto_attention,
     blockwise_attention,
     finalize_attention,
     flash_attention,
@@ -24,6 +26,7 @@ __all__ = [
     "flat_axpy",
     "downpour_accumulate",
     "flash_attention",
+    "auto_attention",
     "blockwise_attention",
     "finalize_attention",
     "attention_reference",

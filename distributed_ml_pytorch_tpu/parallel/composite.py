@@ -125,6 +125,9 @@ def make_composite_train_step(
     batch sharded over the combined ``(data, fsdp)`` axes; the entire
     difference between fsdp and 3-D composite training is the spec tree.
     """
+    from distributed_ml_pytorch_tpu.ops.attention import gspmd_safe_lm
+
+    model = gspmd_safe_lm(model, mesh)  # pallas has no SPMD partitioning rule
     return make_sharded_step(
         tx, mesh, shardings, P((data_axis, fsdp_axis), None),
         lm_loss_builder(model), 2,

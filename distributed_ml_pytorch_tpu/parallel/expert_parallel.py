@@ -91,6 +91,9 @@ def make_ep_train_step(
     weighted Switch load-balance loss summed over MoE layers.
     """
     _check_experts(model, int(mesh.shape[expert_axis]))
+    from distributed_ml_pytorch_tpu.ops.attention import gspmd_safe_lm
+
+    model = gspmd_safe_lm(model, mesh)  # pallas has no SPMD partitioning rule
 
     def step(state: TrainState, tokens, targets):
         def loss_fn(params):

@@ -250,6 +250,9 @@ def make_fsdp_lm_train_step(
     position), so dp/sp/tp/fsdp runs are comparable on the same data.
     """
 
+    from distributed_ml_pytorch_tpu.ops.attention import gspmd_safe_lm
+
+    model = gspmd_safe_lm(model, mesh)  # pallas has no SPMD partitioning rule
     return make_sharded_step(
         tx, mesh, shardings, P(axis, None), lm_loss_builder(model), 2
     )

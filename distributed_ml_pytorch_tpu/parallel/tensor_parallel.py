@@ -149,6 +149,9 @@ def make_tp_train_step(
     handwritten ``psum``s; contrast ``seq_parallel.make_sp_train_step``.
     """
     _check_divisibility(model, int(mesh.shape[model_axis]))
+    from distributed_ml_pytorch_tpu.ops.attention import gspmd_safe_lm
+
+    model = gspmd_safe_lm(model, mesh)  # pallas has no SPMD partitioning rule
 
     def step(state: TrainState, tokens, targets):
         def loss_fn(params):
