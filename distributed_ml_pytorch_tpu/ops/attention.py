@@ -394,16 +394,17 @@ def flash_attention(
     v: jax.Array,
     *,
     causal: bool = False,
-    block_q: int = 1024,
-    block_k: int = 512,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Differentiable Pallas flash attention over (batch, heads, seq, head_dim).
 
-    Defaults are the measured-best blocking on v5e (module docstring).
-    Sequence lengths must be multiples of the (clamped) block sizes — pad
-    upstream for ragged sequences, or use ``auto_attention`` which falls back
-    to the scan — and ``causal`` requires ``sq == sk`` (the standard
+    Block sizes default to the largest measured-good blocking that divides
+    the sequence lengths (``flash_block_choice`` — (1024, 512) on aligned
+    shapes, down to (128, 128)); explicit blocks must divide exactly. Pad
+    upstream for ragged sequences, or use ``auto_attention`` which falls
+    back to the scan. ``causal`` requires ``sq == sk`` (the standard
     self-attention layout; the end-aligned decode mask is a different
     contract and is rejected rather than silently diverging).
     ``interpret=None`` auto-selects interpreter mode off-TPU so the same code
@@ -413,6 +414,16 @@ def flash_attention(
     sk = k.shape[2]
     if causal and sq != sk:
         raise ValueError(f"causal flash_attention requires sq == sk, got {sq} != {sk}")
+    # each side derives independently: the largest measured-good block that
+    # divides it, else the legacy clamp (min(default, seq) — so short or
+    # odd-but-small lengths keep working as single blocks, and a too-long
+    # indivisible length still surfaces the divisibility error below)
+    if block_q is None:
+        block_q = next((c for c in (1024, 512, 256, 128) if sq % c == 0),
+                       min(1024, sq))
+    if block_k is None:
+        block_k = next((c for c in (512, 256, 128) if sk % c == 0),
+                       min(512, sk))
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     if sq % block_q or sk % block_k:

@@ -248,6 +248,56 @@ class ParameterServer:
             print("parameter server:", line)
 
 
+def validate_downpour_args(lr: float, n_push: int, n_pull: int) -> None:
+    """Cadence/lr validation shared by both DownPour clients."""
+    if lr < 0.0:
+        raise ValueError("Invalid learning rate: {}".format(lr))
+    if int(n_push) < 1 or int(n_pull) < 1:
+        raise ValueError(
+            "Invalid cadence: n_push={}, n_pull={} (both must be >= 1)".format(
+                n_push, n_pull
+            )
+        )
+
+
+def init_downpour_accumulator(params: Pytree):
+    """``(flat_init, flat_n, pad, accum)`` shared by both DownPour clients:
+    accumulator allocation parity with the reference (zeros sized like the
+    raveled model, Asynchronous.py:27) rounded up to a lane multiple so the
+    device accumulate takes the Pallas flat-axpy path on TPU; the pad tail
+    stays zero and is sliced off before anything leaves the device."""
+    from distributed_ml_pytorch_tpu.ops.fused_update import LANES
+
+    flat = np.asarray(ravel_model_params(params), np.float32)
+    n = int(flat.shape[0])
+    pad = (-n) % LANES
+    return flat, n, pad, jnp.zeros(n + pad, jnp.float32)
+
+
+def make_downpour_device_step(lr: float, pad: int):
+    """The jitted DownPour device step shared by the single-server and
+    sharded-PS clients: lr-pre-scaled flat accumulation (Asynchronous.py:55,
+    Pallas flat-axpy on TPU) + the local SGD update (:63-68). ``accum`` is
+    donated: the axpy's output aliases its buffer, so the accumulation
+    really is in place in HBM."""
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(2,))
+    def _device_step(params, grads, accum):
+        from distributed_ml_pytorch_tpu.ops import downpour_accumulate
+
+        flat_grads = ravel_model_params(params, grads=grads)
+        if pad:
+            # folds into the concatenate ravel already performs — the
+            # padded flat vector costs no extra HBM pass
+            flat_grads = jnp.concatenate([flat_grads, jnp.zeros(pad, flat_grads.dtype)])
+        accum = downpour_accumulate(accum, flat_grads, lr)
+        new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return new_params, accum
+
+    return _device_step
+
+
 class Listener(MessageListener):
     """C2 parity (``Asynchronous.py:9-18``): receives ParameterUpdate pushes.
 
@@ -302,27 +352,14 @@ class Asynchronous:
         rejoin: bool = False,
         install_timeout: float = 5.0,
     ):
-        if lr < 0.0:
-            raise ValueError("Invalid learning rate: {}".format(lr))
-        if int(n_push) < 1 or int(n_pull) < 1:
-            raise ValueError(
-                "Invalid cadence: n_push={}, n_pull={} (both must be >= 1)".format(n_push, n_pull)
-            )
+        validate_downpour_args(lr, n_push, n_pull)
         self.lr = float(lr)
         self.n_push = int(n_push)
         self.n_pull = int(n_pull)
         self.transport = transport
         self.idx = 0
         self.unravel = make_unraveler(params)
-        from distributed_ml_pytorch_tpu.ops.fused_update import LANES
-
-        # accumulator allocation parity: zeros sized like the raveled model
-        # (Asynchronous.py:27) — rounded up to a lane multiple so the device
-        # accumulate takes the Pallas flat-axpy path on TPU; the pad tail
-        # stays zero and is sliced off before anything leaves the device
-        self._flat_n = int(ravel_model_params(params).shape[0])
-        self._pad = (-self._flat_n) % LANES
-        self.accum = jnp.zeros(self._flat_n + self._pad, jnp.float32)
+        _flat, self._flat_n, self._pad, self.accum = init_downpour_accumulator(params)
         # the listener attaches BEFORE anything is sent, so a server reply
         # (e.g. a restored server answering the install below) can never
         # race the listener's start — it no longer relies on the transport
@@ -365,28 +402,7 @@ class Asynchronous:
         self.server_down = False
         self.heartbeat = heartbeat
 
-        lr_const = self.lr
-        pad = self._pad
-
-        from functools import partial
-
-        # accum is donated: the Pallas axpy's output aliases its buffer, so
-        # the accumulation really is in place in HBM
-        @partial(jax.jit, donate_argnums=(2,))
-        def _device_step(params, grads, accum):
-            from distributed_ml_pytorch_tpu.ops import downpour_accumulate
-
-            flat_grads = ravel_model_params(params, grads=grads)
-            if pad:
-                # folds into the concatenate ravel already performs — the
-                # padded flat vector costs no extra HBM pass
-                flat_grads = jnp.concatenate([flat_grads, jnp.zeros(pad, flat_grads.dtype)])
-            # lr-pre-scaled accumulation (:55) — Pallas flat-axpy kernel on TPU
-            accum = downpour_accumulate(accum, flat_grads, lr_const)
-            new_params = jax.tree.map(lambda p, g: p - lr_const * g, params, grads)  # local SGD (:63-68)
-            return new_params, accum
-
-        self._device_step = _device_step
+        self._device_step = make_downpour_device_step(self.lr, self._pad)
 
     def _send(self, code: MessageCode, payload) -> None:
         """Send toward the server; a dead server degrades, never crashes.

@@ -34,7 +34,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_ml_pytorch_tpu.parallel.fsdp import (
     largest_shardable_dim,
-    lm_loss_builder,
     make_sharded_step,
 )
 from distributed_ml_pytorch_tpu.parallel.tensor_parallel import (
@@ -125,12 +124,11 @@ def make_composite_train_step(
     batch sharded over the combined ``(data, fsdp)`` axes; the entire
     difference between fsdp and 3-D composite training is the spec tree.
     """
-    from distributed_ml_pytorch_tpu.ops.attention import gspmd_safe_lm
+    from distributed_ml_pytorch_tpu.parallel.fsdp import safe_lm_loss_builder
 
-    model = gspmd_safe_lm(model, mesh)  # pallas has no SPMD partitioning rule
     return make_sharded_step(
         tx, mesh, shardings, P((data_axis, fsdp_axis), None),
-        lm_loss_builder(model), 2,
+        safe_lm_loss_builder(model, mesh), 2,
     )
 
 

@@ -250,12 +250,21 @@ def make_fsdp_lm_train_step(
     position), so dp/sp/tp/fsdp runs are comparable on the same data.
     """
 
+    return make_sharded_step(
+        tx, mesh, shardings, P(axis, None), safe_lm_loss_builder(model, mesh), 2
+    )
+
+
+def safe_lm_loss_builder(model, mesh) -> Callable:
+    """:func:`lm_loss_builder` with the GSPMD attention pin applied — THE
+    chokepoint for jit-with-shardings LM step factories (fsdp-LM,
+    composite; tp/ep apply :func:`ops.attention.gspmd_safe_lm` to their own
+    loss closures). Any future GSPMD LM step must route through this (or
+    call ``gspmd_safe_lm`` itself) — a pallas_call inside a multi-device
+    GSPMD program has no SPMD partitioning rule."""
     from distributed_ml_pytorch_tpu.ops.attention import gspmd_safe_lm
 
-    model = gspmd_safe_lm(model, mesh)  # pallas has no SPMD partitioning rule
-    return make_sharded_step(
-        tx, mesh, shardings, P(axis, None), lm_loss_builder(model), 2
-    )
+    return lm_loss_builder(gspmd_safe_lm(model, mesh))
 
 
 def lm_loss_builder(model) -> Callable:

@@ -1,0 +1,193 @@
+"""Sharded parameter server — the DistBelief topology the reference descends
+from (VERDICT r1 #10; the reference's Makefile installs ``pytorch-distbelief``,
+``Makefile:38``, whose namesake system sharded its server across machines).
+
+Design: **sharding is pure composition over the existing pieces.** The
+central vector splits into k contiguous ranges; shard ``s`` is an unmodified
+:class:`~distributed_ml_pytorch_tpu.parallel.async_ps.ParameterServer`
+holding ``flat[lo_s:hi_s]``, serving as the rank-0 hub of its OWN transport
+star (TCP: ``port + s``; in-process: one world per shard). Workers hold one
+transport per shard and run the exact DownPour cadence against all of them —
+push sends each server its slice of the lr-pre-scaled accumulator, pull
+requests every slice, and the per-shard listeners assemble whatever has
+arrived at the next step boundary (a worker may install shard A's fresh
+params alongside shard B's older ones — precisely DownPour's tolerated
+staleness, now also per-shard). No new wire format, no new server code.
+
+Scaling consequence (the design note): server-side bandwidth and apply cost
+scale 1/k per shard host, which is what made DistBelief's central server
+feasible at model sizes a single host couldn't absorb. Worker-side cost is
+unchanged (same bytes, split across k sockets — and the k sends overlap).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_ml_pytorch_tpu.parallel.async_ps import (
+    Listener,
+    ParameterServer,
+    init_downpour_accumulator,
+    make_downpour_device_step,
+    validate_downpour_args,
+)
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    MessageCode,
+    Transport,
+    send_message,
+)
+from distributed_ml_pytorch_tpu.utils.serialization import (
+    make_unraveler,
+    ravel_model_params,
+)
+
+Pytree = Any
+
+
+def shard_ranges(n: int, n_shards: int) -> List[Tuple[int, int]]:
+    """Contiguous near-equal [lo, hi) ranges covering ``range(n)`` — the
+    first ``n % n_shards`` shards are one element longer."""
+    if n_shards < 1 or n_shards > n:
+        raise ValueError(f"need 1 <= n_shards <= {n}, got {n_shards}")
+    base, extra = divmod(n, n_shards)
+    ranges, lo = [], 0
+    for s in range(n_shards):
+        hi = lo + base + (1 if s < extra else 0)
+        ranges.append((lo, hi))
+        lo = hi
+    return ranges
+
+
+def make_shard_server(
+    model: Pytree = None,
+    *,
+    shard: int,
+    n_shards: int,
+    params: Optional[np.ndarray] = None,
+    transport: Optional[Transport] = None,
+    n_workers: Optional[int] = None,
+    worker_timeout: Optional[float] = None,
+    ckpt_dir: Optional[str] = None,
+    ckpt_every: int = 500,
+) -> ParameterServer:
+    """A shard server: a plain ParameterServer over its contiguous slice.
+
+    ``ckpt_dir`` should be per-shard (each server checkpoints only its own
+    slice) — callers typically pass ``f"{dir}/shard{shard}"``.
+    """
+    flat = (
+        np.asarray(params, np.float32)
+        if params is not None
+        else np.asarray(ravel_model_params(model), np.float32)
+    )
+    lo, hi = shard_ranges(flat.shape[0], n_shards)[shard]
+    return ParameterServer(
+        params=flat[lo:hi],
+        transport=transport,
+        n_workers=n_workers,
+        worker_timeout=worker_timeout,
+        ckpt_dir=ckpt_dir,
+        ckpt_every=ckpt_every,
+    )
+
+
+class ShardedAsynchronous:
+    """DownPour client against k shard servers (same cadence semantics as
+    :class:`async_ps.Asynchronous`, one transport per shard).
+
+    Functional step API: ``params = opt.step(params, grads)``. Construction
+    installs each server's slice of this worker's initial params — the same
+    single-install wire pattern as the unsharded client, fanned out.
+    """
+
+    def __init__(
+        self,
+        params: Pytree,
+        lr: float,
+        n_push: int,
+        n_pull: int,
+        *,
+        transports: Sequence[Transport],
+    ):
+        validate_downpour_args(lr, n_push, n_pull)
+        if not transports:
+            raise ValueError("need at least one shard transport")
+        self.lr = float(lr)
+        self.n_push = int(n_push)
+        self.n_pull = int(n_pull)
+        self.transports = list(transports)
+        self.idx = 0
+        self.unravel = make_unraveler(params)
+        flat, self._flat_n, self._pad, self.accum = init_downpour_accumulator(params)
+        self.ranges = shard_ranges(self._flat_n, len(self.transports))
+        self._device_step = make_downpour_device_step(self.lr, self._pad)
+        # per-shard liveness: a dead shard degrades that SLICE to purely-
+        # local SGD (same contract as Asynchronous._send, per shard — the
+        # other shards keep their push/pull service)
+        self.shard_down = [False] * len(self.transports)
+        # listeners attach before any send (async_ps ordering invariant)
+        self.listeners = [Listener(transport=t) for t in self.transports]
+        for listener in self.listeners:
+            listener.start()
+        for s, ((lo, hi), t) in enumerate(zip(self.ranges, self.transports)):
+            self._send(s, MessageCode.ParameterUpdate, flat[lo:hi])
+
+    def _send(self, shard: int, code: MessageCode, payload: np.ndarray) -> None:
+        """Send toward one shard server; its death degrades, never crashes."""
+        if self.shard_down[shard]:
+            return
+        try:
+            send_message(code, payload, transport=self.transports[shard])
+        except (OSError, ConnectionError):
+            self.shard_down[shard] = True
+            lo, hi = self.ranges[shard]
+            print(
+                f"worker: shard server {shard} (params [{lo},{hi})) "
+                "unreachable — that slice continues with purely-local SGD",
+                file=sys.stderr,
+            )
+
+    def _install_arrived(self, params: Pytree) -> Pytree:
+        """Patch whichever shard slices have arrived into the current flat
+        params — per-shard staleness is allowed by construction."""
+        latest = [listener.take_latest() for listener in self.listeners]
+        if all(l is None for l in latest):
+            return params
+        # np.array (not asarray): a jax array exports a read-only buffer
+        flat = np.array(ravel_model_params(params), dtype=np.float32)
+        for (lo, hi), sl in zip(self.ranges, latest):
+            if sl is not None:
+                if sl.shape[0] != hi - lo:
+                    raise ValueError(
+                        f"shard reply of {sl.shape[0]} params for a "
+                        f"[{lo},{hi}) range — shard/worker ranges disagree"
+                    )
+                flat[lo:hi] = sl
+        return self.unravel(jnp.asarray(flat))
+
+    def step(self, params: Pytree, grads: Pytree) -> Pytree:
+        params = self._install_arrived(params)
+        if self.idx % self.n_pull == 0:
+            for s in range(len(self.transports)):
+                self._send(s, MessageCode.ParameterRequest, np.zeros(0, np.float32))
+        params, self.accum = self._device_step(params, grads, self.accum)
+        if self.idx % self.n_push == 0:
+            accum = np.asarray(self.accum[: self._flat_n])
+            for s, (lo, hi) in enumerate(self.ranges):
+                self._send(s, MessageCode.GradientUpdate, accum[lo:hi])
+            self.accum = jnp.zeros_like(self.accum)
+        self.idx += 1
+        return params
+
+    def finish(self) -> None:
+        """Flush the final push and close out every shard."""
+        accum = np.asarray(self.accum[: self._flat_n])
+        for s, (lo, hi) in enumerate(self.ranges):
+            self._send(s, MessageCode.GradientUpdate, accum[lo:hi])
+            self._send(s, MessageCode.WorkerDone, np.zeros(0, np.float32))
+        for listener in self.listeners:
+            listener.stop()

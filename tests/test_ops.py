@@ -187,3 +187,15 @@ def test_gspmd_safe_lm_pins_scan_on_multidevice_mesh():
         assert gspmd_safe_lm(m, mesh1) is m
         injected = m.clone(attn_fn=attention_reference)
         assert gspmd_safe_lm(injected, mesh8).attn_fn is attention_reference
+
+
+def test_flash_attention_default_blocks_adapt_to_sequence():
+    """Default (unspecified) blocks must derive from flash_block_choice so
+    lengths like 1536 — divisible by 512 but not 1024 — still work."""
+    rng = np.random.default_rng(7)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 1, 1536, 8)), jnp.float32)
+               for _ in range(3))
+    got = flash_attention(q, k, v, causal=True)
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=2e-4)

@@ -1,0 +1,218 @@
+"""Sharded parameter server (VERDICT r1 #10): k shard servers each owning a
+contiguous slice of the central vector, workers pushing/pulling per shard.
+Unit tests drive the in-process transports; the k=2 integration test runs
+real server processes over TCP."""
+
+import os
+import subprocess
+import sys
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.launch import _free_port, cpu_platform_env
+from distributed_ml_pytorch_tpu.parallel.sharded_ps import (
+    ShardedAsynchronous,
+    make_shard_server,
+    shard_ranges,
+)
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    InProcessTransport,
+    MessageCode,
+    make_transport,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_shard_ranges_cover_disjointly():
+    for n, k in [(8, 2), (10, 3), (5, 5), (7, 1)]:
+        ranges = shard_ranges(n, k)
+        assert ranges[0][0] == 0 and ranges[-1][1] == n
+        for (a, b), (c, d) in zip(ranges, ranges[1:]):
+            assert b == c and b > a and d > c
+    with pytest.raises(ValueError):
+        shard_ranges(4, 5)
+
+
+def _params():
+    return {
+        "w": jnp.arange(5, dtype=jnp.float32),
+        "b": jnp.arange(3, dtype=jnp.float32) + 10.0,
+    }  # ravels to 8 elements → shards [0,4) and [4,8)
+
+
+def test_sharded_downpour_updates_each_shard_server():
+    """2 shards, 1 worker, n_push=1: after 2 steps of all-ones grads each
+    server's central slice must be install − 2·lr (worker pre-scales by
+    −lr, server-side application is addition)."""
+    params = _params()
+    worlds = [InProcessTransport.create_world(2) for _ in range(2)]
+    servers = [
+        make_shard_server(model=params, shard=s, n_shards=2,
+                          transport=worlds[s][0], n_workers=1)
+        for s in range(2)
+    ]
+    threads = [threading.Thread(target=s.run) for s in servers]
+    for t in threads:
+        t.start()
+    opt = ShardedAsynchronous(params, lr=0.1, n_push=1, n_pull=100,
+                              transports=[w[1] for w in worlds])
+    try:
+        grads = {"w": jnp.ones(5), "b": jnp.ones(3)}
+        p = params
+        for _ in range(2):
+            p = opt.step(p, grads)
+    finally:
+        opt.finish()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads)
+    from distributed_ml_pytorch_tpu.utils.serialization import ravel_model_params
+
+    init = np.asarray(ravel_model_params(_params()))
+    want = init - 0.2  # two pushes of −lr·1
+    got = np.concatenate([servers[0].central, servers[1].central])
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_partial_shard_install_patches_only_arrived_range():
+    """A reply from one shard installs alone — per-shard staleness."""
+    params = _params()
+    worlds = [InProcessTransport.create_world(2) for _ in range(2)]
+    opt = ShardedAsynchronous(params, lr=0.0, n_push=100, n_pull=100,
+                              transports=[w[1] for w in worlds])
+    try:
+        from distributed_ml_pytorch_tpu.utils.serialization import (
+            ravel_model_params,
+        )
+
+        init = np.asarray(ravel_model_params(params))
+        fresh = np.full(4, 99.0, np.float32)
+        opt.listeners[1]._latest = fresh  # shard [4,8) reply arrived
+        patched = opt._install_arrived(params)
+        flat = np.asarray(ravel_model_params(patched))
+        np.testing.assert_allclose(flat[:4], init[:4])
+        np.testing.assert_allclose(flat[4:], fresh)
+        # wrong-size reply fails loudly, never silently corrupts
+        opt.listeners[0]._latest = np.zeros(3, np.float32)
+        with pytest.raises(ValueError, match="ranges disagree"):
+            opt._install_arrived(params)
+    finally:
+        opt.finish()
+
+
+_SERVER_SRC = """
+import sys
+import numpy as np
+from distributed_ml_pytorch_tpu.parallel.sharded_ps import make_shard_server
+from distributed_ml_pytorch_tpu.utils.messaging import make_transport
+
+shard, port = int(sys.argv[1]), sys.argv[2]
+t = make_transport(0, 2, port=int(port), kind="python")
+srv = make_shard_server(params=np.zeros(8, np.float32), shard=shard,
+                        n_shards=2, transport=t, n_workers=1)
+srv.run()
+print("shard", shard, "central", ",".join(f"{x:.4f}" for x in srv.central),
+      flush=True)
+t.close()
+"""
+
+
+def test_sharded_ps_two_server_processes_over_tcp(tmp_path):
+    """The k=2 DistBelief layout with real processes: two shard servers on
+    their own TCP stars, one worker pushing/pulling slices of a LeNet-free
+    toy model; each server must end at install − Σ lr·grads for its slice."""
+    ports = [_free_port(), _free_port()]
+    env = cpu_platform_env()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen([sys.executable, "-c", _SERVER_SRC, str(s), ports[s]],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+        for s in range(2)
+    ]
+    params = _params()
+    transports = [
+        make_transport(1, 2, port=int(p), kind="python", connect_timeout=120)
+        for p in ports
+    ]
+    try:
+        opt = ShardedAsynchronous(params, lr=0.5, n_push=1, n_pull=100,
+                                  transports=transports)
+        grads = {"w": jnp.ones(5), "b": jnp.ones(3)}
+        p = params
+        for _ in range(3):
+            p = opt.step(p, grads)
+        opt.finish()
+    finally:
+        outs = []
+        for proc in procs:
+            try:
+                out, _ = proc.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out = proc.communicate()[0]
+            outs.append(out)
+        for t in transports:
+            t.close()
+    assert all(p.returncode == 0 for p in procs), "\n\n".join(outs)
+    from distributed_ml_pytorch_tpu.utils.serialization import ravel_model_params
+
+    init = np.asarray(ravel_model_params(_params()))
+    want = init - 3 * 0.5  # three pushes of −lr·1
+    for s, out in enumerate(outs):
+        line = [l for l in out.splitlines() if l.startswith(f"shard {s} central")]
+        assert line, out
+        got = np.array([float(x) for x in line[0].split("central ")[1].split(",")])
+        lo, hi = (0, 4) if s == 0 else (4, 8)
+        np.testing.assert_allclose(got, want[lo:hi], rtol=1e-5)
+
+
+def test_dead_shard_degrades_that_slice_only():
+    """A dead shard server must not crash the worker: sends to it stop, the
+    other shard keeps its push/pull service (per-shard degradation)."""
+    params = _params()
+    worlds = [InProcessTransport.create_world(2) for _ in range(2)]
+
+    class Dying:
+        def __init__(self, inner):
+            self.inner, self.dead = inner, False
+
+        def send(self, code, payload, dst=0):
+            if self.dead:
+                raise ConnectionError("shard down")
+            self.inner.send(code, payload, dst)
+
+        def recv(self, timeout=None):
+            return self.inner.recv(timeout)
+
+        def close(self):
+            self.inner.close()
+
+        @property
+        def rank(self):
+            return self.inner.rank
+
+    dying = Dying(worlds[0][1])
+    opt = ShardedAsynchronous(params, lr=0.1, n_push=1, n_pull=1,
+                              transports=[dying, worlds[1][1]])
+    try:
+        grads = {"w": jnp.ones(5), "b": jnp.ones(3)}
+        p = opt.step(params, grads)
+        dying.dead = True
+        for _ in range(2):  # must not raise
+            p = opt.step(p, grads)
+        assert opt.shard_down == [True, False]
+        # the healthy shard kept receiving pushes: drain its server box
+        seen = []
+        while True:
+            msg = worlds[1][0].recv(timeout=0.2)
+            if msg is None:
+                break
+            seen.append(msg[1])
+        assert seen.count(MessageCode.GradientUpdate) == 3
+    finally:
+        opt.finish()  # also must not raise
