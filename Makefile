@@ -23,6 +23,10 @@ server:
 launch:
 	$(PY) -m distributed_ml_pytorch_tpu.launch --world-size 3
 
+# sharded parameter server (DistBelief layout): 2 shard servers + 2 workers
+sharded:
+	$(PY) -m distributed_ml_pytorch_tpu.launch --world-size 4 --n-servers 2
+
 # --- single-process baselines (reference Makefile:22-26; `gpu` → `tpu`) ---
 single:
 	$(PY) -m distributed_ml_pytorch_tpu.training.cli --no-distributed --backend cpu
@@ -63,4 +67,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch single tpu gpu sync local-sgd p2p bench bench-all test graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p bench bench-all test graph install dist

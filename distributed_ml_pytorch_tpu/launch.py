@@ -32,6 +32,26 @@ def _free_port() -> str:
         return str(s.getsockname()[1])
 
 
+def _free_port_block(n: int, attempts: int = 50) -> str:
+    """A base port with ``n`` CONSECUTIVE free ports (sharded PS binds
+    base..base+n-1, one star per shard) — verified by binding them all."""
+    for _ in range(attempts):
+        base = int(_free_port())
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.bind(("", base + i))
+                socks.append(s)
+            return str(base)
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError(f"no block of {n} consecutive free ports found")
+
+
 def cpu_platform_env(base: dict | None = None, n_devices: int = 1) -> dict:
     """Env for running a process on the CPU platform with ``n_devices`` virtual
     devices (shared by the launcher and the integration tests): the PS path is
@@ -77,35 +97,44 @@ def launch_world(
     port: str | None = None,
     cpu: bool = True,
     tpu_worker_rank: int | None = None,
+    n_servers: int = 1,
     poll_interval: float = 0.2,
 ) -> int:
-    """Spawn 1 server + (world_size-1) workers; returns the worst exit code.
+    """Spawn ``n_servers`` server rank(s) + workers; returns the worst exit
+    code. ``n_servers > 1`` launches the sharded-PS layout (ranks
+    0..n_servers-1 each hold a contiguous slice of the central vector).
 
     Children are monitored: if any process exits nonzero while others are
     still running, the rest are killed — a crashed worker must not leave the
     server blocked in accept()/run() forever.
     """
-    if tpu_worker_rank is not None and not 1 <= tpu_worker_rank < world_size:
-        # rank 0 is always the server (it never trains — pinning it wastes
-        # the chip and mislabels CPU numbers as TPU numbers); out-of-range
-        # ranks would silently pin nothing
+    if not 1 <= n_servers < world_size:
+        raise ValueError(
+            f"n_servers={n_servers} must leave at least one worker in a "
+            f"world of {world_size}"
+        )
+    if tpu_worker_rank is not None and not n_servers <= tpu_worker_rank < world_size:
+        # server ranks never train — pinning one wastes the chip and
+        # mislabels CPU numbers as TPU numbers; out-of-range ranks would
+        # silently pin nothing
         raise ValueError(
             f"tpu_worker_rank={tpu_worker_rank} must be a worker rank "
-            f"(1..{world_size - 1})"
+            f"({n_servers}..{world_size - 1})"
         )
-    port = port or _free_port()
+    port = port or (_free_port_block(n_servers) if n_servers > 1 else _free_port())
     common = [
         sys.executable, "-m", "distributed_ml_pytorch_tpu.training.cli",
         "--mode", "ps", "--world-size", str(world_size), "--port", port,
-    ] + list(extra_args)
+    ] + (["--n-servers", str(n_servers)] if n_servers > 1 else []) + list(extra_args)
     envs = [
         rank_env(r, cpu=cpu, tpu_worker_rank=tpu_worker_rank)
         for r in range(world_size)
     ]
     procs = [
-        subprocess.Popen(common + ["--rank", "0", "--server"], env=envs[0])
+        subprocess.Popen(common + ["--rank", str(r), "--server"], env=envs[r])
+        for r in range(n_servers)
     ]
-    for rank in range(1, world_size):
+    for rank in range(n_servers, world_size):
         procs.append(
             subprocess.Popen(common + ["--rank", str(rank)], env=envs[rank])
         )
@@ -146,11 +175,15 @@ def main(argv=None) -> int:
                         help="pin this worker rank to the default (TPU) "
                              "platform while the server and other ranks stay "
                              "on CPU — the DownPour accelerator-worker layout")
+    parser.add_argument("--n-servers", type=int, default=1, metavar="K",
+                        help="shard the parameter server across K ranks "
+                             "(the DistBelief layout)")
     args, extra = parser.parse_known_args(argv)
     if extra and extra[0] == "--":
         extra = extra[1:]
     return launch_world(args.world_size, extra, port=args.port,
-                        cpu=not args.tpu, tpu_worker_rank=args.tpu_worker)
+                        cpu=not args.tpu, tpu_worker_rank=args.tpu_worker,
+                        n_servers=args.n_servers)
 
 
 if __name__ == "__main__":

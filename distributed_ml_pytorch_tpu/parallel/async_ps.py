@@ -467,10 +467,16 @@ DownpourSGD = Asynchronous
 
 
 def train_worker(
-    args, transport: Transport, heartbeat=None
+    args, transport: Transport, heartbeat=None, opt_factory=None
 ) -> Tuple[Pytree, "MetricsLogger"]:
     """Worker-side training loop (reference ``main(args)`` distributed branch,
-    ``example/main.py:31-105``)."""
+    ``example/main.py:31-105``).
+
+    ``opt_factory(params) -> optimizer`` overrides the default
+    ``Asynchronous`` construction (the sharded-PS entry passes a
+    ``ShardedAsynchronous`` builder); ``transport`` then serves only for
+    rank-derived seeds/filenames.
+    """
     from distributed_ml_pytorch_tpu.data import get_dataset, iterate_batches
     from distributed_ml_pytorch_tpu.models import get_model
     from distributed_ml_pytorch_tpu.training.trainer import (
@@ -484,15 +490,18 @@ def train_worker(
     model = get_model(getattr(args, "model", "alexnet"))
     seed = getattr(args, "seed", 0)
     params = model.init(jax.random.key(seed), jnp.zeros((1, 32, 32, 3)))["params"]
-    opt = Asynchronous(
-        params,
-        lr=args.lr,
-        n_push=args.num_push,
-        n_pull=args.num_pull,
-        transport=transport,
-        heartbeat=heartbeat,
-        rejoin=getattr(args, "rejoin", False),
-    )
+    if opt_factory is not None:
+        opt = opt_factory(params)
+    else:
+        opt = Asynchronous(
+            params,
+            lr=args.lr,
+            n_push=args.num_push,
+            n_pull=args.num_pull,
+            transport=transport,
+            heartbeat=heartbeat,
+            rejoin=getattr(args, "rejoin", False),
+        )
     dropout_rng = jax.random.key(seed + 1 + transport.rank)
 
     @jax.jit

@@ -112,6 +112,8 @@ class ShardedAsynchronous:
         n_pull: int,
         *,
         transports: Sequence[Transport],
+        rejoin: bool = False,
+        install_timeout: float = 5.0,
     ):
         validate_downpour_args(lr, n_push, n_pull)
         if not transports:
@@ -133,8 +135,23 @@ class ShardedAsynchronous:
         self.listeners = [Listener(transport=t) for t in self.transports]
         for listener in self.listeners:
             listener.start()
-        for s, ((lo, hi), t) in enumerate(zip(self.ranges, self.transports)):
-            self._send(s, MessageCode.ParameterUpdate, flat[lo:hi])
+        if rejoin:
+            # elastic restart: ADOPT every shard's current slice instead of
+            # stomping trained central params with this process's fresh init
+            # (same contract as Asynchronous(rejoin=True), per shard)
+            for s in range(len(self.transports)):
+                self._send(s, MessageCode.ParameterRequest, np.zeros(0, np.float32))
+            for s, listener in enumerate(self.listeners):
+                if not listener.wait_for_update(timeout=install_timeout):
+                    print(
+                        f"worker: rejoin pull to shard {s} unanswered after "
+                        f"{install_timeout:.1f}s — that slice starts from "
+                        "local init",
+                        file=sys.stderr,
+                    )
+        else:
+            for s, (lo, hi) in enumerate(self.ranges):
+                self._send(s, MessageCode.ParameterUpdate, flat[lo:hi])
 
     def _send(self, shard: int, code: MessageCode, payload: np.ndarray) -> None:
         """Send toward one shard server; its death degrades, never crashes."""
@@ -191,3 +208,99 @@ class ShardedAsynchronous:
             self._send(s, MessageCode.WorkerDone, np.zeros(0, np.float32))
         for listener in self.listeners:
             listener.stop()
+
+
+def run_sharded_ps_process(args) -> int:
+    """CLI entry for one sharded-PS process (``--n-servers K``): global
+    ranks 0..K-1 are shard servers, K.. are workers.
+
+    Shard ``s``'s star is its own transport world on ``port + s`` (server =
+    star-rank 0, every worker = star-rank ``global_rank − K + 1``); the
+    worker trains the exact reference loop with a :class:`ShardedAsynchronous`
+    in place of the unsharded client. Checkpoints (``--ckpt-dir``) land in
+    per-shard subdirectories.
+    """
+    import jax
+
+    from distributed_ml_pytorch_tpu.models import get_model
+    from distributed_ml_pytorch_tpu.parallel.async_ps import train_worker
+    from distributed_ml_pytorch_tpu.utils.messaging import make_transport
+
+    k = int(args.n_servers)
+    n_workers = args.world_size - k
+    if args.rank is None:
+        raise SystemExit("--rank is required for distributed --mode ps runs")
+    if n_workers < 1:
+        raise SystemExit(
+            f"--n-servers {k} leaves no workers in --world-size {args.world_size}"
+        )
+    kind = getattr(args, "transport", "auto")
+    if args.rank < k:
+        shard = args.rank
+        transport = make_transport(
+            0, n_workers + 1, args.master, int(args.port) + shard, kind=kind
+        )
+        try:
+            model = get_model(getattr(args, "model", "alexnet"))
+            import jax.numpy as _jnp
+
+            params = model.init(
+                jax.random.key(getattr(args, "seed", 0)),
+                _jnp.zeros((1, 32, 32, 3)),
+            )["params"]
+            ckpt_dir = getattr(args, "ckpt_dir", "") or None
+            server = make_shard_server(
+                model=params,
+                shard=shard,
+                n_shards=k,
+                transport=transport,
+                n_workers=n_workers,
+                worker_timeout=getattr(args, "worker_timeout", 0.0) or None,
+                ckpt_dir=f"{ckpt_dir}/shard{shard}" if ckpt_dir else None,
+                ckpt_every=getattr(args, "ckpt_every", 500),
+            )
+            if getattr(args, "resume", False) and server.maybe_restore():
+                print(f"shard server {shard}: resumed central params")
+            server.run()
+            print(f"shard server {shard}: done "
+                  f"({server.central.shape[0]} params held)")
+        finally:
+            transport.close()
+        return 0
+    star_rank = args.rank - k + 1
+    transports = [
+        make_transport(
+            star_rank, n_workers + 1, args.master, int(args.port) + s, kind=kind
+        )
+        for s in range(k)
+    ]
+    heartbeats = []
+    try:
+        hb_interval = getattr(args, "heartbeat_interval", 0.0)
+        if hb_interval > 0:
+            # one sender per shard star, started before any jit compile:
+            # every shard server's failure detector must see liveness from
+            # process start, not from first step (async_ps.run_ps_process
+            # does the same for the single star)
+            from distributed_ml_pytorch_tpu.utils.failure import HeartbeatSender
+
+            for t in transports:
+                hb = HeartbeatSender(t, interval=hb_interval)
+                hb.start()
+                heartbeats.append(hb)
+        factory = lambda params: ShardedAsynchronous(
+            params, lr=args.lr, n_push=args.num_push, n_pull=args.num_pull,
+            transports=transports, rejoin=getattr(args, "rejoin", False),
+        )
+        _params, logger = train_worker(
+            args, transports[0], opt_factory=factory
+        )
+        path = logger.to_csv("node{}.csv".format(args.rank))
+        print("wrote", path)
+        print("Finished Training")
+    finally:
+        for hb in heartbeats:
+            hb.stop()
+        for t in transports:
+            t.close()
+    return 0

@@ -56,6 +56,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="size of the world")
     p.add_argument("--server", action="store_true", default=False,
                    help="server node?")
+    p.add_argument("--n-servers", type=int, default=1, metavar="K",
+                   help="(--mode ps) shard the parameter server across K "
+                        "ranks (0..K-1), each owning a contiguous range of "
+                        "the central vector on its own port (port+shard) — "
+                        "the DistBelief layout (parallel/sharded_ps.py)")
     p.add_argument("--master", type=str, default="localhost",
                    help="ip address of the master (server) node")
     p.add_argument("--port", type=str, default="29500",
@@ -262,12 +267,21 @@ def main(argv=None) -> int:
             )
 
     if args.mode == "ps":
+        # only the module imports sit in the try: a run-time ImportError
+        # from inside training must surface, not masquerade as a build issue
         try:
-            from distributed_ml_pytorch_tpu.parallel.async_ps import run_ps_process
+            if getattr(args, "n_servers", 1) > 1:
+                from distributed_ml_pytorch_tpu.parallel.sharded_ps import (
+                    run_sharded_ps_process as ps_entry,
+                )
+            else:
+                from distributed_ml_pytorch_tpu.parallel.async_ps import (
+                    run_ps_process as ps_entry,
+                )
         except ImportError as e:
             print(f"error: --mode ps is unavailable in this build: {e}", file=sys.stderr)
             return 2
-        return run_ps_process(args)
+        return ps_entry(args)
     else:
         # mesh-based modes share one epilogue; each trainer returns
         # (state, MetricsLogger)

@@ -216,3 +216,58 @@ def test_dead_shard_degrades_that_slice_only():
         assert seen.count(MessageCode.GradientUpdate) == 3
     finally:
         opt.finish()  # also must not raise
+
+
+def test_sharded_ps_cli_world_end_to_end(tmp_path):
+    """`launch --n-servers 2` runs the full CLI topology: 2 shard servers +
+    2 workers train LeNet and everyone exits cleanly."""
+    from distributed_ml_pytorch_tpu.launch import launch_world
+
+    code = launch_world(
+        4,
+        ["--model", "lenet", "--epochs", "1", "--batch-size", "16",
+         "--test-batch-size", "32", "--num-push", "4", "--num-pull", "4",
+         "--synthetic-data", "--synthetic-train-size", "96",
+         "--synthetic-test-size", "32", "--log-interval", "1000",
+         "--log-dir", str(tmp_path)],
+        n_servers=2,
+    )
+    assert code == 0
+    for rank in (2, 3):
+        assert os.path.exists(tmp_path / f"node{rank}.csv")
+
+
+def test_sharded_rejoin_adopts_central_without_install():
+    """rejoin=True must PULL each shard's central params (never install the
+    fresh init) and the first step starts from the adopted values."""
+    params = _params()
+    worlds = [InProcessTransport.create_world(2) for _ in range(2)]
+    central = np.arange(8, dtype=np.float32) * 10.0
+    servers = [
+        make_shard_server(params=central, shard=s, n_shards=2,
+                          transport=worlds[s][0], n_workers=1)
+        for s in range(2)
+    ]
+    threads = [threading.Thread(target=s.run) for s in servers]
+    for t in threads:
+        t.start()
+    opt = ShardedAsynchronous(params, lr=0.0, n_push=100, n_pull=100,
+                              transports=[w[1] for w in worlds], rejoin=True)
+    try:
+        # install codes must never have been applied: centrals unchanged
+        np.testing.assert_allclose(
+            np.concatenate([servers[0].central, servers[1].central]), central)
+        grads = {"w": jnp.zeros(5), "b": jnp.zeros(3)}
+        p = opt.step(params, grads)  # installs the pulled replies
+        from distributed_ml_pytorch_tpu.utils.serialization import (
+            ravel_model_params,
+        )
+
+        np.testing.assert_allclose(
+            np.asarray(ravel_model_params(p)), central)
+        for srv in servers:
+            assert srv.message_counts[MessageCode.ParameterUpdate] == 0
+    finally:
+        opt.finish()
+    for t in threads:
+        t.join(timeout=30)
