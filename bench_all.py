@@ -165,8 +165,8 @@ def tpu_phase() -> None:
     # 128 for MXU-aligned logits). remat=False measured faster than
     # remat=True at both shapes (flash attention removed the S² temps that
     # made remat necessary: 88.1k vs 65.9k tok/s at b8/s2048). The flash
-    # kernel's FLOPs are invisible to cost_analysis, so the reported
-    # TFLOP/s + MFU are floors (utils/flops.py).
+    # kernel's FLOPs are invisible to cost_analysis and are added
+    # analytically inside bench_lm (utils/flops.flash_attention_train_flops).
     gpt2 = TransformerLM(
         vocab_size=50304, d_model=768, n_heads=12, n_layers=12, d_ff=3072,
         dtype=jnp.bfloat16, remat=False, pos_encoding="rope",
@@ -174,13 +174,30 @@ def tpu_phase() -> None:
     tok_s2 = bench_lm(gpt2, batch=8, seq=2048, n_long=6, tag="gpt2-small-seq2048")
     emit(6, "gpt2_small_seq2048_train_throughput", tok_s2, "tokens/sec/chip",
          hw, "GPT-2-small config (768d/12h/12L, padded vocab 50304), bf16, "
-         "RoPE, Pallas flash attention, batch 8 x seq 2048; TFLOP/s+MFU are "
-         "floors (Pallas flops uncounted by cost_analysis)")
+         "RoPE, Pallas flash attention, batch 8 x seq 2048; kernel FLOPs "
+         "counted analytically on top of the XLA count")
     tok_s3 = bench_lm(gpt2, batch=1, seq=8192, n_long=6, tag="gpt2-small-seq8192")
     emit(6, "gpt2_small_seq8192_train_throughput", tok_s3, "tokens/sec/chip",
          hw, "same GPT-2-small config at long context, batch 1 x seq 8192; "
-         "attention dominates at this S so the uncounted-Pallas-flops floor "
-         "understates MFU most here")
+         "attention dominates at this S (the analytic kernel count is most "
+         "of the numerator)")
+
+    # config 6 (MoE family leg) — Switch-MoE at GPT-2-small dims
+    moe_tok = bench_moe_lm()
+    emit(6, "moe_lm_4expert_seq2048_train_throughput", moe_tok,
+         "tokens/sec/chip", hw,
+         "Switch-MoE (768d/12L, 4 experts top-1, 2.0 capacity), bf16, batch "
+         "8 x seq 2048 — single-chip leg of the dp x ep sharding "
+         "(dryrun_multichip runs the sharded step)")
+
+    # config 8 (inference) — KV-cache autoregressive decode
+    dec_rate = bench_decode()
+    emit(8, "gpt2_small_decode_throughput", dec_rate, "tokens/sec/chip", hw,
+         "batch 32, 128-token prompt prefill + 256 generated tokens per "
+         "call, scanned single-token steps with a static KV cache "
+         "(models/generate.py); greedy. Decode is param-read bound: batch 8 "
+         "measured 4,185 tok/s — batching amortizes the per-step weight "
+         "traffic 3.1x")
 
 
 def bench_steps_to_accuracy(target: float = 0.99, max_steps: int = 2000,
@@ -305,6 +322,26 @@ def bench_lm(lm=None, batch: int = 1, seq: int = 8192, n_long: int = 11,
                              step=state.step + 1), loss
 
     step_flops = compiled_flops(step, state, tokens, targets)
+    # the Pallas flash kernels' FLOPs are invisible to cost_analysis; when
+    # this leg runs them (TPU + blockable shape + model-default attention),
+    # add the analytic kernel count so MFU is real, not a floor
+    from distributed_ml_pytorch_tpu.ops.attention import flash_block_choice
+
+    uses_flash = (
+        step_flops is not None
+        and jax.default_backend() == "tpu"
+        and getattr(lm, "attn_fn", None) is None
+        and flash_block_choice(seq, seq) is not None
+    )
+    if uses_flash:
+        from distributed_ml_pytorch_tpu.utils.flops import (
+            flash_attention_train_flops,
+        )
+
+        step_flops += flash_attention_train_flops(
+            batch, lm.n_heads, seq, lm.d_model // lm.n_heads, lm.n_layers,
+            causal=True, remat=bool(getattr(lm, "remat", False)),
+        )
 
     def chain(n):
         nonlocal state
@@ -324,6 +361,64 @@ def bench_lm(lm=None, batch: int = 1, seq: int = 8192, n_long: int = 11,
     n_params = sum(p.size for p in jax.tree.leaves(state.params))
     log(f"{tag} ({n_params / 1e6:.0f}M params): {per_step * 1e3:.1f} ms/step at "
         f"batch {batch} x seq {seq} → {rate:.0f} tokens/s ({rate.mfu_note()})")
+    return rate
+
+
+def bench_moe_lm(batch: int = 8, seq: int = 2048, n_long: int = 4,
+                 trials: int = 2):
+    """Single-chip Switch-MoE LM leg: same measurement discipline as
+    bench_lm, on the MoE model family (GPT-2-small dims, 4 experts, top-1
+    routing — ~4x the FFN params of the dense model at ~the dense FLOPs,
+    the MoE bargain the EP sharding distributes)."""
+    import jax.numpy as jnp
+
+    from distributed_ml_pytorch_tpu.models.moe import MoETransformerLM
+
+    moe = MoETransformerLM(
+        vocab_size=50304, d_model=768, n_heads=12, n_layers=12, d_ff=3072,
+        n_experts=4, max_len=seq, dtype=jnp.bfloat16,
+    )
+    return bench_lm(moe, batch=batch, seq=seq, n_long=n_long, trials=trials,
+                    tag=f"moe-4e-seq{seq}")
+
+
+def bench_decode(batch: int = 32, prompt_len: int = 128,
+                 new_tokens: int = 256, trials: int = 3):
+    """Autoregressive decode throughput (tokens/sec generated) of the
+    GPT-2-small model: one compiled prefill + one scanned generation
+    program (models/generate.py), differenced over repeated calls with a
+    rotating prompt so each dispatch is real work."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ml_pytorch_tpu.models import TransformerLM, generate
+
+    lm = TransformerLM(
+        vocab_size=50304, d_model=768, n_heads=12, n_layers=12, d_ff=3072,
+        max_len=prompt_len + new_tokens, dtype=jnp.bfloat16,
+    )
+    params = lm.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    prompts = [
+        jnp.asarray(np.random.default_rng(s).integers(
+            0, lm.vocab_size, size=(batch, prompt_len)), jnp.int32)
+        for s in range(3)
+    ]
+
+    def run(n):
+        t0 = time.perf_counter()
+        out = None
+        for i in range(n):
+            out = generate(lm, params, prompts[i % len(prompts)], new_tokens)
+        int(out[0, -1])  # force the chain
+        return time.perf_counter() - t0
+
+    run(2)  # compile prefill + scan
+    short = min(run(1) for _ in range(trials))
+    long_ = min(run(4) for _ in range(trials))
+    per_call = (long_ - short) / 3
+    rate = batch * new_tokens / per_call
+    log(f"decode: {per_call * 1e3:.1f} ms per {new_tokens}-token generation "
+        f"(batch {batch}) → {rate:.0f} tokens/s")
     return rate
 
 

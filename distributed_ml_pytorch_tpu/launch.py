@@ -44,7 +44,7 @@ def _free_port_block(n: int, attempts: int = 50) -> str:
                 s.bind(("", base + i))
                 socks.append(s)
             return str(base)
-        except OSError:
+        except (OSError, OverflowError):  # taken, or base+i ran past 65535
             continue
         finally:
             for s in socks:

@@ -114,6 +114,7 @@ class ShardedAsynchronous:
         transports: Sequence[Transport],
         rejoin: bool = False,
         install_timeout: float = 5.0,
+        heartbeats: Optional[Sequence] = None,
     ):
         validate_downpour_args(lr, n_push, n_pull)
         if not transports:
@@ -129,8 +130,14 @@ class ShardedAsynchronous:
         self._device_step = make_downpour_device_step(self.lr, self._pad)
         # per-shard liveness: a dead shard degrades that SLICE to purely-
         # local SGD (same contract as Asynchronous._send, per shard — the
-        # other shards keep their push/pull service)
+        # other shards keep their push/pull service). ``heartbeats[s]`` is
+        # an optional per-shard HeartbeatSender whose peer_down flag catches
+        # SILENT deaths (partition/power loss) that a blocking TCP send
+        # would otherwise stall on instead of raising.
         self.shard_down = [False] * len(self.transports)
+        self.heartbeats = list(heartbeats) if heartbeats else None
+        if self.heartbeats is not None and len(self.heartbeats) != len(self.transports):
+            raise ValueError("need one heartbeat sender per shard transport")
         # listeners attach before any send (async_ps ordering invariant)
         self.listeners = [Listener(transport=t) for t in self.transports]
         for listener in self.listeners:
@@ -157,16 +164,22 @@ class ShardedAsynchronous:
         """Send toward one shard server; its death degrades, never crashes."""
         if self.shard_down[shard]:
             return
+        if self.heartbeats is not None and self.heartbeats[shard].peer_down:
+            self._mark_down(shard)
+            return
         try:
             send_message(code, payload, transport=self.transports[shard])
         except (OSError, ConnectionError):
-            self.shard_down[shard] = True
-            lo, hi = self.ranges[shard]
-            print(
-                f"worker: shard server {shard} (params [{lo},{hi})) "
-                "unreachable — that slice continues with purely-local SGD",
-                file=sys.stderr,
-            )
+            self._mark_down(shard)
+
+    def _mark_down(self, shard: int) -> None:
+        self.shard_down[shard] = True
+        lo, hi = self.ranges[shard]
+        print(
+            f"worker: shard server {shard} (params [{lo},{hi})) "
+            "unreachable — that slice continues with purely-local SGD",
+            file=sys.stderr,
+        )
 
     def _install_arrived(self, params: Pytree) -> Pytree:
         """Patch whichever shard slices have arrived into the current flat
@@ -242,11 +255,9 @@ def run_sharded_ps_process(args) -> int:
         )
         try:
             model = get_model(getattr(args, "model", "alexnet"))
-            import jax.numpy as _jnp
-
             params = model.init(
                 jax.random.key(getattr(args, "seed", 0)),
-                _jnp.zeros((1, 32, 32, 3)),
+                jnp.zeros((1, 32, 32, 3)),
             )["params"]
             ckpt_dir = getattr(args, "ckpt_dir", "") or None
             server = make_shard_server(
@@ -291,6 +302,7 @@ def run_sharded_ps_process(args) -> int:
         factory = lambda params: ShardedAsynchronous(
             params, lr=args.lr, n_push=args.num_push, n_pull=args.num_pull,
             transports=transports, rejoin=getattr(args, "rejoin", False),
+            heartbeats=heartbeats or None,
         )
         _params, logger = train_worker(
             args, transports[0], opt_factory=factory

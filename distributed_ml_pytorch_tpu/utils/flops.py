@@ -78,6 +78,28 @@ def compiled_flops(jitted, *args, **kwargs) -> Optional[float]:
     return float(flops) if flops and flops > 0 else None
 
 
+def flash_attention_train_flops(batch: int, heads: int, seq: int,
+                                head_dim: int, n_layers: int, *,
+                                causal: bool = True,
+                                remat: bool = False) -> float:
+    """Analytic FLOPs of the Pallas flash-attention kernels for ONE train
+    step — the piece ``cost_analysis`` cannot see (custom calls are opaque).
+
+    Counted from the kernel structure (ops/attention.py): forward = 2
+    matmuls over the S² score plane (QKᵀ, PV); backward = 3 in the dQ kernel
+    (recomputed S, dP, dQ) + 4 in the dK/dV kernel (recomputed S, dV, dP,
+    dK) = 9 total, ×2 FLOPs/MAC, halved for causal (dead blocks are
+    skipped). Per-block remat reruns the forward kernel inside the backward
+    (+2). Add this to the XLA count to turn an LM leg's MFU floor into the
+    real numerator.
+    """
+    matmuls = 9 + (2 if remat else 0)
+    per_layer = matmuls * 2 * batch * heads * seq * seq * head_dim
+    if causal:
+        per_layer /= 2
+    return float(per_layer * n_layers)
+
+
 def utilization(flops_per_step: Optional[float], step_seconds: float,
                 device=None) -> tuple[Optional[float], Optional[float]]:
     """(achieved TFLOP/s, MFU fraction vs bf16 peak) for a measured step

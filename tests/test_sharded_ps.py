@@ -271,3 +271,26 @@ def test_sharded_rejoin_adopts_central_without_install():
         opt.finish()
     for t in threads:
         t.join(timeout=30)
+
+
+def test_peer_down_heartbeat_degrades_shard():
+    """A shard whose heartbeat sender reports peer_down degrades without
+    ever attempting the (possibly blocking) TCP send."""
+    params = _params()
+    worlds = [InProcessTransport.create_world(2) for _ in range(2)]
+
+    class FakeHeartbeat:
+        peer_down = False
+
+    hbs = [FakeHeartbeat(), FakeHeartbeat()]
+    opt = ShardedAsynchronous(params, lr=0.1, n_push=1, n_pull=1,
+                              transports=[w[1] for w in worlds],
+                              heartbeats=hbs)
+    try:
+        grads = {"w": jnp.ones(5), "b": jnp.ones(3)}
+        p = opt.step(params, grads)
+        hbs[0].peer_down = True
+        p = opt.step(p, grads)
+        assert opt.shard_down == [True, False]
+    finally:
+        opt.finish()
