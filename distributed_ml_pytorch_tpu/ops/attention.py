@@ -493,21 +493,60 @@ def scan_attn_fn(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     return finalize_attention(acc, l).astype(q.dtype)
 
 
-def gspmd_safe_lm(model, mesh):
-    """Pin a model to scan attention when its step will be GSPMD-partitioned.
+def make_sharded_attn_fn(mesh, batch_axes=("data",), head_axis=None,
+                         local_attn=None):
+    """Causal attention for GSPMD-partitioned train steps: a ``shard_map``
+    island over (batch, heads).
 
-    A ``pallas_call`` is an opaque custom call to XLA's SPMD partitioner —
-    it has no partitioning rule, so inside a multi-device jit-with-shardings
-    program (the tp/ep/fsdp/composite step style) the partitioner would have
-    to replicate its operands, defeating the sharding (and failing outright
-    at long-context shapes). shard_map-style steps (sync/sp/ulysses/pp) are
-    unaffected: their bodies are per-device programs where the kernel is
-    legal. Models that already inject an ``attn_fn`` are left alone; on a
-    1-device mesh the kernel is safe and kept.
+    A ``pallas_call`` is an opaque custom call to XLA's SPMD partitioner
+    (no partitioning rule), so it cannot sit directly inside a multi-device
+    jit-with-shardings program. But attention is exactly parallel over the
+    batch and head dimensions — so this wraps the whole attention in a
+    ``shard_map`` whose per-device body is ordinary local code, where
+    :func:`auto_attention` may legally pick the flash kernel (and still
+    picks the scan off-TPU or for unblockable shapes). ``batch_axes``/
+    ``head_axis`` must mirror how the enclosing step shards activations
+    (tp: batch over data + heads over model; fsdp: batch over data;
+    composite: batch over (data, fsdp) + heads over model), so the island
+    adds no resharding — just a boundary the partitioner already agrees
+    with. No collectives: in/out specs are identical and fully mapped.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    batch_entry = tuple(batch_axes) if not isinstance(batch_axes, str) else batch_axes
+    spec = P(batch_entry, head_axis, None, None)
+    local = local_attn or (lambda a, b, c: auto_attention(a, b, c, causal=True))
+
+    def attn(q, k, v):
+        # check_vma=False: the varying-manual-axes checker cannot see
+        # through a pallas_call's ShapeDtypeStruct out_shapes (verified to
+        # reject the kernel body on this jax); the island's specs are fully
+        # mapped with no collectives, so the check buys nothing here
+        f = jax.shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+        return f(q, k, v)
+
+    return attn
+
+
+def gspmd_safe_lm(model, mesh, batch_axes=("data",), head_axis=None):
+    """Give a model GSPMD-legal attention for a jit-with-shardings step.
+
+    On a multi-device mesh the model default (:func:`auto_attention`, which
+    may emit a ``pallas_call`` — illegal under pure GSPMD, see
+    :func:`make_sharded_attn_fn`) is replaced by the shard_map island with
+    the step's activation layout, so tp/ep/fsdp/composite keep the flash
+    kernel's speed on real hardware. Models that already inject an
+    ``attn_fn`` are left alone; on a 1-device mesh the direct kernel is
+    safe and kept.
     """
     has_field = "attn_fn" in getattr(model, "__dataclass_fields__", {})
     if mesh.devices.size > 1 and has_field and model.attn_fn is None:
-        return model.clone(attn_fn=scan_attn_fn)
+        return model.clone(
+            attn_fn=make_sharded_attn_fn(mesh, batch_axes, head_axis)
+        )
     return model
 
 

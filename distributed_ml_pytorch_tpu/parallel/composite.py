@@ -116,6 +116,7 @@ def make_composite_train_step(
     shardings,
     data_axis: str = "data",
     fsdp_axis: str = "fsdp",
+    model_axis: str = "model",
 ) -> Callable:
     """Jitted 3-D (dp×fsdp×tp) LM step: ``(state, tokens, targets) → (state, loss)``.
 
@@ -128,7 +129,8 @@ def make_composite_train_step(
 
     return make_sharded_step(
         tx, mesh, shardings, P((data_axis, fsdp_axis), None),
-        safe_lm_loss_builder(model, mesh), 2,
+        safe_lm_loss_builder(model, mesh, batch_axes=(data_axis, fsdp_axis),
+                             head_axis=model_axis), 2,
     )
 
 

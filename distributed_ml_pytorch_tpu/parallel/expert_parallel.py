@@ -83,6 +83,7 @@ def make_ep_train_step(
     mesh: Mesh,
     expert_axis: str = "expert",
     aux_loss_weight: float = 0.01,
+    data_axis: str = "data",
 ) -> Callable:
     """Build the jitted dp×ep MoE step: ``(state, tokens, targets) → (state, metrics)``.
 
@@ -93,7 +94,9 @@ def make_ep_train_step(
     _check_experts(model, int(mesh.shape[expert_axis]))
     from distributed_ml_pytorch_tpu.ops.attention import gspmd_safe_lm
 
-    model = gspmd_safe_lm(model, mesh)  # pallas has no SPMD partitioning rule
+    # attention becomes a shard_map island (batch over data; heads local)
+    # so the flash kernel stays legal — and fast — under GSPMD
+    model = gspmd_safe_lm(model, mesh, batch_axes=(data_axis,))
 
     def step(state: TrainState, tokens, targets):
         def loss_fn(params):

@@ -251,20 +251,23 @@ def make_fsdp_lm_train_step(
     """
 
     return make_sharded_step(
-        tx, mesh, shardings, P(axis, None), safe_lm_loss_builder(model, mesh), 2
+        tx, mesh, shardings, P(axis, None),
+        safe_lm_loss_builder(model, mesh, batch_axes=(axis,)), 2
     )
 
 
-def safe_lm_loss_builder(model, mesh) -> Callable:
-    """:func:`lm_loss_builder` with the GSPMD attention pin applied — THE
+def safe_lm_loss_builder(model, mesh, batch_axes=("data",),
+                         head_axis=None) -> Callable:
+    """:func:`lm_loss_builder` with GSPMD-legal attention applied — THE
     chokepoint for jit-with-shardings LM step factories (fsdp-LM,
     composite; tp/ep apply :func:`ops.attention.gspmd_safe_lm` to their own
     loss closures). Any future GSPMD LM step must route through this (or
     call ``gspmd_safe_lm`` itself) — a pallas_call inside a multi-device
-    GSPMD program has no SPMD partitioning rule."""
+    GSPMD program has no SPMD partitioning rule, so attention runs as a
+    shard_map island matching the step's (batch, heads) layout."""
     from distributed_ml_pytorch_tpu.ops.attention import gspmd_safe_lm
 
-    return lm_loss_builder(gspmd_safe_lm(model, mesh))
+    return lm_loss_builder(gspmd_safe_lm(model, mesh, batch_axes, head_axis))
 
 
 def lm_loss_builder(model) -> Callable:

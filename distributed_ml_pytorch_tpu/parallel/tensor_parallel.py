@@ -134,6 +134,7 @@ def make_tp_train_step(
     tx: optax.GradientTransformation,
     mesh: Mesh,
     model_axis: str = "model",
+    data_axis: str = "data",
 ) -> Callable:
     """Build the jitted dp×tp LM step: ``(state, tokens, targets) → (state, loss)``.
 
@@ -151,7 +152,9 @@ def make_tp_train_step(
     _check_divisibility(model, int(mesh.shape[model_axis]))
     from distributed_ml_pytorch_tpu.ops.attention import gspmd_safe_lm
 
-    model = gspmd_safe_lm(model, mesh)  # pallas has no SPMD partitioning rule
+    # attention becomes a shard_map island (batch over data, heads over
+    # model) so the flash kernel stays legal — and fast — under GSPMD
+    model = gspmd_safe_lm(model, mesh, batch_axes=(data_axis,), head_axis=model_axis)
 
     def step(state: TrainState, tokens, targets):
         def loss_fn(params):

@@ -169,24 +169,46 @@ def test_auto_attention_matches_reference_off_tpu():
                                rtol=1e-5, atol=1e-5)
 
 
-def test_gspmd_safe_lm_pins_scan_on_multidevice_mesh():
-    """GSPMD step factories must not embed a pallas custom call (no SPMD
-    partitioning rule) — models with a default attn_fn get the scan pinned
-    on multi-device meshes, stay untouched on 1-device meshes, and injected
-    attn_fns are never overridden."""
+def test_gspmd_safe_lm_pins_sharded_island_on_multidevice_mesh():
+    """GSPMD step factories must not embed a bare pallas custom call (no
+    SPMD partitioning rule) — models with a default attn_fn get a
+    shard_map attention island on multi-device meshes, stay untouched on
+    1-device meshes, and injected attn_fns are never overridden."""
     from distributed_ml_pytorch_tpu.models import TransformerLM
     from distributed_ml_pytorch_tpu.models.moe import MoETransformerLM
-    from distributed_ml_pytorch_tpu.ops.attention import gspmd_safe_lm, scan_attn_fn
+    from distributed_ml_pytorch_tpu.ops.attention import gspmd_safe_lm
     from distributed_ml_pytorch_tpu.runtime.mesh import make_mesh
 
     mesh8 = make_mesh({"data": 8})
     mesh1 = make_mesh({"data": 1}, devices=jax.devices()[:1])
     for cls in (TransformerLM, MoETransformerLM):
         m = cls(vocab_size=64, d_model=32, n_heads=4, n_layers=1, d_ff=64)
-        assert gspmd_safe_lm(m, mesh8).attn_fn is scan_attn_fn
+        pinned = gspmd_safe_lm(m, mesh8)
+        assert pinned is not m and pinned.attn_fn is not None
         assert gspmd_safe_lm(m, mesh1) is m
         injected = m.clone(attn_fn=attention_reference)
         assert gspmd_safe_lm(injected, mesh8).attn_fn is attention_reference
+
+
+def test_sharded_attn_island_matches_reference():
+    """The shard_map attention island (batch over data, heads over model)
+    must reproduce dense causal attention exactly — attention is parallel
+    over (batch, heads), so sharding adds nothing numerically."""
+    from distributed_ml_pytorch_tpu.ops.attention import make_sharded_attn_fn
+    from distributed_ml_pytorch_tpu.runtime.mesh import make_mesh
+
+    mesh = make_mesh({"data": 2, "model": 4})
+    attn = make_sharded_attn_fn(mesh, batch_axes=("data",), head_axis="model")
+    rng = np.random.default_rng(8)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 4, 128, 32)), jnp.float32)
+               for _ in range(3))
+    got = jax.jit(attn)(q, k, v)
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # and it is differentiable end-to-end (the GSPMD step trains through it)
+    g = jax.jit(jax.grad(lambda q: attn(q, k, v).sum()))(q)
+    assert np.isfinite(np.asarray(g)).all()
 
 
 def test_flash_attention_default_blocks_adapt_to_sequence():
@@ -199,3 +221,28 @@ def test_flash_attention_default_blocks_adapt_to_sequence():
     want = attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=2e-4)
+
+
+def test_sharded_attn_island_runs_pallas_kernel():
+    """The island's purpose is hosting the Pallas kernel under shard_map —
+    exercised here with interpret-mode flash (the CPU analog of the TPU
+    path auto_attention takes), guarding against shard_map/pallas interop
+    regressions (e.g. the check_vma rejection of custom-call bodies)."""
+    from distributed_ml_pytorch_tpu.ops.attention import make_sharded_attn_fn
+    from distributed_ml_pytorch_tpu.runtime.mesh import make_mesh
+
+    mesh = make_mesh({"data": 2, "model": 4})
+    attn = make_sharded_attn_fn(
+        mesh, batch_axes=("data",), head_axis="model",
+        local_attn=lambda q, k, v: flash_attention(
+            q, k, v, causal=True, interpret=True),
+    )
+    rng = np.random.default_rng(9)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 4, 128, 32)), jnp.float32)
+               for _ in range(3))
+    got = jax.jit(attn)(q, k, v)
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+    g = jax.jit(jax.grad(lambda q: attn(q, k, v).sum()))(q)
+    assert np.isfinite(np.asarray(g)).all()
