@@ -120,6 +120,15 @@ def tpu_phase() -> None:
          "single-chip leg of the 8-way DP config; the sync-DP step is "
          "numerically validated on an 8-device mesh (tests/test_resnet.py)")
 
+    # config 4 (MXU-native leg) — ResNet-18 in bf16 at a batch that fills
+    # the MXU (the f32/batch-64 leg above keeps the reference-recipe shape)
+    r18bf = bench_jax(model=get_resnet("resnet18", dtype=jnp.bfloat16),
+                      batch=256, k=10, n_long=8, trials=3)
+    emit(4, "resnet18_cifar10_train_throughput_bf16", r18bf,
+         "images/sec/chip", hw,
+         "bf16 activations + f32 master params, batch 256, device-resident "
+         "input")
+
     # config 5 (per-chip leg) — ResNet-50, ImageNet shapes (224x224, 1000-way)
     r50 = bench_jax(model=get_resnet("resnet50", num_classes=1000), batch=32,
                     input_shape=(224, 224, 3), n_classes=1000, k=4,
