@@ -3,13 +3,16 @@
 The reference has no attention at all (image CNNs only, SURVEY.md §5.7); this
 module is the long-context foundation the TPU framework adds as first-class:
 
-- ``flash_attention`` — a Pallas TPU kernel, now DIFFERENTIABLE via
+- ``flash_attention`` — a Pallas TPU kernel, DIFFERENTIABLE via
   ``jax.custom_vjp``: the O(S²) score matrix never touches HBM in either
   pass. Forward: grid over (batch·heads, query blocks, key blocks) with
   online-softmax statistics in VMEM scratch, emitting the per-row logsumexp
-  as a residual. Backward: two kernels (one accumulating dQ over key blocks,
-  one accumulating dK/dV over query blocks) that recompute probabilities
-  from the saved logsumexp — the standard flash recipe. Causally-dead
+  as a residual. Backward (default ``bwd_impl="fused"``): ONE kernel over
+  (bh, key block, query block) recomputing probabilities from the saved
+  logsumexp once per block pair — dK/dV accumulate in VMEM scratch across
+  the inner query sweep, dQ is emitted as per-key-block partials reduced by
+  one XLA sum afterwards. A ``"split"`` two-kernel backward (dQ pass +
+  dK/dV pass, scores recomputed twice) is kept for A/B. Causally-dead
   blocks are skipped.
 - ``blockwise_attention`` — the same online-softmax recurrence written as a
   ``lax.scan`` over key blocks in plain JAX: used as the per-chunk compute
@@ -20,31 +23,38 @@ module is the long-context foundation the TPU framework adds as first-class:
   when the shape fits its blocking, the scan otherwise.
 - ``attention_reference`` — the naive softmax(QKᵀ)V for tests.
 
-Block sizes: measured on the real chip (v5 lite), causal bf16
-(b=8, h=12, S=2048, d=64) — the round-1 (128,128) blocking ran at 10.4 ms
-(no better than the scan's 10.3 ms, which round 1 wrongly concluded was a
-scan win); the sweep found (block_q=1024, block_k=512) runs 0.58 ms —
-17.8× the scan — because per-grid-step MXU work finally dominates DMA and
-bookkeeping. At GPT-2-small scale the scan-based step spent ~90% of its
-time in attention (no-attention ablation: 82 ms vs 839 ms/step), so the
+Measurements (v5 lite, causal bf16, b=8 h=12 S=2048 d=64, DEVICE-TRUE
+timing via ``utils/devtime`` — round ≤2 numbers came from host clocks that
+the tunnel made unreliable; see devtime's docstring): forward at the
+default (1024, 1024) blocking runs 1.63 ms vs the blockwise scan's
+10.2 ms (6.3×) and (128, 128)'s 10.7 ms. The fused backward brings
+fwd+bwd to 4.49 ms — the backward alone is 1.75× the forward against
+~2.5× in raw FLOPs, vs 3.7× for the split two-kernel backward (5.34 ms
+total). Calibration against the installed JAX's own kernels on identical
+shapes: legacy ``pallas.ops.tpu.flash_attention`` 1.49 ms fwd / 8.0 ms
+fwd+bwd at its best blocking; ``splash_attention`` with its fused backward
+1.63 ms / 4.49 ms — this kernel matches splash on both passes, so it sits
+on the Mosaic ceiling for this shape. What got it there, in measured
+order of importance: (1) one score recompute per block pair (the split
+backward's second recompute cost ~0.9 ms); (2) lane-replicated (BQ, 128)
+m/l statistics widened by whole-tile copies (``_rep_lanes``) — replacing
+(BQ, 1) lane-broadcast shuffles cut ~0.9 ms from the forward at sub-1024
+key blocks; (3) transposed (BK, BQ) scores in the backward so dV/dK are
+plain NN contractions and lse/delta broadcast along sublanes; (4) log2-
+space softmax and diagonal-only masking (small, ~2% each). At GPT-2-small
+scale the scan-based step spent ~90% of its time in attention, so the
 kernel, not the scan, is the training default on TPU (auto_attention).
 
-Backward blocking: the fwd-best (1024, 512) also wins for fwd+bwd —
-measured 4.51 ms/call vs 5.97 ms at (512, 512) (b8·h12·S2048, min of 3
-trials over 20-call chains; short-chain timings on the tunneled chip are
-noise — see bench.py's differenced method). The backward runs ≈6.7× the
-forward (vs ~2.5× in raw FLOPs): the dK/dV pass's transposed contractions
-and the double recomputation of scores leave headroom for a future fused
-backward.
-
-Long-context sweep (S ∈ {2k, 8k, 32k}, VERDICT r1 #3): beyond speed, the
+Long-context sweep (S ∈ {2k, 8k, 32k}, device-true): beyond speed, the
 scan's BACKWARD is O(S²) HBM — XLA's autodiff saves every per-block score
 tensor, and at S=8192 (b2·h12) its gradient OOMs at 19.5 GB against the
 chip's 15.75 GB. The flash backward recomputes probabilities from the saved
-logsumexp instead: at S=32768 (b1·h12) fwd+bwd runs in 157 ms (~37 useful
-TFLOP/s, differenced chained-dispatch timing) where the scan cannot compile
-at all — on this hardware the kernel is the only differentiable attention
-at long context without rematerialization.
+logsumexp instead, and the fused backward holds bwd ≈ 2.0× fwd at every
+length: b2·h12·S8192 fwd 4.24 ms / fwd+bwd 12.7 ms (56.8 useful TFLOP/s);
+b1·h12·S32768 fwd 29.9 ms / fwd+bwd 92.3 ms (62.5 TFLOP/s, 31.7% of bf16
+peak — vs 157 ms for the round-2 split backward) where the scan cannot
+compile at all. On this hardware the kernel is the only differentiable
+attention at long context without rematerialization.
 
 All take ``(batch, heads, seq, head_dim)`` and an optional causal mask.
 ``NEG_INF`` is a large-finite mask value rather than ``-inf`` so fully-masked
@@ -63,6 +73,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+LOG2_E = 1.4426950408889634  # scores are kept in log2 space inside the kernels
+# ceiling on the fused backward's HBM dq-partials buffer; above it the
+# buffer-free split backward is auto-selected (measured S=32k fused buffer:
+# 3.2 GB on the 15.75 GB chip — comfortably under; 2× longer would not be)
+FUSED_BWD_PARTIALS_CAP = 6 * 1024**3
 
 
 def attention_reference(
@@ -170,6 +185,14 @@ def finalize_attention(acc: jax.Array, l: jax.Array) -> jax.Array:
     return acc / jnp.maximum(l, 1e-30)
 
 
+def _rep_lanes(x, width):
+    """Widen a 128-lane-replicated (rows, 128) value to (rows, width) by
+    whole-tile copies — never a lane-broadcast shuffle (see _flash_kernel)."""
+    if width <= 128:
+        return x[:, :width]
+    return jnp.tile(x, (1, pl.cdiv(width, 128)))[:, :width]
+
+
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     *, block_q: int, block_k: int, causal: bool
@@ -186,33 +209,65 @@ def _flash_kernel(
 
     # keys strictly after the last query of this block contribute nothing
     live = (kj * block_k < (qi + 1) * block_q) if causal else (kj >= 0)
+    # blocks wholly below the diagonal need no mask at all — only the
+    # diagonal-straddling blocks pay the iota/compare/select VPU passes
+    # (the per-step cost is VPU-bound at d=64: O(BQ·BK) vector work against
+    # d-thin matmuls, so every elementwise pass over the score block counts)
+    diag = ((kj + 1) * block_k - 1 > qi * block_q) if causal else None
 
-    @pl.when(live)
-    def _step():
+    def _step(masked):
         q = q_ref[0]  # (BQ, D)
         d = q.shape[-1]
         k_blk = k_ref[0]  # (BK, D)
         v_blk = v_ref[0]
+        # scores in log2 space: fold log2(e) into the 1/√d scale so the
+        # softmax runs on exp2 — one fewer multiply pass over the score
+        # block per step (the kernel is VPU-bound, so elementwise passes
+        # are the currency; the lse residual is stored base-2 to match)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * (d**-0.5)
-        if causal:
+        ) * (d**-0.5 * LOG2_E)
+        if masked:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        m_prev = m_ref[:, :1]  # lanes hold replicated copies; use lane 0
-        l_prev = l_ref[:, :1]
+        # m/l live lane-replicated at full 128-lane width so the (BQ, BK)
+        # broadcasts below are TILE copies, not lane-broadcast shuffles —
+        # a (BQ, 1) operand must be shuffled across lanes for every 128-wide
+        # score tile, and that shuffle was ~60% of the whole kernel's time
+        # (measured by ablation: matmul+DMA floor 0.62 ms vs 1.5 ms full)
+        m_prev = m_ref[:]  # (BQ, 128)
+        l_prev = l_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
-        correction = jnp.exp(m_prev - m_new)
-        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+        # no select guarding the exp: every flash row has ≥1 live key
+        # (causal needs sq == sk, so the diagonal is always present), hence
+        # m_new is finite and masked entries underflow to exactly 0 —
+        # exp2(NEG_INF − m_new) = 0 in f32. (The scan keeps its guard: ring
+        # attention feeds it fully-masked rows where m_new == NEG_INF.)
+        p = jnp.exp2(s - _rep_lanes(m_new, block_k))
+        correction = jnp.exp2(m_prev - m_new)
+        l_new = l_prev * correction + jax.lax.broadcast_in_dim(
+            jnp.sum(p, axis=-1), l_prev.shape, (0,))
         pv = jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
-        acc_ref[:] = acc_ref[:] * correction + pv
+        m_ref[:] = m_new
+        l_ref[:] = l_new
+        acc_ref[:] = acc_ref[:] * _rep_lanes(correction, d) + pv
+
+    if causal:
+        @pl.when(live & diag)
+        def _step_diag():
+            _step(True)
+
+        @pl.when(live & jnp.logical_not(diag))
+        def _step_interior():
+            _step(False)
+    else:
+        @pl.when(live)
+        def _step_full():
+            _step(False)
 
     @pl.when(kj == n_k - 1)
     def _finalize():
@@ -221,7 +276,7 @@ def _flash_kernel(
         # per-row logsumexp — the backward's softmax residual. Stored
         # sublane-replicated ×8 so the output block is a legal (8, block_q)
         # TPU tile (rank-2 row vectors can't be blocked per-bh otherwise).
-        lse = (m_ref[:, :1] + jnp.log(l_fin))[:, 0]
+        lse = (m_ref[:, :1] + jnp.log2(l_fin))[:, 0]  # base-2, like the scores
         lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
 
 
@@ -259,16 +314,17 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _recompute_p(q, k_blk, qi, kj, lse, *, block_q, block_k, causal, scale):
-    """Probabilities p = exp(s − lse) for one (q block, k block) pair — the
-    backward pass's recomputation (scores never persisted)."""
+    """Probabilities p = exp2(s₂ − lse₂) for one (q block, k block) pair — the
+    backward pass's recomputation (scores never persisted; log2 space, with
+    masked entries underflowing to exactly 0 against the finite lse)."""
     s = jax.lax.dot_general(
         q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
+    ) * (scale * LOG2_E)
     if causal:
         q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-    return jnp.where(s > NEG_INF / 2, jnp.exp(s - lse[:, None]), 0.0), s
+    return jnp.exp2(s - lse[:, None]), s
 
 
 def _flash_dq_kernel(
@@ -349,25 +405,157 @@ def _flash_dkv_kernel(
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
-def _flash(causal, block_q, block_k, interpret, q, k, v):
-    out, _lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+def _flash_bwd_fused_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_part_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+    *, block_q: int, block_k: int, causal: bool
+):
+    """One-pass backward: grid (bh, key block j, query block i), i innermost.
+
+    Scores are recomputed ONCE per (i, j) block pair (the split kernels
+    recomputed them twice — measured 6.7× fwd, vs ~2.5× in raw FLOPs).
+    dK/dV accumulate in VMEM scratch across the inner query sweep. dQ cannot
+    accumulate in scratch here (its block changes every inner step), so each
+    grid step emits a per-key-block PARTIAL dq block into an (n_k, bh, sq, d)
+    output that one XLA reduction folds afterwards — the same layout JAX's
+    own fused splash-attention backward uses.
+
+    Scores are built TRANSPOSED, (block_k, block_q): that makes dV = pᵀ·do
+    and dK = dsᵀ·q plain non-transposed MXU contractions, and broadcasts
+    the per-query lse/delta row vectors along lanes for free.
+    """
+    kj, qi = pl.program_id(1), pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # query blocks entirely before this key block see none of it
+    live = ((qi + 1) * block_q > kj * block_k) if causal else (qi >= 0)
+    # interior (fully-live) blocks skip the mask's VPU passes, as in forward
+    diag = ((kj + 1) * block_k - 1 > qi * block_q) if causal else None
+
+    def _step(masked):
+        q = q_ref[0]
+        d = q.shape[-1]
+        scale = d**-0.5
+        k_blk, v_blk, do = k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0, :1]  # (1, BQ) — queries along lanes
+        di = delta_ref[0, :1]
+        s_t = jax.lax.dot_general(  # k @ qᵀ → (BK, BQ)
+            k_blk, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (scale * LOG2_E)  # log2 space, matching the stored lse
+        if masked:
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1)
+            s_t = jnp.where(k_pos <= q_pos, s_t, NEG_INF)
+        # masked entries underflow to exactly 0 (lse finite per row) — no
+        # select needed, as in the forward
+        p_t = jnp.exp2(s_t - lse)
+        dv_acc[:] += jax.lax.dot_general(  # pᵀ·do as plain (BK,BQ)@(BQ,D)
+            p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp_t = jax.lax.dot_general(  # v @ doᵀ → (BK, BQ)
+            v_blk, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds_t = p_t * (dp_t - di) * scale
+        dk_acc[:] += jax.lax.dot_general(  # dsᵀ·q as plain (BK,BQ)@(BQ,D)
+            ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dq_part_ref[0, 0] = jax.lax.dot_general(  # ds·k → (BQ, D)
+            ds_t.astype(k_blk.dtype), k_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        @pl.when(live & diag)
+        def _step_diag():
+            _step(True)
+
+        @pl.when(live & jnp.logical_not(diag))
+        def _step_interior():
+            _step(False)
+
+        # dead pairs must still publish a (zero) dq partial
+        @pl.when(jnp.logical_not(live))
+        def _dead():
+            dq_part_ref[0, 0] = jnp.zeros_like(dq_part_ref[0, 0])
+    else:
+        @pl.when(live)
+        def _step_full():
+            _step(False)
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _flash(causal, blocks, bwd_blocks, interpret, bwd_impl, q, k, v):
+    out, _lse = _flash_fwd(q, k, v, causal, blocks[0], blocks[1], interpret)
     return out
 
 
-def _flash_fwd_rule(causal, block_q, block_k, interpret, q, k, v):
-    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+def _flash_fwd_rule(causal, blocks, bwd_blocks, interpret, bwd_impl, q, k, v):
+    out, lse = _flash_fwd(q, k, v, causal, blocks[0], blocks[1], interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
+def _flash_bwd_rule(causal, blocks, bwd_blocks, interpret, bwd_impl, res, do):
     q, k, v, out, lse = res
+    # backward blocking is swept independently of the forward's: on the v5e
+    # the fused backward at (1024, 1024) runs ~19% faster than at the
+    # fwd-shared (1024, 512) — see the module docstring's measurements
+    block_q, block_k = bwd_blocks
     bh, sq, d = q.shape
     sk = k.shape[1]
-    # delta_i = Σ_d do·o — one cheap fused XLA pass, shared by both kernels
+    # delta_i = Σ_d do·o — one cheap fused XLA pass, shared by the kernels
     # (broadcast into the same 8-sublane-replicated layout as lse)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, sq))
+    if bwd_impl == "fused":
+        n_k = sk // block_k
+        qspec = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0),
+                             memory_space=pltpu.VMEM)
+        kspec = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0),
+                             memory_space=pltpu.VMEM)
+        rowspec = pl.BlockSpec((1, 8, block_q), lambda bh, j, i: (bh, 0, i),
+                               memory_space=pltpu.VMEM)
+        dq_part, dk, dv = pl.pallas_call(
+            functools.partial(_flash_bwd_fused_kernel, block_q=block_q,
+                              block_k=block_k, causal=causal),
+            out_shape=(
+                jax.ShapeDtypeStruct((n_k, bh, sq, d), jnp.float32),
+                jax.ShapeDtypeStruct(k.shape, k.dtype),
+                jax.ShapeDtypeStruct(v.shape, v.dtype),
+            ),
+            grid=(bh, n_k, sq // block_q),
+            in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+            out_specs=(
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda bh, j, i: (j, bh, i, 0),
+                             memory_space=pltpu.VMEM),
+                kspec, kspec,
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+        dq = dq_part.sum(axis=0).astype(q.dtype)
+        return dq, dk, dv
+    # split impl: the round-2 two-kernel backward (scores recomputed twice) —
+    # kept for A/B measurement and as a fallback with no dq-partials buffer
     qspec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0), memory_space=pltpu.VMEM)
     kspec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0), memory_space=pltpu.VMEM)
     rowspec = pl.BlockSpec((1, 8, block_q), lambda bh, i, j: (bh, 0, i), memory_space=pltpu.VMEM)
@@ -413,47 +601,68 @@ def flash_attention(
     causal: bool = False,
     block_q: int | None = None,
     block_k: int | None = None,
+    block_q_bwd: int | None = None,
+    block_k_bwd: int | None = None,
     interpret: bool | None = None,
+    bwd_impl: str | None = None,
 ) -> jax.Array:
     """Differentiable Pallas flash attention over (batch, heads, seq, head_dim).
 
     Block sizes default to the largest measured-good blocking that divides
-    the sequence lengths (``flash_block_choice`` — (1024, 512) on aligned
-    shapes, down to (128, 128)); explicit blocks must divide exactly. Pad
-    upstream for ragged sequences, or use ``auto_attention`` which falls
+    the sequence lengths — ``flash_block_choice`` for the forward and
+    ``flash_bwd_block_choice`` for the backward (both prefer (1024, 1024)
+    on aligned shapes, down to (128, 128); see the module docstring's
+    sweep) — and a shape no candidate divides raises rather than falling
+    back to an unswept clamp. Explicit blocks must divide exactly.
+    Pad upstream for ragged sequences, or use ``auto_attention`` which falls
     back to the scan. ``causal`` requires ``sq == sk`` (the standard
     self-attention layout; the end-aligned decode mask is a different
     contract and is rejected rather than silently diverging).
     ``interpret=None`` auto-selects interpreter mode off-TPU so the same code
-    runs under the CPU test mesh.
+    runs under the CPU test mesh. ``bwd_impl``: "fused" (one kernel, scores
+    recomputed once per block pair) or "split" (the two-kernel dQ + dK/dV
+    pair, scores recomputed twice, but no dq-partials buffer). The default
+    ``None`` picks "fused" unless its (sk/block_k, b·h, sq, d) f32
+    dq-partials buffer would exceed ``FUSED_BWD_PARTIALS_CAP`` bytes of HBM
+    (beyond ~S=48k at GPT-2-small geometry), where the slower-but-lean
+    split keeps long-context training compilable.
     """
     b, h, sq, d = q.shape
     sk = k.shape[2]
     if causal and sq != sk:
         raise ValueError(f"causal flash_attention requires sq == sk, got {sq} != {sk}")
-    # each side derives independently: the largest measured-good block that
-    # divides it, else the legacy clamp (min(default, seq) — so short or
-    # odd-but-small lengths keep working as single blocks, and a too-long
-    # indivisible length still surfaces the divisibility error below)
+    defaults = flash_block_choice(sq, sk)
+    if (block_q is None or block_k is None) and defaults is None:
+        raise ValueError(
+            f"no flash blocking divides sq={sq}, sk={sk}; pad the sequence "
+            "or use auto_attention (scan fallback)"
+        )
     if block_q is None:
-        block_q = next((c for c in (1024, 512, 256, 128) if sq % c == 0),
-                       min(1024, sq))
+        block_q = defaults[0]
     if block_k is None:
-        block_k = next((c for c in (512, 256, 128) if sk % c == 0),
-                       min(512, sk))
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k:
+        block_k = defaults[1]
+    bwd_defaults = flash_bwd_block_choice(sq, sk) or (block_q, block_k)
+    if block_q_bwd is None:
+        block_q_bwd = bwd_defaults[0]
+    if block_k_bwd is None:
+        block_k_bwd = bwd_defaults[1]
+    if sq % block_q or sk % block_k or sq % block_q_bwd or sk % block_k_bwd:
         raise ValueError(
             f"flash_attention needs seq multiples of block sizes, got "
-            f"sq={sq}%{block_q}, sk={sk}%{block_k}"
+            f"sq={sq}%{block_q}/{block_q_bwd}, sk={sk}%{block_k}/{block_k_bwd}"
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if bwd_impl is None:
+        partials = (sk // block_k_bwd) * b * h * sq * d * 4
+        bwd_impl = "split" if partials > FUSED_BWD_PARTIALS_CAP else "fused"
+    if bwd_impl not in ("fused", "split"):
+        raise ValueError(f"bwd_impl must be 'fused' or 'split', got {bwd_impl!r}")
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
-    out = _flash(causal, block_q, block_k, interpret, qf, kf, vf)
+    out = _flash(causal, (block_q, block_k), (block_q_bwd, block_k_bwd),
+                 interpret, bwd_impl, qf, kf, vf)
     return out.reshape(b, h, sq, d)
 
 
@@ -551,9 +760,19 @@ def gspmd_safe_lm(model, mesh, batch_axes=("data",), head_axis=None):
 
 
 def flash_block_choice(sq: int, sk: int):
-    """Largest measured-good (block_q, block_k) dividing the sequence
+    """Largest measured-good forward (block_q, block_k) dividing the sequence
     lengths, or None when no legal blocking exists (→ scan fallback).
     Preference order reflects the v5e sweep in the module docstring."""
     bq = next((c for c in (1024, 512, 256, 128) if sq % c == 0), None)
-    bk = next((c for c in (512, 256, 128) if sk % c == 0), None)
+    bk = next((c for c in (1024, 512, 256, 128) if sk % c == 0), None)
+    return None if bq is None or bk is None else (bq, bk)
+
+
+def flash_bwd_block_choice(sq: int, sk: int):
+    """Backward blocking: the fused backward's v5e sweep prefers square
+    (1024, 1024) — larger key blocks amortize the per-(i, j) dq-partial
+    write, and the kernel has no (block_q, block_k) score transpose asymmetry
+    the forward has. Falls to smaller divisors like the forward choice."""
+    bq = next((c for c in (1024, 512, 256, 128) if sq % c == 0), None)
+    bk = next((c for c in (1024, 512, 256, 128) if sk % c == 0), None)
     return None if bq is None or bk is None else (bq, bk)

@@ -1,0 +1,144 @@
+"""Device-true timing from bounded profiler traces.
+
+Why this exists: on a tunneled / shared TPU (this rig: one v5e behind an
+HTTP tunnel), every host-side clock lies. ``block_until_ready`` can return
+before the device finishes, a device→host fetch pays a large and *variable*
+RTT, and the device may be time-shared between tenants — measured here:
+host-differenced estimates for the same kernel swung 0.34–5.0 ms across
+runs (even with chained data dependencies and min-of-N trials), while the
+profiler's device timeline showed every one of 10 calls at 2.528–2.529 ms.
+The XLA profiler records per-program start/stop on the device clock, so its
+durations are immune to both the tunnel and host jitter.
+
+``device_time`` runs a callable a few times inside a bounded
+``jax.profiler.trace`` window (the same machinery ``utils/tracing.py``
+exposes for training jobs, SURVEY.md §5.1) and parses the emitted
+Chrome-trace JSON for the device-side program spans. The result reports
+per-call device time plus a per-program breakdown (useful for roofline
+attribution: e.g. decode's weight-read program vs its sampling program).
+
+Off-TPU (the CPU test mesh) the XLA CPU backend does not emit comparable
+device spans, so the utility falls back to wall-clock differencing and says
+so in the result; tests cover the parser on a canned trace instead.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import tempfile
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class DeviceTiming:
+    """Per-call device time for one traced callable."""
+
+    per_call_s: float
+    calls: int
+    #: program name -> (count, total_seconds) on the device timeline
+    programs: dict = field(default_factory=dict)
+    #: "trace" (device-true) or "wallclock" (off-TPU fallback)
+    source: str = "trace"
+
+    @property
+    def per_call_ms(self) -> float:
+        return self.per_call_s * 1e3
+
+
+def parse_device_spans(trace_json: dict) -> dict:
+    """Device-pid complete spans from a Chrome-trace dict.
+
+    Returns ``{event_name: (count, total_seconds)}`` for 'X' (complete)
+    events on processes whose ``process_name`` metadata mentions a device
+    (``/device:``). Nested fusion spans are included under their own names;
+    the top-level XLA program spans are the ``jit_*``-named ones.
+    """
+    events = trace_json.get("traceEvents", [])
+    device_pids = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            if "/device:" in str(e.get("args", {}).get("name", "")):
+                device_pids.add(e["pid"])
+    out: dict = {}
+    for e in events:
+        if e.get("ph") == "X" and e.get("pid") in device_pids and "dur" in e:
+            name = e.get("name", "?")
+            n, tot = out.get(name, (0, 0.0))
+            out[name] = (n + 1, tot + e["dur"] / 1e6)
+    return out
+
+
+def _top_level_total(programs: dict) -> tuple[int, float]:
+    """(calls, total_seconds) of the top-level XLA program spans.
+
+    XLA names a jitted program's device span ``jit_<fn>(<fingerprint>)``;
+    everything else (``fusion.N``, ``copy.N``, …) is nested inside one.
+    When several distinct programs ran (e.g. a grad function that launches
+    forward + two backward kernels as one program each), all jit spans are
+    summed — the caller traced only the calls it wants attributed.
+    """
+    n_calls, total = 0, 0.0
+    for name, (n, tot) in programs.items():
+        if name.startswith("jit"):
+            n_calls = max(n_calls, n)
+            total += tot
+    return n_calls, total
+
+
+def device_time(fn, *args, calls: int = 10, warmup: int = 2,
+                trace_dir: str | None = None) -> DeviceTiming:
+    """Per-call device time of ``fn(*args)`` from a profiler trace.
+
+    ``fn`` should be jitted (or jit-compatible: it will be dispatched as-is);
+    its result is forced via a scalar fetch — the only completion signal the
+    tunnel respects. On non-TPU backends falls back to wall-clock around the
+    forced calls (source="wallclock").
+    """
+    import jax
+
+    def force(r):
+        leaf = jax.tree.leaves(r)[0]
+        float(leaf.reshape(-1)[0])
+
+    for _ in range(max(warmup, 1)):
+        force(fn(*args))
+
+    if jax.devices()[0].platform != "tpu":
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(calls):
+            r = fn(*args)
+        force(r)
+        dt = time.perf_counter() - t0
+        return DeviceTiming(per_call_s=dt / calls, calls=calls,
+                            source="wallclock")
+
+    own_dir = trace_dir is None
+    tdir = trace_dir or tempfile.mkdtemp(prefix="devtime_")
+    try:
+        with jax.profiler.trace(tdir):
+            r = None
+            for _ in range(calls):
+                r = fn(*args)
+            force(r)
+        paths = sorted(glob.glob(os.path.join(
+            tdir, "plugins", "profile", "*", "*.trace.json.gz")))
+        if not paths:
+            raise RuntimeError(f"profiler produced no trace under {tdir}")
+        with gzip.open(paths[-1]) as fh:
+            programs = parse_device_spans(json.load(fh))
+    finally:
+        if own_dir:
+            import shutil
+            shutil.rmtree(tdir, ignore_errors=True)
+    n, total = _top_level_total(programs)
+    if n == 0:
+        raise RuntimeError(
+            "no jit program spans on the device timeline; was fn jitted?")
+    # n is the span count of the most-frequent program == dispatched calls
+    # (warmup happened outside the window)
+    return DeviceTiming(per_call_s=total / n, calls=n, programs=programs)

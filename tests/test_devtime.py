@@ -1,0 +1,67 @@
+"""Tests for utils/devtime — the device-true timing instrument.
+
+The TPU path (profiler trace → device spans) can't run on the CPU test
+mesh, so the parser is exercised on a canned Chrome-trace dict shaped like
+a real capture (process_name metadata + nested device spans), and the
+public entry is exercised through its wall-clock fallback.
+"""
+
+import jax.numpy as jnp
+
+from distributed_ml_pytorch_tpu.utils.devtime import (
+    DeviceTiming,
+    _top_level_total,
+    device_time,
+    parse_device_spans,
+)
+
+
+def _canned_trace():
+    return {
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 3,
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "M", "name": "process_name", "pid": 9,
+             "args": {"name": "/host:CPU"}},
+            # two calls of one program, 2.5 ms each (dur is microseconds)
+            {"ph": "X", "pid": 3, "name": "jit_step(123)", "dur": 2500},
+            {"ph": "X", "pid": 3, "name": "jit_step(123)", "dur": 2500},
+            # nested fusion spans — counted under their own names only
+            {"ph": "X", "pid": 3, "name": "fusion.1", "dur": 2000},
+            {"ph": "X", "pid": 3, "name": "copy.2", "dur": 100},
+            # host spans must be ignored even with a 'dur'
+            {"ph": "X", "pid": 9, "name": "jit_step(123)", "dur": 99999},
+        ]
+    }
+
+
+def test_parse_device_spans_filters_host_and_groups_by_name():
+    spans = parse_device_spans(_canned_trace())
+    assert spans["jit_step(123)"] == (2, 0.005)
+    assert spans["fusion.1"] == (1, 0.002)
+    assert "copy.2" in spans
+    # the host's 99999 span did not leak into the device total
+    n, total = _top_level_total(spans)
+    assert n == 2
+    assert abs(total - 0.005) < 1e-12
+
+
+def test_top_level_total_sums_distinct_programs():
+    spans = {
+        "jit_fwd(1)": (4, 0.004),
+        "jit_bwd(2)": (4, 0.012),
+        "fusion.3": (4, 0.003),  # nested — excluded
+    }
+    n, total = _top_level_total(spans)
+    assert n == 4
+    assert abs(total - 0.016) < 1e-12
+
+
+def test_device_time_wallclock_fallback_off_tpu():
+    # on the CPU test mesh the fallback path must produce a sane timing
+    f = lambda x: x * 2.0
+    t = device_time(f, jnp.ones((4,)), calls=3, warmup=1)
+    assert isinstance(t, DeviceTiming)
+    assert t.source == "wallclock"
+    assert t.per_call_s > 0
+    assert t.calls == 3

@@ -123,9 +123,12 @@ def test_blockwise_attention_offsets_shift_causal_mask():
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_flash_attention_gradients_match_reference(causal):
-    """The custom_vjp backward kernels (dQ and dK/dV) must agree with
-    autodiff through the dense reference."""
+@pytest.mark.parametrize("bwd_impl", ["fused", "split"])
+def test_flash_attention_gradients_match_reference(causal, bwd_impl):
+    """Both backward implementations (the one-recompute fused kernel and the
+    two-kernel split) must agree with autodiff through the dense reference —
+    including with backward blocking different from the forward's (the
+    production default) so the dq-partials layout is exercised."""
     rng = np.random.default_rng(5)
     b, h, s, d = 2, 2, 256, 64
     q, k, v = (jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
@@ -133,7 +136,8 @@ def test_flash_attention_gradients_match_reference(causal):
 
     def f(q, k, v):
         return flash_attention(q, k, v, causal=causal, block_q=128,
-                               block_k=128).sum()
+                               block_k=128, block_q_bwd=256, block_k_bwd=128,
+                               bwd_impl=bwd_impl).sum()
 
     def r(q, k, v):
         return attention_reference(q, k, v, causal=causal).sum()
@@ -145,10 +149,33 @@ def test_flash_attention_gradients_match_reference(causal):
                                    rtol=2e-2, atol=2e-2)
 
 
+def test_flash_bwd_impl_auto_selects_split_at_extreme_length(monkeypatch):
+    """Beyond FUSED_BWD_PARTIALS_CAP the lean split backward must be chosen
+    so extreme-length gradients stay compilable (code-review r3 finding)."""
+    from distributed_ml_pytorch_tpu.ops import attention as A
+
+    chosen = []
+    real = A._flash
+
+    def spy(causal, blocks, bwd_blocks, interpret, bwd_impl, q, k, v):
+        chosen.append(bwd_impl)
+        return real(causal, blocks, bwd_blocks, interpret, bwd_impl, q, k, v)
+
+    monkeypatch.setattr(A, "_flash", spy)
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 1, 256, 64)), jnp.float32)
+               for _ in range(3))
+    A.flash_attention(q, k, v, causal=True)
+    assert chosen[-1] == "fused"
+    monkeypatch.setattr(A, "FUSED_BWD_PARTIALS_CAP", 1)  # force the cap
+    A.flash_attention(q, k, v, causal=True)
+    assert chosen[-1] == "split"
+
+
 def test_flash_block_choice_prefers_large_and_falls_back():
     from distributed_ml_pytorch_tpu.ops.attention import flash_block_choice
 
-    assert flash_block_choice(2048, 2048) == (1024, 512)
+    assert flash_block_choice(2048, 2048) == (1024, 1024)
     assert flash_block_choice(512, 256) == (512, 256)
     assert flash_block_choice(384, 384) == (128, 128)
     assert flash_block_choice(200, 512) is None  # no divisor → scan path
