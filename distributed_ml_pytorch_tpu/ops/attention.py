@@ -512,15 +512,25 @@ def _flash_fwd_rule(causal, blocks, bwd_blocks, interpret, bwd_impl, q, k, v):
 
 def _flash_bwd_rule(causal, blocks, bwd_blocks, interpret, bwd_impl, res, do):
     q, k, v, out, lse = res
+    # delta_i = Σ_d do·o — one cheap fused XLA pass, shared by the kernels
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    return _flash_bwd_core(causal, bwd_blocks, interpret, bwd_impl,
+                           q, k, v, lse, do, delta)
+
+
+def _flash_bwd_core(causal, bwd_blocks, interpret, bwd_impl,
+                    q, k, v, lse, do, delta):
+    """Shared backward: ``delta`` is the natural-space per-row correction —
+    rowsum(do·o) for the plain vjp, rowsum(do·o) − dlse when the logsumexp
+    output also carries a cotangent (``ds = p·(dp − rowsum(do·o) + dlse)``,
+    so the lse term folds into delta with no kernel changes)."""
     # backward blocking is swept independently of the forward's: on the v5e
     # the fused backward at (1024, 1024) runs ~19% faster than at the
     # fwd-shared (1024, 512) — see the module docstring's measurements
     block_q, block_k = bwd_blocks
     bh, sq, d = q.shape
     sk = k.shape[1]
-    # delta_i = Σ_d do·o — one cheap fused XLA pass, shared by the kernels
-    # (broadcast into the same 8-sublane-replicated layout as lse)
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    # broadcast into the same 8-sublane-replicated layout as lse
     delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, sq))
     if bwd_impl == "fused":
         n_k = sk // block_k
@@ -593,6 +603,36 @@ def _flash_bwd_rule(causal, blocks, bwd_blocks, interpret, bwd_impl, res, do):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _flash_lse(causal, blocks, bwd_blocks, interpret, bwd_impl, q, k, v):
+    """Like ``_flash`` but also returns the per-row NATURAL logsumexp
+    (bh, sq) — and is differentiable in BOTH outputs, which is what lets
+    ring attention combine per-chunk kernel results outside the kernel."""
+    out, lse2 = _flash_fwd(q, k, v, causal, blocks[0], blocks[1], interpret)
+    return out, lse2[:, 0, :] * (1.0 / LOG2_E)
+
+
+def _flash_lse_fwd_rule(causal, blocks, bwd_blocks, interpret, bwd_impl,
+                        q, k, v):
+    out, lse2 = _flash_fwd(q, k, v, causal, blocks[0], blocks[1], interpret)
+    return (out, lse2[:, 0, :] * (1.0 / LOG2_E)), (q, k, v, out, lse2)
+
+
+def _flash_lse_bwd_rule(causal, blocks, bwd_blocks, interpret, bwd_impl,
+                        res, cts):
+    q, k, v, out, lse2 = res
+    do, dlse = cts
+    # ds = p·(v·do − rowsum(do·o) + dlse): the lse cotangent enters as a
+    # per-row shift of delta (∂lse/∂s = p), shared by every backward kernel
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = delta - dlse.astype(jnp.float32)
+    return _flash_bwd_core(causal, bwd_blocks, interpret, bwd_impl,
+                           q, k, v, lse2, do, delta)
+
+
+_flash_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -627,25 +667,87 @@ def flash_attention(
     (beyond ~S=48k at GPT-2-small geometry), where the slower-but-lean
     split keeps long-context training compilable.
     """
+    blocks, bwd_blocks, interpret, bwd_impl = _resolve_flash_config(
+        q, k, causal, block_q, block_k, block_q_bwd, block_k_bwd,
+        interpret, bwd_impl)
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    out = _flash(causal, blocks, bwd_blocks, interpret, bwd_impl, qf, kf, vf)
+    return out.reshape(b, h, sq, d)
+
+
+def flash_attention_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    block_q_bwd: int | None = None,
+    block_k_bwd: int | None = None,
+    interpret: bool | None = None,
+    bwd_impl: str | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """:func:`flash_attention` that also returns the per-row natural
+    logsumexp ``(b, h, sq)`` f32 — differentiable in both outputs.
+
+    This is the building block for cross-chunk combines (ring attention,
+    decode-time chunked prefill): per-chunk ``(out_i, lse_i)`` pairs merge
+    exactly as ``out = Σ out_i·exp(lse_i − lse)``, ``lse = logaddexp_i`` in
+    plain XLA, and gradients flow because the lse cotangent folds into the
+    backward's delta term (see ``_flash_lse_bwd_rule``). Same blocking
+    rules and constraints as :func:`flash_attention`.
+    """
+    blocks, bwd_blocks, interpret, bwd_impl = _resolve_flash_config(
+        q, k, causal, block_q, block_k, block_q_bwd, block_k_bwd,
+        interpret, bwd_impl)
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    out, lse = _flash_lse(causal, blocks, bwd_blocks, interpret, bwd_impl,
+                          qf, kf, vf)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+def _resolve_flash_config(q, k, causal, block_q, block_k,
+                          block_q_bwd, block_k_bwd, interpret, bwd_impl):
+    """Default-resolution and validation shared by the flash entry points."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     if causal and sq != sk:
         raise ValueError(f"causal flash_attention requires sq == sk, got {sq} != {sk}")
-    defaults = flash_block_choice(sq, sk)
-    if (block_q is None or block_k is None) and defaults is None:
-        raise ValueError(
-            f"no flash blocking divides sq={sq}, sk={sk}; pad the sequence "
-            "or use auto_attention (scan fallback)"
-        )
+    # defaults derive PER SIDE so an explicit block for an odd length still
+    # composes with a derived one for the other side (e.g. block_q=320 with
+    # sq=320, sk=2048); only a side that actually needs a default can raise
+    def _default(n, name):
+        c = next((c for c in (1024, 512, 256, 128) if n % c == 0), None)
+        if c is None:
+            raise ValueError(
+                f"no flash blocking divides {name}={n}; pass an explicit "
+                "block or pad the sequence (auto_attention falls back to "
+                "the scan for such shapes)"
+            )
+        return c
+
     if block_q is None:
-        block_q = defaults[0]
+        block_q = _default(sq, "sq")
     if block_k is None:
-        block_k = defaults[1]
-    bwd_defaults = flash_bwd_block_choice(sq, sk) or (block_q, block_k)
+        block_k = _default(sk, "sk")
+    # backward defaults: largest dividing candidate per side (the fused
+    # backward prefers (1024, 1024)); an explicit forward block is the
+    # fallback for lengths no candidate divides — it divides by definition
     if block_q_bwd is None:
-        block_q_bwd = bwd_defaults[0]
+        block_q_bwd = next((c for c in (1024, 512, 256, 128) if sq % c == 0),
+                           block_q)
     if block_k_bwd is None:
-        block_k_bwd = bwd_defaults[1]
+        block_k_bwd = next((c for c in (1024, 512, 256, 128) if sk % c == 0),
+                           block_k)
     if sq % block_q or sk % block_k or sq % block_q_bwd or sk % block_k_bwd:
         raise ValueError(
             f"flash_attention needs seq multiples of block sizes, got "
@@ -658,12 +760,8 @@ def flash_attention(
         bwd_impl = "split" if partials > FUSED_BWD_PARTIALS_CAP else "fused"
     if bwd_impl not in ("fused", "split"):
         raise ValueError(f"bwd_impl must be 'fused' or 'split', got {bwd_impl!r}")
-    qf = q.reshape(b * h, sq, d)
-    kf = k.reshape(b * h, sk, d)
-    vf = v.reshape(b * h, sk, d)
-    out = _flash(causal, (block_q, block_k), (block_q_bwd, block_k_bwd),
-                 interpret, bwd_impl, qf, kf, vf)
-    return out.reshape(b, h, sq, d)
+    return ((block_q, block_k), (block_q_bwd, block_k_bwd), interpret,
+            bwd_impl)
 
 
 def auto_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -672,11 +770,12 @@ def auto_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     allow, the differentiable blockwise scan otherwise.
 
     The decision is static (shapes + backend at trace time), so under jit
-    exactly one path is compiled. The scan remains the path for: non-TPU
-    backends (interpret-mode pallas is orders slower than compiled XLA),
-    sequences not divisible by the kernel's minimum blocking, and ring
-    attention's chunk folding (which needs the (acc, m, l) carry interface,
-    not a finalized output).
+    exactly one path is compiled. The scan remains the path for non-TPU
+    backends (interpret-mode pallas is orders slower than compiled XLA) and
+    sequences not divisible by the kernel's minimum blocking; ring
+    attention makes the same choice at chunk granularity (flash via the
+    chunk-level lse combine on TPU, the (acc, m, l)-carry blockwise scan
+    elsewhere — parallel/ring.py).
     """
     sq, sk = q.shape[2], k.shape[2]
     blocks = flash_block_choice(sq, sk)

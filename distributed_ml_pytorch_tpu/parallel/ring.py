@@ -13,9 +13,13 @@ materializes the full sequence, which is precisely what makes contexts longer
 than one chip's memory trainable.
 
 Differentiable end-to-end: the ring is a ``lax.scan`` whose body is the
-blockwise online-softmax update (``ops/attention.py``) plus ``ppermute`` — all
-primitives with transpose rules, so ``jax.grad`` through a sharded training
-step works and the backward pass re-runs the ring in reverse.
+per-chunk attention plus ``ppermute`` — all primitives with transpose rules,
+so ``jax.grad`` through a sharded training step works and the backward pass
+re-runs the ring in reverse. Two per-chunk implementations share the ring:
+the Pallas flash kernel with a chunk-level logsumexp combine
+(``ring_flash_attention`` — the TPU default, so sequence parallelism runs
+the same kernel single-chip training does) and the blockwise lax.scan
+online-softmax update (non-TPU backends and unblockable chunk lengths).
 
 Causality across chunks falls out of global position offsets: device ``i``'s
 queries live at ``[i·S/p, (i+1)·S/p)``; a chunk received from device ``j``
@@ -37,6 +41,8 @@ from distributed_ml_pytorch_tpu.ops.attention import (
     NEG_INF,
     blockwise_attention,
     finalize_attention,
+    flash_attention_lse,
+    flash_block_choice,
     init_softmax_state,
 )
 
@@ -49,6 +55,99 @@ def _merge_softmax_states(m1, l1, a1, m2, l2, a2):
     return m, l1 * c1 + l2 * c2, a1 * c1 + a2 * c2
 
 
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str,
+    axis_size: int,
+    causal: bool = False,
+) -> jax.Array:
+    """Ring attention whose per-chunk compute is the Pallas flash kernel.
+
+    The flash kernel finalizes its output (no (acc, m, l) carry interface),
+    so the ring folds CHUNK-level results instead of block-level ones:
+    each step runs :func:`flash_attention_lse` on the currently-held K/V
+    chunk — yielding the chunk output and its per-row natural logsumexp —
+    and merges them in plain XLA by logsumexp renormalization
+    (``o ← o·e^{lse−lse'} + o_i·e^{lse_i−lse'}``). Gradients flow because
+    the lse output is differentiable (its cotangent folds into the kernel
+    backward's delta term).
+
+    Causality needs no new kernel mask mode: ring chunks are equal-sized
+    and offset-aligned, so a held chunk is (relative to the local queries)
+    either wholly past (plain attention), the diagonal chunk (standard
+    causal), or wholly future (skipped: lse = −∞). The three cases select
+    by the traced ring position via ``lax.cond``, so each step still pays
+    exactly one kernel invocation.
+
+    Measured (v5e, device-true, fwd+bwd): the p=4 per-device work at
+    b4·h3·chunk2048·d64 bf16 runs 2.50 ms against 15.25 ms for the
+    blockwise-scan ring body — 6.1×; the chunk-level combine and the lse
+    output add nothing measurable (kernel with/without lse: 1.85/1.85 ms).
+
+    Call **inside** ``shard_map``, like :func:`ring_attention`.
+    """
+    p = int(axis_size)
+    idx = jax.lax.axis_index(axis)
+
+    perm = [(j, (j + 1) % p) for j in range(p)]
+
+    def lse_floor(_):
+        o = jnp.zeros(q.shape, jnp.float32)
+        lse = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
+        return o, lse
+
+    def chunk(step, k_cur, v_cur):
+        src = (idx - step) % p  # whose chunk we hold at this ring step
+        if not causal:
+            o, lse = flash_attention_lse(q, k_cur, v_cur, causal=False)
+            return o.astype(jnp.float32), lse
+
+        def diag(_):
+            o, lse = flash_attention_lse(q, k_cur, v_cur, causal=True)
+            return o.astype(jnp.float32), lse
+
+        def past(_):
+            o, lse = flash_attention_lse(q, k_cur, v_cur, causal=False)
+            return o.astype(jnp.float32), lse
+
+        def future_or_past(_):
+            return jax.lax.cond(src < idx, past, lse_floor, None)
+
+        return jax.lax.cond(src == idx, diag, future_or_past, None)
+
+    def merge(o, lse, o_i, lse_i):
+        lse_new = jnp.logaddexp(lse, lse_i)
+        # exponents are ≤ 0 by construction; fully-masked rows give
+        # exp(NEG_INF − finite) → exactly 0 (and NEG_INF − NEG_INF → e⁰
+        # weights only ever scale all-zero outputs)
+        w = jnp.exp(lse - lse_new)[..., None]
+        w_i = jnp.exp(lse_i - lse_new)[..., None]
+        return o * w + o_i * w_i, lse_new
+
+    o, lse = lse_floor(None)
+
+    def body(carry, step):
+        o, lse, k_cur, v_cur = carry
+        k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+        o_i, lse_i = chunk(step, k_cur, v_cur)
+        o, lse = merge(o, lse, o_i, lse_i)
+        return (o, lse, k_nxt, v_nxt), None
+
+    if p > 1:
+        (o, lse, k_last, v_last), _ = jax.lax.scan(
+            body, (o, lse, k, v), jnp.arange(p - 1)
+        )
+    else:
+        k_last, v_last = k, v
+    o_i, lse_i = chunk(p - 1, k_last, v_last)
+    o, _lse = merge(o, lse, o_i, lse_i)
+    return o.astype(q.dtype)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -58,13 +157,33 @@ def ring_attention(
     axis_size: int,
     causal: bool = False,
     block_k: int = 512,
+    impl: str | None = None,
 ) -> jax.Array:
     """Attention over a sequence sharded on mesh axis ``axis``.
 
     Call **inside** ``shard_map``: ``q``/``k``/``v`` are the local
     (batch, heads, S/p, dim) chunks; returns the local output chunk.
     ``axis_size`` is the static ring length (``mesh.shape[axis]``).
+
+    ``impl``: "flash" folds chunks through the Pallas kernel
+    (:func:`ring_flash_attention`), "blockwise" through the lax.scan
+    online-softmax update; the default ``None`` picks flash on TPU when
+    the local chunk fits the kernel's blocking — the same static
+    per-backend choice ``auto_attention`` makes. ``block_k`` tunes the
+    BLOCKWISE impl's key blocking only; the flash kernel carries its own
+    swept blocking, so when the flash impl is selected (including by the
+    TPU default) ``block_k`` is ignored — pass ``impl="blockwise"`` to
+    keep a tuned scan configuration.
     """
+    if impl is None:
+        blockable = flash_block_choice(q.shape[2], k.shape[2]) is not None
+        impl = ("flash" if jax.default_backend() == "tpu" and blockable
+                else "blockwise")
+    if impl == "flash":
+        return ring_flash_attention(
+            q, k, v, axis=axis, axis_size=axis_size, causal=causal)
+    if impl != "blockwise":
+        raise ValueError(f"impl must be 'flash', 'blockwise' or None, got {impl!r}")
     p = int(axis_size)
     idx = jax.lax.axis_index(axis)
     s_local = q.shape[2]
@@ -109,22 +228,29 @@ def ring_attention(
 
 
 def make_ring_attention(
-    mesh: Mesh, axis: str = "seq", *, causal: bool = False, block_k: int = 512
+    mesh: Mesh, axis: str = "seq", *, causal: bool = False,
+    block_k: int = 512, impl: str | None = None
 ) -> Callable:
     """Jitted full-sequence attention with the seq axis sharded over ``mesh``.
 
     Takes/returns global (batch, heads, seq, dim) arrays sharded
     ``P(None, None, axis, None)``; seq must divide by ``mesh.shape[axis]``.
+    ``impl`` as in :func:`ring_attention`.
     """
     axis_size = int(mesh.shape[axis])
     spec = P(None, None, axis, None)
     local = partial(
-        ring_attention, axis=axis, axis_size=axis_size, causal=causal, block_k=block_k
+        ring_attention, axis=axis, axis_size=axis_size, causal=causal,
+        block_k=block_k, impl=impl
     )
+    # check_vma=False: the flash path's pallas_call out_shapes are opaque
+    # to the varying-manual-axes checker (same constraint as
+    # ops/attention.make_sharded_attn_fn); specs are fully mapped either way
     sharded = jax.shard_map(
         lambda q, k, v: local(q, k, v),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        check_vma=False,
     )
     return jax.jit(sharded)

@@ -58,7 +58,10 @@ def ulysses_attention(q, k, v, axis: str, axis_size: int):
        concatenate in mesh-axis order, which is global sequence order
        (``shard_lm_batch`` shards the sequence contiguously), so causal
        masking over the gathered axis is exact;
-    2. run the ordinary blockwise causal kernel (``ops/attention.py``) —
+    2. run ordinary full-sequence causal attention (``default_attn_fn`` →
+       ``auto_attention``): on TPU that is the Pallas flash kernel —
+       measured on the local body (b4, h12/4, S8192, d64 bf16, fwd+bwd,
+       device-true): 6.09 ms vs 78.55 ms for the blockwise scan, 12.9× —
        attention is embarrassingly parallel over heads;
     3. the inverse ``all_to_all`` (split sequence, concatenate heads)
        restores ``(b, h, S/p, hd)`` for the position-local residual/MLP.
