@@ -520,8 +520,13 @@ def ps_phase() -> None:
 
 def _steady_rate_from_csv(path: str, batch: int):
     """Steady-state img/s from a trainer CSV's per-iteration timestamps:
-    median inter-step gap over the second half of the run (warmup/compile
-    excluded by construction). Returns (img_per_sec, n_steps) or None."""
+    MEAN inter-step gap over the second half of the run (warmup/compile
+    excluded by construction). Mean, not median: chunk-dispatched workers
+    log a burst of per-step records at each chunk boundary, so the gap
+    distribution is bimodal (≈0 within a burst, chunk-time at boundaries)
+    and a median would see only the zeros; the tail mean is exactly
+    (t_end − t_mid)/steps either way. Returns (img_per_sec, n_steps) or
+    None."""
     import pandas as pd
 
     if not os.path.isfile(path):
@@ -531,7 +536,7 @@ def _steady_rate_from_csv(path: str, batch: int):
         return None
     gaps = pd.to_datetime(df["timestamp"]).diff().dt.total_seconds().iloc[1:]
     tail = gaps.iloc[len(gaps) // 2:]
-    per_step = float(tail.median())
+    per_step = float(tail.mean())
     if per_step <= 0:
         return None
     return batch / per_step, len(df)
@@ -558,7 +563,7 @@ def ps_tpu_phase() -> None:
     data_args = [
         "--batch-size", str(batch),  # rate math below derives from this
         "--epochs", "2", "--synthetic-data",
-        "--synthetic-train-size", "2048", "--synthetic-test-size", "64",
+        "--synthetic-train-size", "16384", "--synthetic-test-size", "64",
         "--log-interval", "100000",
     ]
     ps_rate = single_rate = None
@@ -573,11 +578,13 @@ def ps_tpu_phase() -> None:
                 emit(3, "async_ps_tpu_worker_throughput", ps_rate,
                      "images/sec/chip", "cpu server + 1x tpu worker",
                      f"steady-state from {n} per-step CSV timestamps; "
-                     "DownPour push/pull cadence 10/10, per-step dispatch")
+                     "DownPour cadence 10/10 with chunked dispatch (one "
+                     "compiled scan per between-comm run, VERDICT r2 #2)")
     with tempfile.TemporaryDirectory() as td:
         code = subprocess.run(
             [sys.executable, "-m", "distributed_ml_pytorch_tpu.training.cli",
-             "--no-distributed", "--log-dir", td] + data_args,
+             "--no-distributed", "--steps-per-dispatch", "10",
+             "--log-dir", td] + data_args,
             env=dict(os.environ),
         ).returncode
         if code != 0:
@@ -586,16 +593,83 @@ def ps_tpu_phase() -> None:
             got = _steady_rate_from_csv(os.path.join(td, "tpu.csv"), batch)
             if got:
                 single_rate, n = got
-                emit(3, "single_mode_per_step_throughput", single_rate,
+                emit(3, "single_mode_scanned_throughput", single_rate,
                      "images/sec/chip", "1x tpu",
-                     f"same recipe/dispatch discipline as the PS leg "
-                     f"({n} per-step timestamps) — the PS delta is pure "
-                     "push/pull overhead")
+                     f"same recipe at --steps-per-dispatch 10 (the chunk "
+                     f"size the PS cadence implies), {n} per-step records "
+                     "— the PS delta is protocol cost, not dispatch")
     if ps_rate and single_rate:
         emit(3, "async_ps_push_pull_overhead", 100 * (1 - ps_rate / single_rate),
              "percent", "derived",
              "throughput cost of the PS protocol for a TPU worker vs the "
-             "identical single-mode recipe")
+             "same-chunk-size scanned single-mode recipe; on THIS rig both "
+             "legs are bounded by the tunnel's ~0.4-1s per device->host "
+             "fetch (one 9.9 MB accum fetch per push cadence), not by "
+             "DownPour itself — see async_ps_chunked_device_cycle")
+    _ps_device_cycle_phase(batch)
+
+
+def _ps_device_cycle_phase(batch: int) -> None:
+    """The DownPour worker's device-side ceiling: one cadence cycle of
+    chunked dispatches (lengths 1+9 at cadence 10/10) with NO host fetch —
+    what the chunk-dispatch rework actually bought, measured without the
+    tunnel's per-fetch cost (a TPU-VM pays ~2 ms for the 9.9 MB push fetch
+    this rig pays ~1 s for)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ml_pytorch_tpu.models import get_model
+    from distributed_ml_pytorch_tpu.parallel.async_ps import (
+        init_downpour_accumulator,
+        make_downpour_chunk_step,
+    )
+
+    model = get_model("alexnet")
+    params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))["params"]
+    _, n, pad, accum = init_downpour_accumulator(params)
+    chunk_step = make_downpour_chunk_step(model, 0.008, pad)
+    rng = jax.random.key(1)
+    rnd = np.random.default_rng(0)
+
+    def mkbatch(length):
+        return (
+            np.asarray(rnd.normal(size=(length, batch, 32, 32, 3)), np.float32),
+            np.asarray(rnd.integers(0, 10, (length, batch))),
+        )
+
+    bxs1, bys1 = mkbatch(1)
+    bxs9, bys9 = mkbatch(9)
+    dx1, dy1 = jax.device_put(bxs1), jax.device_put(bys1)
+    dx9, dy9 = jax.device_put(bxs9), jax.device_put(bys9)
+    losses = None
+    for _ in range(2):  # compile both scan lengths + warm
+        params, accum, losses = chunk_step(params, accum, dx1, dy1, rng, 0)
+        params, accum, losses = chunk_step(params, accum, dx9, dy9, rng, 1)
+    float(losses[-1])
+
+    def cycle_rate(x1, y1, x9, y9, reps=10):
+        nonlocal params, accum, losses
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            params, accum, losses = chunk_step(params, accum, x1, y1, rng, 0)
+            params, accum, losses = chunk_step(params, accum, x9, y9, rng, 1)
+        float(losses[-1])  # trailing fetch forces the chain
+        return (time.perf_counter() - t0) / reps
+
+    per_cycle = cycle_rate(dx1, dy1, dx9, dy9)
+    with_xfer = cycle_rate(bxs1, bys1, bxs9, bys9)
+    emit(3, "async_ps_chunked_device_cycle", 10 * batch / per_cycle,
+         "images/sec/chip",
+         "1x tpu, device-resident input",
+         f"one 10-step DownPour cadence cycle as two compiled chunk "
+         f"dispatches, forced completion ({per_cycle * 1e3:.1f} ms/cycle); "
+         f"with per-cycle host batch upload it is "
+         f"{10 * batch / with_xfer:.0f} img/s ({with_xfer * 1e3:.0f} ms) — "
+         "this rig's tunnel moves host<->device data at ~15-50 MB/s, so "
+         "the end-to-end PS row is transport-bound, not protocol-bound; "
+         "round 2's per-step dispatch managed 669 img/s on the same rig")
 
 
 def transport_phase() -> None:

@@ -15,8 +15,9 @@ TPU-native decode structure:
   per-token Python dispatch, no growing shapes (the cache is statically
   sized to ``prompt + max_new_tokens``).
 - Sampling is temperature-controlled categorical (temperature 0 → greedy
-  argmax), per-step rng folded from one key, fully deterministic given
-  ``(params, prompt, rng)``.
+  argmax) with optional top-k and/or nucleus (top-p) truncation
+  (:func:`sample_tokens`), per-step rng folded from one key, fully
+  deterministic given ``(params, prompt, rng)``.
 """
 
 from __future__ import annotations
@@ -26,6 +27,52 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+
+def sample_tokens(
+    logits: jnp.ndarray,
+    rng: jax.Array,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jnp.ndarray:
+    """One sampling decision over ``[B, vocab]`` logits.
+
+    ``temperature=0`` is greedy argmax (k/p ignored — argmax is already the
+    1-token nucleus). Otherwise: optional top-k truncation (keep the k
+    highest logits), then optional nucleus truncation (keep the smallest
+    prefix of the sorted distribution whose probability mass reaches
+    ``top_p``; the top token always survives), then categorical sampling at
+    the given temperature. All static-shape ops (sort + masks), so the
+    whole thing lives inside the scanned decode program. Tokens whose
+    logit exactly ties the nucleus cut-off logit are kept (the mask maps
+    back through a threshold compare), matching the usual top-p contract.
+    """
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    vocab = logits.shape[-1]
+    neg = jnp.asarray(jnp.finfo(logits.dtype).min, logits.dtype)
+    top_k = min(int(top_k), vocab) if top_k else 0
+    if top_k > 0 or top_p < 1.0:
+        # ONE descending sort serves both filters: the k-th entry is the
+        # top-k threshold, and masking the sorted tail past k-1 gives the
+        # nucleus pass the post-top-k distribution without re-sorting
+        sort_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        if top_k > 0:
+            kth = sort_desc[..., top_k - 1][..., None]
+            logits = jnp.where(logits < kth, neg, logits)
+            sort_desc = jnp.where(jnp.arange(vocab) >= top_k, neg, sort_desc)
+        if top_p < 1.0:
+            probs = jax.nn.softmax(sort_desc, axis=-1)
+            # exclusive cumulative mass: a token is cut iff the mass BEFORE
+            # it already reaches top_p — the argmax token can never be cut
+            exceeded = (jnp.cumsum(probs, axis=-1) - probs) >= top_p
+            exceeded = exceeded.at[..., 0].set(False)  # even at top_p = 0
+            cut = jnp.where(exceeded, jnp.inf, sort_desc)
+            thresh = jnp.min(cut, axis=-1, keepdims=True)
+            logits = jnp.where(logits < thresh, neg, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
 
 
 def _decode_model(model, cache_size: int):
@@ -68,13 +115,17 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jnp.ndarray:
     """Sample ``max_new_tokens`` continuations of ``prompt`` ([B, P] int32).
 
     Returns ``[B, P + max_new_tokens]`` tokens. ``temperature=0`` is greedy;
     otherwise categorical sampling at the given temperature (``rng``
-    required). Jit-compiled end-to-end: one prefill program + one scanned
-    generation program, both cached across calls with the same shapes.
+    required) with optional ``top_k`` / nucleus ``top_p`` truncation
+    (:func:`sample_tokens`). Jit-compiled end-to-end: one prefill program +
+    one scanned generation program, both cached across calls with the same
+    shapes.
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature > 0 sampling needs an rng key")
@@ -87,7 +138,8 @@ def generate(
     cache = init_cache(model, b, total)
     dec = _decode_model(model, total)
     return _generate_jit(
-        dec, int(max_new_tokens), float(temperature), params, cache, prompt, rng
+        dec, int(max_new_tokens), float(temperature), int(top_k), float(top_p),
+        params, cache, prompt, rng
     )
 
 
@@ -101,6 +153,8 @@ def generate_tp(
     rng: Optional[jax.Array] = None,
     data_axis: str = "data",
     model_axis: str = "model",
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jnp.ndarray:
     """Tensor-parallel decode: ``generate`` semantics on a dp×tp mesh.
 
@@ -150,12 +204,14 @@ def generate_tp(
     prompt = jax.device_put(prompt, NamedSharding(mesh, P(data_axis, None)))
     dec = _decode_model(model, total)
     return _generate_jit(
-        dec, int(max_new_tokens), float(temperature), params, cache, prompt, rng
+        dec, int(max_new_tokens), float(temperature), int(top_k), float(top_p),
+        params, cache, prompt, rng
     )
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
-def _generate_jit(dec, max_new_tokens, temperature, params, cache, prompt, rng):
+@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def _generate_jit(dec, max_new_tokens, temperature, top_k, top_p,
+                  params, cache, prompt, rng):
     b, p = prompt.shape
 
     # prefill: whole prompt in one pass; next token comes from the last logit
@@ -166,11 +222,9 @@ def _generate_jit(dec, max_new_tokens, temperature, params, cache, prompt, rng):
     cache = mutated["cache"]
 
     def sample(logits, step_rng):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-        return jax.random.categorical(step_rng, logits / temperature, axis=-1).astype(
-            prompt.dtype
-        )
+        return sample_tokens(
+            logits, step_rng, temperature=temperature, top_k=top_k, top_p=top_p
+        ).astype(prompt.dtype)
 
     first = sample(logits[:, -1], jax.random.fold_in(rng, 0))
 

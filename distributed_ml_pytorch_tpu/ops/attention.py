@@ -726,7 +726,7 @@ def _resolve_flash_config(q, k, causal, block_q, block_k,
     # composes with a derived one for the other side (e.g. block_q=320 with
     # sq=320, sk=2048); only a side that actually needs a default can raise
     def _default(n, name):
-        c = next((c for c in (1024, 512, 256, 128) if n % c == 0), None)
+        c = _side_block_choice(n)
         if c is None:
             raise ValueError(
                 f"no flash blocking divides {name}={n}; pass an explicit "
@@ -743,11 +743,9 @@ def _resolve_flash_config(q, k, causal, block_q, block_k,
     # backward prefers (1024, 1024)); an explicit forward block is the
     # fallback for lengths no candidate divides — it divides by definition
     if block_q_bwd is None:
-        block_q_bwd = next((c for c in (1024, 512, 256, 128) if sq % c == 0),
-                           block_q)
+        block_q_bwd = _side_block_choice(sq) or block_q
     if block_k_bwd is None:
-        block_k_bwd = next((c for c in (1024, 512, 256, 128) if sk % c == 0),
-                           block_k)
+        block_k_bwd = _side_block_choice(sk) or block_k
     if sq % block_q or sk % block_k or sq % block_q_bwd or sk % block_k_bwd:
         raise ValueError(
             f"flash_attention needs seq multiples of block sizes, got "
@@ -858,12 +856,19 @@ def gspmd_safe_lm(model, mesh, batch_axes=("data",), head_axis=None):
     return model
 
 
+def _side_block_choice(n: int):
+    """Largest v5e-swept block size dividing one sequence side, or None.
+    THE single candidate list — every default-blocking path (forward,
+    backward, per-side fallback in _resolve_flash_config) derives from it,
+    so a future re-sweep edits exactly one tuple."""
+    return next((c for c in (1024, 512, 256, 128) if n % c == 0), None)
+
+
 def flash_block_choice(sq: int, sk: int):
     """Largest measured-good forward (block_q, block_k) dividing the sequence
     lengths, or None when no legal blocking exists (→ scan fallback).
     Preference order reflects the v5e sweep in the module docstring."""
-    bq = next((c for c in (1024, 512, 256, 128) if sq % c == 0), None)
-    bk = next((c for c in (1024, 512, 256, 128) if sk % c == 0), None)
+    bq, bk = _side_block_choice(sq), _side_block_choice(sk)
     return None if bq is None or bk is None else (bq, bk)
 
 
@@ -871,7 +876,6 @@ def flash_bwd_block_choice(sq: int, sk: int):
     """Backward blocking: the fused backward's v5e sweep prefers square
     (1024, 1024) — larger key blocks amortize the per-(i, j) dq-partial
     write, and the kernel has no (block_q, block_k) score transpose asymmetry
-    the forward has. Falls to smaller divisors like the forward choice."""
-    bq = next((c for c in (1024, 512, 256, 128) if sq % c == 0), None)
-    bk = next((c for c in (1024, 512, 256, 128) if sk % c == 0), None)
-    return None if bq is None or bk is None else (bq, bk)
+    the forward has. Currently the same per-side preference as the forward
+    (one candidate list, _side_block_choice)."""
+    return flash_block_choice(sq, sk)

@@ -298,6 +298,86 @@ def make_downpour_device_step(lr: float, pad: int):
     return _device_step
 
 
+def downpour_chunk_schedule(
+    n_push: int, n_pull: int, start: int, stop: int, max_chunk: int = 64
+):
+    """Static dispatch schedule for steps ``[start, stop)``: the runs of
+    purely-local SGD between host-communication gaps.
+
+    A comm gap sits between steps ``t−1`` and ``t`` when a pull opens step
+    ``t`` (``t % n_pull == 0``) or a push closed step ``t−1``
+    (``(t−1) % n_push == 0`` — note the +1 offset: a push fires AFTER its
+    step, so gcd(n_push, n_pull)-sized uniform chunks would misplace push
+    payloads). Every step inside a run is pure local SGD, so the whole run
+    compiles into one ``lax.scan`` dispatch with identical semantics.
+
+    Returns ``[(gap, length), …]`` with global gap indices and lengths
+    summing to ``stop − start``; lengths are capped at ``max_chunk`` (bounds
+    host-side batch stacking; an extra cut is a no-op boundary). Distinct
+    lengths are few (≤ 4 for any cadence pair), so each scan compiles once.
+    """
+    gaps = {start, stop}
+    gaps |= {t for t in range(start, stop) if t % n_pull == 0}
+    gaps |= {t + 1 for t in range(start, stop) if t % n_push == 0}
+    cuts = sorted(g for g in gaps if start <= g <= stop)
+    out = []
+    for a, b in zip(cuts, cuts[1:]):
+        while b - a > max_chunk:
+            out.append((a, max_chunk))
+            a += max_chunk
+        if b > a:
+            out.append((a, b - a))
+    return out
+
+
+def make_downpour_chunk_step(model, lr: float, pad: int):
+    """Fused multi-step DownPour dispatch (VERDICT r2 #2): one compiled
+    ``lax.scan`` runs a whole between-comm run of local SGD — per micro-step
+    the loss/grad, the lr-pre-scaled flat accumulation (Pallas flat-axpy on
+    TPU) and the local update (``Asynchronous.py:55,63-68`` semantics,
+    identical to :func:`make_downpour_device_step` iterated) — so a TPU
+    worker pays one host dispatch per comm boundary instead of per batch
+    (the per-step dispatch was ~1600× off the chip's scanned throughput).
+    Emits per-step losses so the reference's per-iteration CSV telemetry
+    survives chunking. ``params`` and ``accum`` buffers are donated.
+    """
+    from functools import partial
+
+    from distributed_ml_pytorch_tpu.training.trainer import cross_entropy_loss
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def chunk_step(params, accum, bxs, bys, rng, idx0):
+        from distributed_ml_pytorch_tpu.ops import downpour_accumulate
+
+        def body(carry, xs):
+            params, accum, idx = carry
+            bx, by = xs
+
+            def loss_fn(q):
+                logits = model.apply(
+                    {"params": q}, bx, train=True,
+                    rngs={"dropout": jax.random.fold_in(rng, idx)},
+                )
+                return cross_entropy_loss(logits, by)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            flat_grads = ravel_model_params(params, grads=grads)
+            if pad:
+                flat_grads = jnp.concatenate(
+                    [flat_grads, jnp.zeros(pad, flat_grads.dtype)]
+                )
+            accum = downpour_accumulate(accum, flat_grads, lr)
+            params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+            return (params, accum, idx + 1), loss
+
+        (params, accum, _), losses = jax.lax.scan(
+            body, (params, accum, idx0), (bxs, bys)
+        )
+        return params, accum, losses
+
+    return chunk_step
+
+
 class Listener(MessageListener):
     """C2 parity (``Asynchronous.py:9-18``): receives ParameterUpdate pushes.
 
@@ -428,6 +508,26 @@ class Asynchronous:
             file=sys.stderr,
         )
 
+    def boundary(self, gap: int) -> Optional[np.ndarray]:
+        """Host-side communication for inter-step gap ``gap`` (the point
+        between step ``gap − 1`` and step ``gap``) — the chunked dispatch
+        path's counterpart of :meth:`step`'s per-step bookkeeping, in the
+        same order: the push owed by step ``gap − 1`` (it ended that
+        iteration), then the freshest server install + the pull owed by
+        step ``gap`` (they open this one). Returns the installed flat
+        vector (caller unravels at the chunk boundary) or None.
+        """
+        if gap >= 1 and (gap - 1) % self.n_push == 0:
+            self._send(
+                MessageCode.GradientUpdate, np.asarray(self.accum[: self._flat_n])
+            )
+            self.accum = jnp.zeros_like(self.accum)
+        latest = self.listener.take_latest()
+        if gap % self.n_pull == 0:
+            self._send(MessageCode.ParameterRequest, np.zeros(0, np.float32))
+        self.idx = gap
+        return latest
+
     def step(self, params: Pytree, grads: Pytree) -> Pytree:
         # install the freshest server push at the step boundary (race-free
         # version of the reference's mid-step unravel, Asynchronous.py:17-18)
@@ -517,29 +617,105 @@ def train_worker(
 
     eval_step = make_eval_fn(model)
     logger = MetricsLogger(getattr(args, "log_dir", "log"))
+
+    # chunked dispatch (VERDICT r2 #2): on TPU the per-batch dispatch over
+    # the tunnel — not the DownPour protocol — dominated the PS worker
+    # (669 img/s vs ~1M scanned); between comm gaps every step is purely
+    # local SGD, so those runs compile into one scan with exact cadence
+    # semantics (downpour_chunk_schedule). Opt-out/in via --chunked-dispatch.
+    chunked = getattr(args, "chunked_dispatch", "auto")
+    chunked = (jax.default_backend() == "tpu") if chunked == "auto" else (
+        chunked in ("on", True))
+    chunked = chunked and hasattr(opt, "boundary")
+
+    steps_per_epoch = len(x_train) // args.batch_size
     # each worker shuffles with its own seed — the reference's per-worker
     # DataLoader(shuffle=True) gives independent streams (example/main.py:27)
     for epoch in range(args.epochs):
         print("Training for epoch {}".format(epoch))
-        for i, (bx, by) in enumerate(
-            iterate_batches(
-                x_train, y_train, args.batch_size, seed=seed + 1000 * transport.rank, epoch=epoch
-            )
-        ):
-            loss, grads = grad_fn(params, bx, by, dropout_rng, opt.idx)
-            params = opt.step(params, grads)
-            rec_extra = {}
-            if i % args.log_interval == 0 and i > 0:
-                test_loss, test_acc = evaluate(
-                    eval_step, params, x_test, y_test, args.test_batch_size
+        batches = iterate_batches(
+            x_train, y_train, args.batch_size, seed=seed + 1000 * transport.rank, epoch=epoch
+        )
+        if chunked:
+            chunk_step = _chunk_step_cache(opt, model)
+            start = epoch * steps_per_epoch
+            # telemetry is flushed in batches: a per-chunk device→host loss
+            # fetch would re-add one tunnel/PCIe round trip per dispatch —
+            # the very cost chunking exists to amortize. Losses stay on
+            # device until an eval, a flush quota, or epoch end forces them.
+            pending = []  # (rel_start, device losses, eval step set, ev)
+
+            def flush():
+                for rel0, dev_losses, eval_is, ev in pending:
+                    for off, loss in enumerate(np.asarray(dev_losses)):
+                        i = rel0 + off
+                        rec_extra = (
+                            {"test_loss": ev[0], "test_accuracy": ev[1]}
+                            if ev is not None and i in eval_is else {}
+                        )
+                        rec = logger.log_step(i, float(loss), **rec_extra)
+                        if rec_extra:
+                            print_eval_line(rec)
+                pending.clear()
+
+            for gap, length in downpour_chunk_schedule(
+                opt.n_push, opt.n_pull, start, start + steps_per_epoch
+            ):
+                latest = opt.boundary(gap)
+                if latest is not None:
+                    params = opt.unravel(jnp.asarray(latest))
+                pairs = [next(batches) for _ in range(length)]
+                bxs = np.stack([p[0] for p in pairs])
+                bys = np.stack([p[1] for p in pairs])
+                params, opt.accum, losses = chunk_step(
+                    params, opt.accum, bxs, bys, dropout_rng, gap
                 )
-                rec_extra = {"test_loss": test_loss, "test_accuracy": test_acc}
-            rec = logger.log_step(i, float(loss), **rec_extra)
-            if rec_extra:
-                print_eval_line(rec)
+                opt.idx = gap + length
+                # interval-crossing evals land at the chunk boundary
+                # (params advance inside one dispatch, so mid-chunk params
+                # don't exist); EVERY crossing step gets an eval record —
+                # the same row count and step indices as the per-step path,
+                # all carrying the chunk-end evaluation
+                rel0 = gap - start
+                eval_is = {
+                    i for i in range(rel0, rel0 + length)
+                    if i % args.log_interval == 0 and i > 0
+                }
+                ev = (
+                    evaluate(eval_step, params, x_test, y_test,
+                             args.test_batch_size)
+                    if eval_is else None
+                )
+                pending.append((rel0, losses, eval_is, ev))
+                if ev is not None or len(pending) >= 8:
+                    flush()
+            flush()
+            # no trailing boundary here: the next epoch's first chunk (or
+            # finish()'s flush after the last) owes any epoch-joint comm
+        else:
+            for i, (bx, by) in enumerate(batches):
+                loss, grads = grad_fn(params, bx, by, dropout_rng, opt.idx)
+                params = opt.step(params, grads)
+                rec_extra = {}
+                if i % args.log_interval == 0 and i > 0:
+                    test_loss, test_acc = evaluate(
+                        eval_step, params, x_test, y_test, args.test_batch_size
+                    )
+                    rec_extra = {"test_loss": test_loss, "test_accuracy": test_acc}
+                rec = logger.log_step(i, float(loss), **rec_extra)
+                if rec_extra:
+                    print_eval_line(rec)
         evaluate(eval_step, params, x_test, y_test, args.test_batch_size, verbose=True)
     opt.finish()
     return params, logger
+
+
+def _chunk_step_cache(opt, model):
+    """One compiled chunk step per optimizer instance (distinct scan lengths
+    share it — lax.scan length comes from the stacked batch shape)."""
+    if getattr(opt, "_chunk_step", None) is None:
+        opt._chunk_step = make_downpour_chunk_step(model, opt.lr, opt._pad)
+    return opt._chunk_step
 
 
 def run_server(args, transport: Transport) -> ParameterServer:

@@ -154,6 +154,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "compiled program (lax.scan) — "
                         "amortizes host dispatch; per-step CSV logging and "
                         "eval cadence are preserved")
+    p.add_argument("--chunked-dispatch", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="(--mode ps workers) compile each between-comm run "
+                        "of local SGD into one lax.scan dispatch with exact "
+                        "push/pull cadence semantics; 'auto' enables it on "
+                        "TPU, where per-batch dispatch — not the DownPour "
+                        "protocol — bounds worker throughput")
     p.add_argument("--heartbeat-interval", type=float, default=1.0, metavar="SEC",
                    help="PS-mode worker liveness heartbeat cadence; 0 disables "
                         "(the reference has no failure detection, SURVEY.md §5.3)")

@@ -157,3 +157,58 @@ def test_tp_decode_rejects_indivisible_heads():
     mesh = make_mesh({"data": 1, "model": 2}, devices=jax.devices()[:2])
     with pytest.raises(ValueError, match="divisible"):
         generate_tp(model, params, jnp.zeros((1, 2), jnp.int32), 4, mesh)
+
+
+def test_sample_tokens_topk_restricts_support():
+    from distributed_ml_pytorch_tpu.models.generate import sample_tokens
+
+    rng = np.random.default_rng(4)
+    logits = jnp.asarray(rng.normal(size=(4, 64)), jnp.float32)
+    topset = np.argsort(np.asarray(logits), axis=-1)[:, -5:]
+    for i in range(50):
+        toks = np.asarray(sample_tokens(logits, jax.random.key(i),
+                                        temperature=1.0, top_k=5))
+        for b in range(4):
+            assert toks[b] in topset[b]
+
+
+def test_sample_tokens_topk1_and_tiny_topp_equal_greedy():
+    from distributed_ml_pytorch_tpu.models.generate import sample_tokens
+
+    rng = np.random.default_rng(5)
+    logits = jnp.asarray(rng.normal(size=(3, 32)), jnp.float32)
+    greedy = np.asarray(jnp.argmax(logits, axis=-1))
+    for i in range(10):
+        k1 = np.asarray(sample_tokens(logits, jax.random.key(i),
+                                      temperature=0.7, top_k=1))
+        p0 = np.asarray(sample_tokens(logits, jax.random.key(i),
+                                      temperature=0.7, top_p=1e-9))
+        np.testing.assert_array_equal(k1, greedy)
+        np.testing.assert_array_equal(p0, greedy)
+
+
+def test_sample_tokens_topp_keeps_nucleus_only():
+    from distributed_ml_pytorch_tpu.models.generate import sample_tokens
+
+    # 0.5/0.3/0.1/0.1 distribution: the 0.75-nucleus is {0, 1} with a solid
+    # float margin on both sides (0.5 < 0.75 ≤ 0.8 — an exact-boundary
+    # threshold like 0.8 would flip on cumsum rounding across backends)
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.1, 0.1]], jnp.float32))
+    seen = set()
+    for i in range(100):
+        seen.add(int(sample_tokens(logits, jax.random.key(i),
+                                   temperature=1.0, top_p=0.75)[0]))
+    assert seen == {0, 1}
+
+
+def test_generate_with_topk_topp_runs_and_stays_in_vocab():
+    model = tiny_lm()
+    params = trained_ish_params(model)
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    out = generate(model, params, prompt, 8, temperature=0.9,
+                   rng=jax.random.key(0), top_k=10, top_p=0.9)
+    assert out.shape == (1, 12)
+    assert int(out.max()) < 64 and int(out.min()) >= 0
+    out2 = generate(model, params, prompt, 8, temperature=0.9,
+                    rng=jax.random.key(0), top_k=10, top_p=0.9)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
