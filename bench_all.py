@@ -199,14 +199,24 @@ def tpu_phase() -> None:
          "8 x seq 2048 — single-chip leg of the dp x ep sharding "
          "(dryrun_multichip runs the sharded step)")
 
-    # config 8 (inference) — KV-cache autoregressive decode
-    dec_rate = bench_decode()
+    # config 8 (inference) — KV-cache autoregressive decode, with the HBM
+    # roofline that judges it (decode reads all params + the live KV cache
+    # per step; utilization column per VERDICT r2 #4)
+    dec_rate, dec_frac, dec_bytes = bench_decode()
     emit(8, "gpt2_small_decode_throughput", dec_rate, "tokens/sec/chip", hw,
-         "batch 32, 128-token prompt prefill + 256 generated tokens per "
-         "call, scanned single-token steps with a static KV cache "
-         "(models/generate.py); greedy. Decode is param-read bound: batch 8 "
-         "measured 4,185 tok/s — batching amortizes the per-step weight "
-         "traffic 3.1x")
+         f"batch 32, 128-token prompt prefill + 256 generated tokens per "
+         f"call, scanned single-token steps with a static KV cache "
+         f"(models/generate.py); greedy, device-true timing. "
+         f"{dec_bytes / 1e6:.0f} MB/step of mandatory HBM traffic → "
+         f"{100 * dec_frac:.0f}% of the 819 GB/s roofline")
+    emit(8, "gpt2_small_decode_hbm_utilization", 100 * dec_frac,
+         "percent of 819 GB/s", hw,
+         "mandatory traffic (bf16 params + average live K/V read) per step "
+         "x steps/s — decode's MFU-equivalent, a lower bound on achieved "
+         "bandwidth. Batch 8 runs at 61% (genuinely weight-read bound); "
+         "batch 32's lower fraction means per-step costs that scale with "
+         "batch (cached attention, logits) now share the bill — the "
+         "documented headroom for a fused decode-step kernel")
 
 
 def bench_steps_to_accuracy(target: float = 0.99, max_steps: int = 2000,
@@ -290,6 +300,7 @@ def bench_steps_to_accuracy(target: float = 0.99, max_steps: int = 2000,
 
 
 def bench_lm(lm=None, batch: int = 1, seq: int = 8192, n_long: int = 11,
+             cross_check: bool = True,
              trials: int = 3, tag: str = "lm"):
     """Differenced steady-state tokens/sec (+ FLOPs/MFU) of one LM train step
     on the default device (chained through the donated state: each dispatch's
@@ -352,24 +363,45 @@ def bench_lm(lm=None, batch: int = 1, seq: int = 8192, n_long: int = 11,
             causal=True, remat=bool(getattr(lm, "remat", False)),
         )
 
-    def chain(n):
-        nonlocal state
-        t0 = time.perf_counter()
-        loss = None
-        for _ in range(n):
-            state, loss = step(state, tokens, targets)
-        float(loss)
-        return time.perf_counter() - t0
+    # audit cross-check (VERDICT r2 #8): the hybrid numerator must agree
+    # with an independent scaling-book 6ND count within 15%
+    from distributed_ml_pytorch_tpu.utils.flops import (
+        check_flops_agreement,
+        lm_train_flops_6nd,
+    )
 
-    chain(2)  # compile + warm
-    n_short = 1
-    short = min(chain(n_short) for _ in range(trials))
-    long_ = min(chain(n_long) for _ in range(trials))
-    per_step = (long_ - short) / (n_long - n_short)
-    rate = Rate.make(batch * seq / per_step, step_flops, per_step)
     n_params = sum(p.size for p in jax.tree.leaves(state.params))
+    embed_params = sum(
+        leaf.size
+        for path, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]
+        if any("embed" in str(getattr(k, "key", k)).lower() for k in path)
+    )
+    if cross_check:
+        analytic = lm_train_flops_6nd(
+            n_params - embed_params, batch, seq, lm.n_heads,
+            lm.d_model // lm.n_heads, lm.n_layers,
+            causal=True, remat=bool(getattr(lm, "remat", False)))
+        warn = check_flops_agreement(step_flops, analytic)
+        if warn:
+            log(f"{tag}: {warn}")
+    else:
+        warn = None
+
+    from distributed_ml_pytorch_tpu.utils.devtime import device_time
+
+    holder = {"s": state}
+
+    def one_step():
+        holder["s"], loss = step(holder["s"], tokens, targets)
+        return loss
+
+    t = device_time(one_step, calls=max(2, n_long), warmup=2)
+    per_step = t.per_call_s
+    rate = Rate.make(batch * seq / per_step, step_flops, per_step)
     log(f"{tag} ({n_params / 1e6:.0f}M params): {per_step * 1e3:.1f} ms/step at "
-        f"batch {batch} x seq {seq} → {rate:.0f} tokens/s ({rate.mfu_note()})")
+        f"batch {batch} x seq {seq} → {rate:.0f} tokens/s ({rate.mfu_note()}, "
+        f"device-true; 6ND cross-check "
+        f"{'skipped' if not cross_check else 'ok' if warn is None else 'FAILED'})")
     return rate
 
 
@@ -387,20 +419,29 @@ def bench_moe_lm(batch: int = 8, seq: int = 2048, n_long: int = 4,
         vocab_size=50304, d_model=768, n_heads=12, n_layers=12, d_ff=3072,
         n_experts=4, max_len=seq, dtype=jnp.bfloat16,
     )
+    # cross_check=False: 6·N·D over ALL experts' params overcounts top-1
+    # routed execution ~2-3x — an activated-params 6ND for MoE is future work
     return bench_lm(moe, batch=batch, seq=seq, n_long=n_long, trials=trials,
+                    cross_check=False,
                     tag=f"moe-4e-seq{seq}")
 
 
 def bench_decode(batch: int = 32, prompt_len: int = 128,
-                 new_tokens: int = 256, trials: int = 3):
-    """Autoregressive decode throughput (tokens/sec generated) of the
-    GPT-2-small model: one compiled prefill + one scanned generation
-    program (models/generate.py), differenced over repeated calls with a
-    rotating prompt so each dispatch is real work."""
+                 new_tokens: int = 256):
+    """Autoregressive decode of the GPT-2-small model — tokens/s plus the
+    roofline that judges it (VERDICT r2 #4): each single-token step must
+    read every parameter once (batch-amortized) and each sequence's K/V
+    cache, so the decode ceiling is HBM bandwidth, not FLOPs. Reports
+    bytes/step from the actual param dtypes + the average live cache
+    length, and the achieved fraction of the chip's 819 GB/s. Timing is
+    device-true (utils/devtime): the profiler's device spans for the
+    prefill + scanned-generation programs, immune to the tunnel RTT that
+    host-differenced decode timing is hostage to."""
     import jax
     import jax.numpy as jnp
 
     from distributed_ml_pytorch_tpu.models import TransformerLM, generate
+    from distributed_ml_pytorch_tpu.utils.devtime import device_time
 
     lm = TransformerLM(
         vocab_size=50304, d_model=768, n_heads=12, n_layers=12, d_ff=3072,
@@ -410,25 +451,43 @@ def bench_decode(batch: int = 32, prompt_len: int = 128,
     prompts = [
         jnp.asarray(np.random.default_rng(s).integers(
             0, lm.vocab_size, size=(batch, prompt_len)), jnp.int32)
-        for s in range(3)
+        for s in range(8)
     ]
+    calls = {"i": 0}
 
-    def run(n):
-        t0 = time.perf_counter()
-        out = None
-        for i in range(n):
-            out = generate(lm, params, prompts[i % len(prompts)], new_tokens)
-        int(out[0, -1])  # force the chain
-        return time.perf_counter() - t0
+    def one_call():  # rotate prompts: identical dispatches can be memoized
+        calls["i"] += 1
+        return generate(lm, params, prompts[calls["i"] % len(prompts)],
+                        new_tokens)
 
-    run(2)  # compile prefill + scan
-    short = min(run(1) for _ in range(trials))
-    long_ = min(run(4) for _ in range(trials))
-    per_call = (long_ - short) / 3
+    # single-call traces: the 256-iteration scan emits thousands of inner
+    # spans per call, and a multi-call window overflows the profiler buffer
+    # (observed: 4 forced calls, one surviving top-level span)
+    t1 = device_time(one_call, calls=1, warmup=2)
+    t2 = device_time(one_call, calls=1, warmup=0)
+    per_call = (t1.per_call_s + t2.per_call_s) / 2
     rate = batch * new_tokens / per_call
+
+    # --- roofline: MANDATORY bytes per step, a lower bound on achieved
+    # HBM bandwidth. Weights count at the compute dtype (XLA hoists the
+    # one-time f32→bf16 conversion out of the scanned loop, so steady-state
+    # steps read the bf16 copies — counting stored-f32 bytes measured an
+    # impossible 111% at batch 8); K/V counts the average live cache read.
+    n_params = sum(leaf.size for leaf in jax.tree.leaves(params))
+    param_bytes = n_params * jnp.dtype(lm.dtype).itemsize
+    d_model, n_layers = lm.d_model, lm.n_layers
+    avg_len = prompt_len + new_tokens / 2  # cache grows as tokens emit
+    kv_bytes_per_step = batch * 2 * n_layers * d_model * avg_len * 2  # bf16 K+V
+    bytes_per_step = param_bytes + kv_bytes_per_step
+    steps_per_s = rate / batch
+    achieved_bw = bytes_per_step * steps_per_s
+    frac = achieved_bw / 819e9
     log(f"decode: {per_call * 1e3:.1f} ms per {new_tokens}-token generation "
-        f"(batch {batch}) → {rate:.0f} tokens/s")
-    return rate
+        f"(batch {batch}, device-true) → {rate:.0f} tokens/s; "
+        f"{bytes_per_step / 1e6:.0f} MB/step mandatory "
+        f"({param_bytes / 1e6:.0f} bf16 params + {kv_bytes_per_step / 1e6:.0f} KV) "
+        f"→ ≥{achieved_bw / 1e9:.0f} GB/s = {100 * frac:.0f}% of 819 GB/s HBM")
+    return rate, frac, bytes_per_step
 
 
 def bench_hostfed_resnet50(batch: int = 256, steps: int = 8, trials: int = 3):

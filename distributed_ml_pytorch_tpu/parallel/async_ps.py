@@ -274,26 +274,33 @@ def init_downpour_accumulator(params: Pytree):
     return flat, n, pad, jnp.zeros(n + pad, jnp.float32)
 
 
+def _downpour_micro_update(params, grads, accum, lr: float, pad: int):
+    """THE DownPour per-step device math (Asynchronous.py:55,63-68),
+    shared verbatim by the per-step jitted step and the chunked scan body
+    so the two dispatch disciplines cannot drift: lr-pre-scaled flat
+    accumulation (Pallas flat-axpy on TPU) + the local SGD update."""
+    from distributed_ml_pytorch_tpu.ops import downpour_accumulate
+
+    flat_grads = ravel_model_params(params, grads=grads)
+    if pad:
+        # folds into the concatenate ravel already performs — the
+        # padded flat vector costs no extra HBM pass
+        flat_grads = jnp.concatenate([flat_grads, jnp.zeros(pad, flat_grads.dtype)])
+    accum = downpour_accumulate(accum, flat_grads, lr)
+    new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+    return new_params, accum
+
+
 def make_downpour_device_step(lr: float, pad: int):
     """The jitted DownPour device step shared by the single-server and
-    sharded-PS clients: lr-pre-scaled flat accumulation (Asynchronous.py:55,
-    Pallas flat-axpy on TPU) + the local SGD update (:63-68). ``accum`` is
+    sharded-PS clients (``_downpour_micro_update`` under jit). ``accum`` is
     donated: the axpy's output aliases its buffer, so the accumulation
     really is in place in HBM."""
     from functools import partial
 
     @partial(jax.jit, donate_argnums=(2,))
     def _device_step(params, grads, accum):
-        from distributed_ml_pytorch_tpu.ops import downpour_accumulate
-
-        flat_grads = ravel_model_params(params, grads=grads)
-        if pad:
-            # folds into the concatenate ravel already performs — the
-            # padded flat vector costs no extra HBM pass
-            flat_grads = jnp.concatenate([flat_grads, jnp.zeros(pad, flat_grads.dtype)])
-        accum = downpour_accumulate(accum, flat_grads, lr)
-        new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
-        return new_params, accum
+        return _downpour_micro_update(params, grads, accum, lr, pad)
 
     return _device_step
 
@@ -347,8 +354,6 @@ def make_downpour_chunk_step(model, lr: float, pad: int):
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def chunk_step(params, accum, bxs, bys, rng, idx0):
-        from distributed_ml_pytorch_tpu.ops import downpour_accumulate
-
         def body(carry, xs):
             params, accum, idx = carry
             bx, by = xs
@@ -361,13 +366,7 @@ def make_downpour_chunk_step(model, lr: float, pad: int):
                 return cross_entropy_loss(logits, by)
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
-            flat_grads = ravel_model_params(params, grads=grads)
-            if pad:
-                flat_grads = jnp.concatenate(
-                    [flat_grads, jnp.zeros(pad, flat_grads.dtype)]
-                )
-            accum = downpour_accumulate(accum, flat_grads, lr)
-            params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+            params, accum = _downpour_micro_update(params, grads, accum, lr, pad)
             return (params, accum, idx + 1), loss
 
         (params, accum, _), losses = jax.lax.scan(

@@ -73,19 +73,23 @@ def parse_device_spans(trace_json: dict) -> dict:
 
 
 def _top_level_total(programs: dict) -> tuple[int, float]:
-    """(calls, total_seconds) of the top-level XLA program spans.
+    """(dominant span count, total_seconds) of top-level XLA program spans.
 
     XLA names a jitted program's device span ``jit_<fn>(<fingerprint>)``;
     everything else (``fusion.N``, ``copy.N``, …) is nested inside one.
-    When several distinct programs ran (e.g. a grad function that launches
-    forward + two backward kernels as one program each), all jit spans are
-    summed — the caller traced only the calls it wants attributed.
+    All jit spans are summed — the caller traced only the calls it wants
+    attributed — and the count returned is that of the program carrying
+    the most device time (auxiliary micro-programs like a cache init can
+    run more OFTEN than the main program, so a max-count heuristic would
+    misattribute; ``device_time`` divides by its own known call count
+    anyway).
     """
-    n_calls, total = 0, 0.0
+    n_calls, total, biggest = 0, 0.0, -1.0
     for name, (n, tot) in programs.items():
         if name.startswith("jit"):
-            n_calls = max(n_calls, n)
             total += tot
+            if tot > biggest:
+                biggest, n_calls = tot, n
     return n_calls, total
 
 
@@ -97,6 +101,14 @@ def device_time(fn, *args, calls: int = 10, warmup: int = 2,
     its result is forced via a scalar fetch — the only completion signal the
     tunnel respects. On non-TPU backends falls back to wall-clock around the
     forced calls (source="wallclock").
+
+    CAVEAT — identical dispatches: the tunneled runtime can MEMOIZE a
+    repeat dispatch of the same program on the same input buffers (observed:
+    4 forced decode calls on one prompt produced a single device span).
+    When measuring with repeated calls, rotate inputs — pass a zero-arg
+    closure that cycles through distinct arrays (``device_time(one_call,
+    calls=N)``); kernels measured so far only memoized for large programs,
+    but rotation is the safe default for anything end-to-end.
     """
     import jax
 
@@ -104,7 +116,7 @@ def device_time(fn, *args, calls: int = 10, warmup: int = 2,
         leaf = jax.tree.leaves(r)[0]
         float(leaf.reshape(-1)[0])
 
-    for _ in range(max(warmup, 1)):
+    for _ in range(warmup):
         force(fn(*args))
 
     if jax.devices()[0].platform != "tpu":
@@ -121,10 +133,12 @@ def device_time(fn, *args, calls: int = 10, warmup: int = 2,
     tdir = trace_dir or tempfile.mkdtemp(prefix="devtime_")
     try:
         with jax.profiler.trace(tdir):
-            r = None
+            # every call is forced individually: an unforced intermediate
+            # dispatch can land outside the trace window (observed with
+            # large-footprint programs), silently dropping its span. The
+            # extra per-call fetch is host time — device spans are clean.
             for _ in range(calls):
-                r = fn(*args)
-            force(r)
+                force(fn(*args))
         paths = sorted(glob.glob(os.path.join(
             tdir, "plugins", "profile", "*", "*.trace.json.gz")))
         if not paths:
@@ -139,6 +153,10 @@ def device_time(fn, *args, calls: int = 10, warmup: int = 2,
     if n == 0:
         raise RuntimeError(
             "no jit program spans on the device timeline; was fn jitted?")
-    # n is the span count of the most-frequent program == dispatched calls
-    # (warmup happened outside the window)
+    # divide by the number of spans the DOMINANT program actually has, not
+    # the requested call count: a memoized repeat dispatch (same buffers)
+    # or a span dropped by profiler-buffer overflow both leave n < calls,
+    # and in each case `total` covers exactly n real executions — dividing
+    # by `calls` would deflate per-call time and inflate MFU silently.
+    # Auxiliary micro-programs fold into the per-call figure (negligible).
     return DeviceTiming(per_call_s=total / n, calls=n, programs=programs)
