@@ -55,6 +55,13 @@ bench-all:
 test:
 	$(PY) -m pytest tests/ -x -q
 
+# one-command real-data verification (VERDICT r2 #6): downloads genuine
+# CIFAR-10 where egress exists, re-runs steps-to-target + torch parity on
+# it and appends the outcome to BASELINE.md; prints SKIP and exits 0 when
+# offline, so it can run unconditionally
+verify-real-data:
+	$(PY) verify_real_data.py
+
 # --- plots (reference Makefile:8-11) ---
 graph:
 	$(PY) -m distributed_ml_pytorch_tpu.graph
@@ -67,4 +74,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p bench bench-all test graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p bench bench-all test verify-real-data graph install dist

@@ -39,3 +39,12 @@ def mesh8():
     from distributed_ml_pytorch_tpu.runtime import data_mesh
 
     return data_mesh(8)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "network: needs internet egress (real CIFAR-10 download); "
+        "deselect with -m 'not network' — these skip themselves when "
+        "the download fails",
+    )

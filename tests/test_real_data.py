@@ -1,0 +1,85 @@
+"""Network-marked real-data tests (VERDICT r2 #6).
+
+These run ONLY where egress exists: ``pytest -m network tests/test_real_data.py``.
+In this sandbox (zero egress) they skip cleanly — the point is that the
+moment the suite runs somewhere with network, the real-CIFAR-10 claims
+close themselves with no code changes.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def real_cifar(tmp_path_factory):
+    from distributed_ml_pytorch_tpu.data import load_cifar10
+
+    root = str(tmp_path_factory.mktemp("cifar_real"))
+    try:
+        data = load_cifar10(root=root, synthetic=False, download=True)
+    except Exception as e:
+        pytest.skip(f"real CIFAR-10 unavailable (no egress?): {e}")
+    return data
+
+
+@pytest.mark.network
+def test_real_cifar10_downloads_and_has_canonical_shapes(real_cifar):
+    x, y, xt, yt, is_synth = real_cifar
+    assert not is_synth
+    assert x.shape == (50000, 32, 32, 3) and xt.shape == (10000, 32, 32, 3)
+    assert set(y.tolist()) == set(range(10))
+
+
+@pytest.mark.network
+def test_real_data_short_training_learns(real_cifar):
+    """A few hundred reference-recipe steps on the genuine data must beat
+    chance decisively — the sanity gate before the full parity run."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_ml_pytorch_tpu.models import AlexNet
+    from distributed_ml_pytorch_tpu.training.trainer import (
+        create_train_state,
+        make_eval_fn,
+        make_scan_train_step,
+    )
+
+    x, y, xt, yt, _ = real_cifar
+    model = AlexNet()
+    state, tx = create_train_state(model, jax.random.key(0), lr=0.008)
+    scan = make_scan_train_step(model, tx)
+    ev = make_eval_fn(model)
+    idx = np.random.default_rng(0).integers(0, len(x), size=(8, 50, 64))
+    for sel in idx:
+        state, _ = scan(state, jnp.asarray(x[sel]), jnp.asarray(y[sel]),
+                        jax.random.key(1))
+    _, preds = ev(state.params, jnp.asarray(xt[:2000]), jnp.asarray(yt[:2000]))
+    acc = float((np.asarray(preds) == yt[:2000]).mean())
+    assert acc > 0.25, f"400 real-data steps only reached {acc:.3f}"
+
+
+def test_verify_real_data_script_skips_cleanly_without_egress(tmp_path):
+    """The one-command closer must exit 0 with an explicit SKIP record when
+    the download cannot happen — runnable unconditionally in CI."""
+    import os
+    import shutil
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # run from a scratch cwd so ./data stays empty and BASELINE.md untouched;
+    # a dead proxy makes the download fail FAST even on networked hosts, so
+    # this test deterministically exercises the skip path everywhere
+    shutil.copy(os.path.join(repo, "verify_real_data.py"), tmp_path)
+    out = subprocess.run(
+        [sys.executable, str(tmp_path / "verify_real_data.py")],
+        capture_output=True, text=True, cwd=tmp_path,
+        env={**os.environ,
+             "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+             "http_proxy": "http://127.0.0.1:9",
+             "https_proxy": "http://127.0.0.1:9"},
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "skipped_no_egress" in out.stdout
