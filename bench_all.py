@@ -249,7 +249,7 @@ def bench_steps_to_accuracy(target: float = 0.99, max_steps: int = 2000,
         make_scan_train_step,
     )
 
-    x, y, xt, yt, _ = load_cifar10(root=root, synthetic=True if synthetic else False)
+    x, y, xt, yt, _ = load_cifar10(root=root, synthetic=synthetic)
     xe, ye = xt[:n_eval], yt[:n_eval]
     idx = np.random.default_rng(0).integers(
         0, len(x), size=(max_steps // eval_every, eval_every, BATCH)

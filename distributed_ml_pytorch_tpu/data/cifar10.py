@@ -43,6 +43,14 @@ CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
 CIFAR10_MD5 = "c58f30108f718f92721af3b95e74349a"
 
 
+def _file_md5(path: str) -> str:
+    digest = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
 def download_cifar10(root: str, url: str | None = None,
                      md5: str | None = None,
                      timeout: float = 30.0) -> str:
@@ -64,6 +72,17 @@ def download_cifar10(root: str, url: str | None = None,
 
     os.makedirs(root, exist_ok=True)
     dest = os.path.join(root, _TARBALL)
+    if os.path.isfile(dest) and md5 and _file_md5(dest) != md5:
+        # a corrupt/torn tarball left by earlier tooling must fail HERE as
+        # a checksum mismatch, not later as an opaque extract error —
+        # remove it and re-download (ADVICE r2). Racing launcher ranks may
+        # both see the mismatch; the loser's remove finds nothing (fine),
+        # and at worst a concurrently-installed GOOD tarball is removed and
+        # benignly re-fetched by the verified path below
+        import contextlib
+
+        with contextlib.suppress(FileNotFoundError):
+            os.remove(dest)
     if not os.path.isfile(dest):
         # per-process .part name: N launcher ranks may race this download
         # (launch_world spawns workers that all call get_dataset); each
@@ -75,13 +94,10 @@ def download_cifar10(root: str, url: str | None = None,
                     open(part, "wb") as f:
                 shutil.copyfileobj(resp, f)
             if md5:
-                digest = hashlib.md5()
-                with open(part, "rb") as f:
-                    for chunk in iter(lambda: f.read(1 << 20), b""):
-                        digest.update(chunk)
-                if digest.hexdigest() != md5:
+                digest = _file_md5(part)
+                if digest != md5:
                     raise ValueError(
-                        f"checksum mismatch for {url}: got {digest.hexdigest()}, "
+                        f"checksum mismatch for {url}: got {digest}, "
                         f"want {md5} — refusing to install"
                     )
             os.replace(part, dest)  # atomic: readers never see a torn tarball

@@ -307,7 +307,10 @@ def run_sharded_ps_process(args) -> int:
         _params, logger = train_worker(
             args, transports[0], opt_factory=factory
         )
-        path = logger.to_csv("node{}.csv".format(args.rank))
+        # worker CSVs keep the unsharded node1..N convention (first worker
+        # = node1.csv) regardless of how many server ranks precede them —
+        # log-consuming tooling (log/, graph regeneration) assumes it
+        path = logger.to_csv("node{}.csv".format(star_rank))
         print("wrote", path)
         print("Finished Training")
     finally:

@@ -139,3 +139,22 @@ def test_shard_for_process_partitions():
     assert len(seen) == 100  # truncated to a multiple of process_count
     assert len(set(seen.tolist())) == 100  # disjoint coverage
     assert all(len(s[1]) == 25 for s in shards)
+
+
+def test_preexisting_corrupt_tarball_is_reverified_and_replaced(tmp_path):
+    """A torn/corrupt tarball already sitting at the destination must be
+    caught by the md5 check and silently re-downloaded — not surface later
+    as an opaque tarfile/extract error (ADVICE r2)."""
+    src = tmp_path / "src.tar.gz"
+    md5 = make_fake_archive(str(src))
+    root = str(tmp_path / "data")
+    os.makedirs(root)
+    # plant garbage where the tarball would live
+    dest = os.path.join(root, "cifar-10-python.tar.gz")
+    with open(dest, "wb") as f:
+        f.write(b"this is not a gzip stream")
+    d = download_cifar10(root, url=src.as_uri(), md5=md5)
+    assert os.path.isdir(d)
+    # the garbage was replaced by the verified archive
+    with open(dest, "rb") as f:
+        assert hashlib.md5(f.read()).hexdigest() == md5

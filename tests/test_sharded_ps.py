@@ -234,8 +234,11 @@ def test_sharded_ps_cli_world_end_to_end(tmp_path):
         n_servers=2,
     )
     assert code == 0
-    for rank in (2, 3):
-        assert os.path.exists(tmp_path / f"node{rank}.csv")
+    # worker CSVs keep the unsharded node1..N convention (first worker =
+    # node1.csv) regardless of the k server ranks before them (ADVICE r2)
+    for w in (1, 2):
+        assert os.path.exists(tmp_path / f"node{w}.csv")
+    assert not os.path.exists(tmp_path / "node3.csv")
 
 
 def test_sharded_rejoin_adopts_central_without_install():
