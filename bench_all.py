@@ -191,6 +191,10 @@ def tpu_phase() -> None:
          "attention dominates at this S (the analytic kernel count is most "
          "of the numerator)")
 
+    # config 6 (extreme-length leg) — full model at 32k context via the
+    # sequence-chunked loss
+    bench_lm_32k()
+
     # config 6 (MoE family leg) — Switch-MoE at GPT-2-small dims
     moe_tok = bench_moe_lm()
     emit(6, "moe_lm_4expert_seq2048_train_throughput", moe_tok,
@@ -420,6 +424,69 @@ def bench_lm(lm=None, batch: int = 1, seq: int = 8192, n_long: int = 11,
         f"device-true; 6ND cross-check "
         f"{'skipped' if not cross_check else 'ok' if warn is None else 'FAILED'})")
     return rate
+
+
+def bench_lm_32k() -> None:
+    """Config 6, extreme-length leg: a FULL GPT-2-small train step at
+    S=32768 on one chip — possible only because the loss is sequence-
+    chunked (``training/trainer.chunked_lm_loss``: the (1, 32768, 50304)
+    logits tensor alone is 6.6 GB f32, which OOM'd the dense loss; the
+    flash kernel handles the attention, remat the block activations)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_ml_pytorch_tpu.models.transformer import TransformerLM
+    from distributed_ml_pytorch_tpu.training.trainer import chunked_lm_loss
+    from distributed_ml_pytorch_tpu.utils.devtime import device_time
+    from distributed_ml_pytorch_tpu.utils.flops import lm_train_flops_6nd
+
+    S = 32768
+    lm = TransformerLM(
+        vocab_size=50304, d_model=768, n_heads=12, n_layers=12, d_ff=3072,
+        max_len=S, dtype=jnp.bfloat16, pos_encoding="rope", remat=True)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, 50304, (1, S)),
+                         jnp.int32)
+    targets = jnp.asarray(np.random.default_rng(1).integers(0, 50304, (1, S)),
+                          jnp.int32)
+    params = lm.init(jax.random.key(0), tokens[:, :128])["params"]
+    tx = optax.sgd(1e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(
+            lambda p: chunked_lm_loss(lm, p, tokens, targets, chunk=2048)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    holder = {"p": params, "o": opt_state}
+
+    def call():
+        holder["p"], holder["o"], loss = step(
+            holder["p"], holder["o"], tokens, targets)
+        return loss
+
+    t = device_time(call, calls=2, warmup=2)
+    n_params = sum(p.size for p in jax.tree.leaves(holder["p"]))
+    embed_params = sum(
+        leaf.size
+        for path, leaf in jax.tree_util.tree_flatten_with_path(holder["p"])[0]
+        if any("embed" in str(getattr(k, "key", k)).lower() for k in path)
+    )
+    fl = lm_train_flops_6nd(
+        n_params - embed_params, 1, S, lm.n_heads,
+        lm.d_model // lm.n_heads, lm.n_layers, remat=True)
+    from bench import Rate
+
+    rate = Rate.make(S / t.per_call_s, fl, t.per_call_s)
+    emit(6, "gpt2_small_seq32768_train_throughput", rate, "tokens/sec/chip",
+         "1x tpu",
+         "FULL-model single-chip training at 32k context (bf16, RoPE, "
+         "remat, sequence-chunked loss — the dense loss OOMs on the 6.6 GB "
+         "logits tensor); numerator is the analytic 6ND count incl. remat "
+         "recompute (cost_analysis path not used for this leg)")
 
 
 def bench_moe_lm(batch: int = 8, seq: int = 2048, n_long: int = 4,

@@ -169,6 +169,13 @@ class TransformerLM(nn.Module):
     cache_size: int = 0
     remat: bool = False
     pos_encoding: str = "learned"  # "learned" (table) | "rope" (rotary in-attn)
+    #: head=False returns the post-LayerNorm hidden states instead of
+    #: logits — the entry point for sequence-chunked losses that must not
+    #: materialize the full (batch, seq, vocab) logits tensor at long
+    #: context (training/trainer.chunked_lm_loss); the lm_head params stay
+    #: in the tree (flax ignores unused subtrees) and are applied by the
+    #: chunked loss itself
+    head: bool = True
 
     @nn.compact
     def __call__(self, tokens, positions=None):
@@ -193,4 +200,6 @@ class TransformerLM(nn.Module):
                 name=f"block_{i}",
             )(x, positions)
         x = nn.LayerNorm(dtype=self.dtype)(x)
+        if not self.head:
+            return x
         return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head")(x)

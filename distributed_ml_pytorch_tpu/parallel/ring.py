@@ -95,8 +95,11 @@ def ring_flash_attention(
     perm = [(j, (j + 1) % p) for j in range(p)]
 
     def lse_floor(_):
-        o = jnp.zeros(q.shape, jnp.float32)
-        lse = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
+        # derived via q so the arrays carry its device-varying type (vma)
+        # inside shard_map — fresh constants would fail the scan's
+        # carry-type invariance (same trick as init_softmax_state)
+        o = (q * 0.0).astype(jnp.float32)
+        lse = jnp.max(q * 0.0, axis=-1).astype(jnp.float32) + NEG_INF
         return o, lse
 
     def chunk(step, k_cur, v_cur):
@@ -243,14 +246,10 @@ def make_ring_attention(
         ring_attention, axis=axis, axis_size=axis_size, causal=causal,
         block_k=block_k, impl=impl
     )
-    # check_vma=False: the flash path's pallas_call out_shapes are opaque
-    # to the varying-manual-axes checker (same constraint as
-    # ops/attention.make_sharded_attn_fn); specs are fully mapped either way
     sharded = jax.shard_map(
         lambda q, k, v: local(q, k, v),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
     )
     return jax.jit(sharded)

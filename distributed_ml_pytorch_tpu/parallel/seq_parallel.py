@@ -122,15 +122,15 @@ def make_sp_train_step(
         params = optax.apply_updates(state.params, updates)
         return state.replace(params=params, opt_state=opt_state, step=state.step + 1), loss
 
-    # check_vma=False: on TPU the ring's per-chunk compute is a pallas_call,
-    # whose out_shapes the varying-manual-axes checker cannot see through
-    # (ops/attention.make_sharded_attn_fn documents the same constraint)
+    # check_vma stays ON: it also drives the automatic psum insertion that
+    # makes REPLICATED-param gradients correct (disabling it silently broke
+    # them — round 3); the flash path's pallas_call declares its vma via
+    # its out_shapes (ops/attention._vma_struct)
     sharded = jax.shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P(), P(data_axis, seq_axis), P(data_axis, seq_axis)),
         out_specs=(P(), P()),
-        check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
 
@@ -163,7 +163,6 @@ def make_sp_eval_fn(
             mesh=mesh,
             in_specs=(P(), P(data_axis, seq_axis), P(data_axis, seq_axis)),
             out_specs=P(),
-            check_vma=False,  # pallas inside (see make_sp_train_step)
         )
     )
 

@@ -192,6 +192,48 @@ def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
+def chunked_lm_loss(model, params, tokens, targets, chunk: int = 2048):
+    """Masked-mean next-token CE WITHOUT materializing (batch, seq, vocab)
+    logits — the long-context LM loss.
+
+    At S=32k the GPT-2-small logits tensor alone is 6.6 GB (f32), which is
+    what stops the full model training at that length, not the attention
+    (the flash kernel handles S=32k fine — ops/attention.py). This runs
+    the Transformer body once (``model.clone(head=False)`` → post-LayerNorm
+    hiddens, O(S·d)), then a ``lax.scan`` over sequence chunks applies the
+    lm_head matmul + CE per chunk under ``jax.checkpoint`` — the backward
+    recomputes each chunk's logits instead of saving them, so peak logits
+    memory is O(chunk·vocab) in both passes.
+
+    Same loss definition as ``fsdp.lm_loss_builder`` (final sequence
+    position masked); exact equality is tested. ``seq`` must divide by
+    ``chunk``.
+    """
+    b, s = tokens.shape
+    if s % chunk:
+        raise ValueError(f"seq {s} must divide by chunk {chunk}")
+    h = model.clone(head=False).apply({"params": params}, tokens)
+    w = params["lm_head"]["kernel"]
+    n = s // chunk
+    hc = h.reshape(b, n, chunk, h.shape[-1]).transpose(1, 0, 2, 3)
+    tc = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+    mask = jnp.ones((b, s), jnp.float32).at[:, -1].set(0.0)
+    mc = mask.reshape(b, n, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_ce(w_, h_c, t_c, m_c):
+        logits = (h_c @ w_.astype(h_c.dtype)).astype(jnp.float32)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, t_c)
+        return jnp.sum(ce * m_c)
+
+    def body(carry, xs):
+        h_c, t_c, m_c = xs
+        return carry + chunk_ce(w, h_c, t_c, m_c), None
+
+    loss_sum, _ = jax.lax.scan(body, jnp.zeros(()), (hc, tc, mc))
+    return loss_sum / jnp.sum(mask)
+
+
 def _sgd_step_body(model, tx, state: TrainState, images, labels, dropout_rng):
     """Unjitted single-step update shared by the per-step and scanned trainers.
 
