@@ -13,7 +13,12 @@ TPU-native decode structure:
 - **Generation** is a ``lax.scan`` over single-token steps: one compiled
   program for the entire sampled continuation, cache threaded as carry — no
   per-token Python dispatch, no growing shapes (the cache is statically
-  sized to ``prompt + max_new_tokens``).
+  sized to ``prompt + max_new_tokens``). The per-layer cache
+  ``dynamic_update_slice``s ARE updated in place inside the scan (measured:
+  per-step time is flat in cache length; do not "optimize" them — a
+  standalone, non-carried step DOES pay a full cache copy per append, and
+  a pallas ``input_output_aliases`` append kernel still materialized
+  copies on this runtime, so the scan-carry structure is the fast path).
 - Sampling is temperature-controlled categorical (temperature 0 → greedy
   argmax) with optional top-k and/or nucleus (top-p) truncation
   (:func:`sample_tokens`), per-step rng folded from one key, fully
