@@ -241,6 +241,7 @@ def make_fsdp_lm_train_step(
     mesh: Mesh,
     shardings,
     axis: str = "data",
+    loss_chunk: int = 0,
 ) -> Callable:
     """Jitted FSDP LM step: ``(state, tokens, targets) → (state, loss)``.
 
@@ -248,16 +249,20 @@ def make_fsdp_lm_train_step(
     with the LM loss convention shared with the sp/tp paths
     (``seq_parallel.next_token_targets``: the final position is masked by
     position), so dp/sp/tp/fsdp runs are comparable on the same data.
+    ``loss_chunk > 0`` computes the same loss sequence-chunked
+    (``trainer.chunked_lm_loss`` — no (batch, seq, vocab) logits
+    materialization; what makes 32k-context training fit one chip).
     """
 
     return make_sharded_step(
         tx, mesh, shardings, P(axis, None),
-        safe_lm_loss_builder(model, mesh, batch_axes=(axis,)), 2
+        safe_lm_loss_builder(model, mesh, batch_axes=(axis,),
+                             loss_chunk=loss_chunk), 2
     )
 
 
 def safe_lm_loss_builder(model, mesh, batch_axes=("data",),
-                         head_axis=None) -> Callable:
+                         head_axis=None, loss_chunk: int = 0) -> Callable:
     """:func:`lm_loss_builder` with GSPMD-legal attention applied — THE
     chokepoint for jit-with-shardings LM step factories (fsdp-LM,
     composite; tp/ep apply :func:`ops.attention.gspmd_safe_lm` to their own
@@ -267,16 +272,32 @@ def safe_lm_loss_builder(model, mesh, batch_axes=("data",),
     shard_map island matching the step's (batch, heads) layout."""
     from distributed_ml_pytorch_tpu.ops.attention import gspmd_safe_lm
 
-    return lm_loss_builder(gspmd_safe_lm(model, mesh, batch_axes, head_axis))
+    return lm_loss_builder(gspmd_safe_lm(model, mesh, batch_axes, head_axis),
+                           loss_chunk=loss_chunk)
 
 
-def lm_loss_builder(model) -> Callable:
+def lm_loss_builder(model, loss_chunk: int = 0) -> Callable:
     """The shared LM loss (final position masked by position, the
     ``seq_parallel.next_token_targets`` convention) as a
     :func:`make_sharded_step` loss builder — one definition for the fsdp-LM
-    and composite paths."""
+    and composite paths. ``loss_chunk > 0`` routes through the
+    sequence-chunked formulation (no full logits tensor; exact-equality
+    tested in f32 — under bf16 activations the chunked path's CE runs on
+    f32-upcast logits where the dense path's runs in bf16, a small
+    numerics difference in the chunked path's FAVOR)."""
 
     def loss_builder(state, tokens, targets):
+        if loss_chunk > 0:
+            from distributed_ml_pytorch_tpu.training.trainer import (
+                chunked_lm_loss,
+            )
+
+            def loss_fn(params):
+                return chunked_lm_loss(model, params, tokens, targets,
+                                       chunk=loss_chunk)
+
+            return loss_fn
+
         def loss_fn(params):
             logits = model.apply({"params": params}, tokens)
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)

@@ -44,6 +44,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="(--mode moe) experts per MoE layer")
     p.add_argument("--microbatches", type=int, default=4,
                    help="(--mode pp) GPipe microbatches per step")
+    p.add_argument("--loss-chunk", type=int, default=0, metavar="C",
+                   help="(single/fsdp modes) compute the LM loss in C-token "
+                        "sequence chunks without materializing the full "
+                        "(batch, seq, vocab) logits — required at very long "
+                        "context (e.g. --seq 32768); 0 = dense loss")
     p.add_argument("--steps-per-dispatch", type=int, default=1, metavar="K",
                    help="fuse K steps (distinct batches) into one compiled "
                         "program via lax.scan; --steps must divide by K")
@@ -119,6 +124,10 @@ def main(argv=None) -> int:
             f"--d-model {args.d_model} must be divisible by --n-heads "
             f"{args.n_heads} (attention splits d_model into heads)"
         )
+    if args.loss_chunk and args.seq % args.loss_chunk:
+        parser.error(
+            f"--seq {args.seq} must divide by --loss-chunk {args.loss_chunk}"
+        )
 
     import math
 
@@ -190,7 +199,8 @@ def main(argv=None) -> int:
         state, shardings = create_fsdp_train_state(
             init_fn, jax.random.key(args.seed), mesh
         )
-        step = make_fsdp_lm_train_step(lm, tx, mesh, shardings)
+        step = make_fsdp_lm_train_step(lm, tx, mesh, shardings,
+                                       loss_chunk=args.loss_chunk)
         shard = lambda t, g: shard_fsdp_batch(mesh, t, g)
         desc = "single-device" if args.mode == "single" else (
             f"{n_fsdp}-way fsdp "

@@ -50,3 +50,22 @@ def test_train_lm_example_loss_decreases(capsys):
     out = capsys.readouterr().out
     losses = [float(l.split("loss")[-1]) for l in out.splitlines() if "  step" in l]
     assert losses[-1] < losses[0]
+
+
+def test_train_lm_chunked_loss_matches_dense(capsys):
+    """--loss-chunk must train to the same losses as the dense loss (same
+    seed/data) — the CLI-reachable face of chunked_lm_loss's exactness."""
+    import re
+
+    outs = []
+    for extra in ([], ["--loss-chunk", "64"]):
+        rc = main([
+            "--mode", "single", "--steps", "3", "--batch", "4",
+            "--seq", "256", "--vocab", "64", "--d-model", "32",
+            "--n-heads", "8", "--n-layers", "1", "--d-ff", "64",
+        ] + extra)
+        assert rc == 0
+        m = re.search(r"final loss ([0-9.]+)", capsys.readouterr().out)
+        assert m
+        outs.append(float(m.group(1)))
+    assert abs(outs[0] - outs[1]) < 1e-3, outs
