@@ -849,6 +849,7 @@ def _ps_device_cycle_phase(batch: int) -> None:
 
     from distributed_ml_pytorch_tpu.models import get_model
     from distributed_ml_pytorch_tpu.parallel.async_ps import (
+        default_downpour_tx,
         init_downpour_accumulator,
         make_downpour_chunk_step,
     )
@@ -856,7 +857,9 @@ def _ps_device_cycle_phase(batch: int) -> None:
     model = get_model("alexnet")
     params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))["params"]
     _, n, pad, accum = init_downpour_accumulator(params)
-    chunk_step = make_downpour_chunk_step(model, 0.008, pad)
+    tx = default_downpour_tx(0.008)
+    opt_state = tx.init(params)
+    chunk_step = make_downpour_chunk_step(model, tx, pad)
     rng = jax.random.key(1)
     rnd = np.random.default_rng(0)
 
@@ -872,16 +875,20 @@ def _ps_device_cycle_phase(batch: int) -> None:
     dx9, dy9 = jax.device_put(bxs9), jax.device_put(bys9)
     losses = None
     for _ in range(2):  # compile both scan lengths + warm
-        params, accum, losses = chunk_step(params, accum, dx1, dy1, rng, 0)
-        params, accum, losses = chunk_step(params, accum, dx9, dy9, rng, 1)
+        params, opt_state, accum, losses = chunk_step(
+            params, opt_state, accum, dx1, dy1, rng, 0)
+        params, opt_state, accum, losses = chunk_step(
+            params, opt_state, accum, dx9, dy9, rng, 1)
     float(losses[-1])
 
     def cycle_rate(x1, y1, x9, y9, reps=10):
-        nonlocal params, accum, losses
+        nonlocal params, opt_state, accum, losses
         t0 = time.perf_counter()
         for _ in range(reps):
-            params, accum, losses = chunk_step(params, accum, x1, y1, rng, 0)
-            params, accum, losses = chunk_step(params, accum, x9, y9, rng, 1)
+            params, opt_state, accum, losses = chunk_step(
+                params, opt_state, accum, x1, y1, rng, 0)
+            params, opt_state, accum, losses = chunk_step(
+                params, opt_state, accum, x9, y9, rng, 1)
         float(losses[-1])  # trailing fetch forces the chain
         return (time.perf_counter() - t0) / reps
 

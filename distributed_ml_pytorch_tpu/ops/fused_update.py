@@ -92,5 +92,11 @@ def flat_axpy(y: jax.Array, x: jax.Array, alpha) -> jax.Array:
 
 def downpour_accumulate(accum: jax.Array, flat_grads: jax.Array, lr) -> jax.Array:
     """``accum - lr * grads`` — the lr-pre-scaled gradient accumulation of the
-    reference's ``accum.add_(-lr, grads)`` (``Asynchronous.py:55``)."""
+    reference's ``accum.add_(-lr, grads)`` (``Asynchronous.py:55``).
+
+    Op-level parity surface only: the production worker now accumulates
+    optax UPDATES (already lr-scaled by the local transform) via
+    ``flat_axpy(accum, flat_updates, 1.0)`` — see
+    ``parallel/async_ps._downpour_micro_update`` — which reduces to this
+    exact math for the default SGD recipe."""
     return flat_axpy(accum, flat_grads, -lr)

@@ -111,6 +111,7 @@ class ShardedAsynchronous:
         n_push: int,
         n_pull: int,
         *,
+        tx=None,
         transports: Sequence[Transport],
         rejoin: bool = False,
         install_timeout: float = 5.0,
@@ -125,9 +126,15 @@ class ShardedAsynchronous:
         self.transports = list(transports)
         self.idx = 0
         self.unravel = make_unraveler(params)
+        # worker-local optax transform (same contract as Asynchronous.tx:
+        # default = the reference SGD recipe; state survives shard installs)
+        from distributed_ml_pytorch_tpu.parallel.async_ps import default_downpour_tx
+
+        self.tx = tx if tx is not None else default_downpour_tx(self.lr)
+        self.opt_state = self.tx.init(params)
         flat, self._flat_n, self._pad, self.accum = init_downpour_accumulator(params)
         self.ranges = shard_ranges(self._flat_n, len(self.transports))
-        self._device_step = make_downpour_device_step(self.lr, self._pad)
+        self._device_step = make_downpour_device_step(self.tx, self._pad)
         # per-shard liveness: a dead shard degrades that SLICE to purely-
         # local SGD (same contract as Asynchronous._send, per shard — the
         # other shards keep their push/pull service). ``heartbeats[s]`` is
@@ -204,7 +211,9 @@ class ShardedAsynchronous:
         if self.idx % self.n_pull == 0:
             for s in range(len(self.transports)):
                 self._send(s, MessageCode.ParameterRequest, np.zeros(0, np.float32))
-        params, self.accum = self._device_step(params, grads, self.accum)
+        params, self.opt_state, self.accum = self._device_step(
+            params, self.opt_state, grads, self.accum
+        )
         if self.idx % self.n_push == 0:
             accum = np.asarray(self.accum[: self._flat_n])
             for s, (lo, hi) in enumerate(self.ranges):
@@ -299,9 +308,9 @@ def run_sharded_ps_process(args) -> int:
                 hb = HeartbeatSender(t, interval=hb_interval)
                 hb.start()
                 heartbeats.append(hb)
-        factory = lambda params: ShardedAsynchronous(
+        factory = lambda params, tx: ShardedAsynchronous(
             params, lr=args.lr, n_push=args.num_push, n_pull=args.num_pull,
-            transports=transports, rejoin=getattr(args, "rejoin", False),
+            tx=tx, transports=transports, rejoin=getattr(args, "rejoin", False),
             heartbeats=heartbeats or None,
         )
         _params, logger = train_worker(

@@ -131,6 +131,23 @@ def make_optimizer(
     return optax.chain(*chain, base)
 
 
+def build_tx(
+    optimizer: str,
+    lr,
+    momentum: float = 0.0,
+    weight_decay: float | None = None,
+    grad_clip: float = 0.0,
+    grad_accum: int = 1,
+) -> optax.GradientTransformation:
+    """``make_optimizer`` + the grad-accumulation wrap — the single assembly
+    point shared by :func:`create_train_state` and :func:`tx_from_args` so
+    a new chain element cannot diverge between the kwarg and CLI paths."""
+    tx = make_optimizer(optimizer, lr, momentum, weight_decay, grad_clip)
+    if int(grad_accum) > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=int(grad_accum))
+    return tx
+
+
 def create_train_state(
     model,
     rng: jax.Array,
@@ -151,17 +168,15 @@ def create_train_state(
     is applied — the effective batch grows without growing per-step HBM.
     """
     params = model.init(rng, jnp.zeros(sample_shape))["params"]
-    tx = make_optimizer(optimizer, lr, momentum, weight_decay, grad_clip)
-    if int(grad_accum) > 1:
-        tx = optax.MultiSteps(tx, every_k_schedule=int(grad_accum))
+    tx = build_tx(optimizer, lr, momentum, weight_decay, grad_clip, grad_accum)
     return TrainState.create(params, tx), tx
 
 
-def state_from_args(args, model, steps_per_epoch: int):
-    """Build ``(state, tx)`` from the CLI argument surface — the ONE place
-    the optimizer/schedule/accumulation knobs are read, shared by the
-    single-process, sync/fsdp, and local-sgd trainers so a new knob cannot
-    be silently dropped by one mode.
+def tx_from_args(args, steps_per_epoch: int) -> optax.GradientTransformation:
+    """Build the optax transform from the CLI argument surface — the ONE
+    place the optimizer/schedule/accumulation knobs are read, shared by the
+    single-process, sync/fsdp, local-sgd, AND async-PS trainers so a new
+    knob cannot be silently dropped by one mode.
 
     ``steps_per_epoch`` is in raw batches; with ``--grad-accum K`` the LR
     schedule advances once per K micro-batches (``optax.MultiSteps`` emits
@@ -175,16 +190,23 @@ def state_from_args(args, model, steps_per_epoch: int):
         steps_per_epoch=max(1, int(steps_per_epoch) // grad_accum),
         total_epochs=args.epochs,
     )
-    return create_train_state(
-        model,
-        jax.random.key(getattr(args, "seed", 0)),
+    return build_tx(
+        getattr(args, "optimizer", "sgd"),
         lr,
-        momentum=getattr(args, "momentum", 0.0),
-        grad_accum=grad_accum,
-        optimizer=getattr(args, "optimizer", "sgd"),
-        weight_decay=getattr(args, "weight_decay", None),
-        grad_clip=getattr(args, "grad_clip", 0.0),
+        getattr(args, "momentum", 0.0),
+        getattr(args, "weight_decay", None),
+        getattr(args, "grad_clip", 0.0),
+        grad_accum,
     )
+
+
+def state_from_args(args, model, steps_per_epoch: int, sample_shape=(1, 32, 32, 3)):
+    """``(state, tx)`` from the CLI surface (see :func:`tx_from_args`)."""
+    tx = tx_from_args(args, steps_per_epoch)
+    params = model.init(
+        jax.random.key(getattr(args, "seed", 0)), jnp.zeros(sample_shape)
+    )["params"]
+    return TrainState.create(params, tx), tx
 
 
 def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:

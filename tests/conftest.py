@@ -48,3 +48,32 @@ def pytest_configure(config):
         "deselect with -m 'not network' — these skip themselves when "
         "the download fails",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: spawns real processes or trains end-to-end (minutes on a "
+        "1-core host); `make test` deselects these for a fast core signal, "
+        "`make test-all` runs everything",
+    )
+
+
+# Modules whose tests launch real subprocess worlds (interpreter start + jit
+# compile per process) or run whole example trainings — the wall-clock tail
+# of the suite. Marked wholesale here so a new test in these files cannot be
+# forgotten; in-process tests that also take minutes opt in with an explicit
+# @pytest.mark.slow at the test site.
+SLOW_MODULES = {
+    "test_examples",
+    "test_launchers",
+    "test_multihost_bootstrap",
+    "test_multihost_branches",
+    "test_ps_fault_injection",
+    "test_ps_multiprocess",
+    "test_real_data",
+    "test_sharded_ps",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__.rsplit(".", 1)[-1] in SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)

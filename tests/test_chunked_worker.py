@@ -15,6 +15,7 @@ import numpy as np
 from distributed_ml_pytorch_tpu.models import get_model
 from distributed_ml_pytorch_tpu.parallel.async_ps import (
     Asynchronous,
+    default_downpour_tx,
     downpour_chunk_schedule,
     init_downpour_accumulator,
     make_downpour_chunk_step,
@@ -64,7 +65,8 @@ def test_chunk_step_matches_per_step_device_math():
     # per-step reference: the worker's grad_fn + make_downpour_device_step
     from distributed_ml_pytorch_tpu.training.trainer import cross_entropy_loss
 
-    device_step = make_downpour_device_step(lr, pad)
+    tx = default_downpour_tx(lr)
+    device_step = make_downpour_device_step(tx, pad)
 
     def grad_fn(p, bx, by, idx):
         def loss_fn(q):
@@ -76,16 +78,18 @@ def test_chunk_step_matches_per_step_device_math():
 
         return jax.value_and_grad(loss_fn)(p)
 
-    p_ref, a_ref = params, accum
+    p_ref, a_ref, s_ref = params, accum, tx.init(params)
     losses_ref = []
     for i in range(L):
         loss, grads = grad_fn(p_ref, bxs[i], bys[i], i)
-        p_ref, a_ref = device_step(p_ref, grads, a_ref)
+        p_ref, s_ref, a_ref = device_step(p_ref, s_ref, grads, a_ref)
         losses_ref.append(float(loss))
 
-    chunk_step = make_downpour_chunk_step(model, lr, pad)
+    chunk_step = make_downpour_chunk_step(model, tx, pad)
     _, _, pad2, accum2 = init_downpour_accumulator(params)
-    p_chk, a_chk, losses = chunk_step(params, accum2, bxs, bys, key, 0)
+    p_chk, _, a_chk, losses = chunk_step(
+        params, tx.init(params), accum2, bxs, bys, key, 0
+    )
 
     np.testing.assert_allclose(np.asarray(losses), losses_ref, rtol=1e-5)
     for a, b in zip(jax.tree.leaves(p_ref), jax.tree.leaves(p_chk)):
