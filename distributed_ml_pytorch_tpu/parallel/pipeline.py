@@ -111,6 +111,28 @@ def init_pp_params(cfg: PipelineLMConfig, rng: jax.Array, sample_len: int = 8):
     }
 
 
+def _is_blocks_path(path) -> bool:
+    """THE stage-sharding rule: a leaf is stage-sharded iff its path crosses
+    a ``"blocks"`` key. Shared by :func:`pp_param_specs` and the 1F1B
+    localizer so the varying/replicated treatment cannot diverge."""
+    return "blocks" in (
+        getattr(k, "key", getattr(k, "name", str(k))) for k in path
+    )
+
+
+def _lm_modules(cfg: PipelineLMConfig):
+    """The replicated (non-block) modules, one construction shared by every
+    schedule builder: ``(tok_embed, pos_embed, head, ln_f)``."""
+    from flax import linen as nn
+
+    return (
+        nn.Embed(cfg.vocab_size, cfg.d_model),
+        nn.Embed(cfg.max_len, cfg.d_model),
+        nn.Dense(cfg.vocab_size, use_bias=False),
+        nn.LayerNorm(),
+    )
+
+
 def pp_param_specs(tree, stage_axis: str = "stage"):
     """Spec tree: any leaf under a ``"blocks"`` key is layer-stacked on its
     leading axis → ``P(stage, ...)``; everything else replicated.
@@ -122,12 +144,33 @@ def pp_param_specs(tree, stage_axis: str = "stage"):
     """
 
     def spec_for(path, leaf):
-        names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
-        if "blocks" in names:
+        if _is_blocks_path(path):
             return P(*((stage_axis,) + (None,) * (leaf.ndim - 1)))
         return P()
 
     return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def _wrap_pp_step(grad_fn, tx, mesh, stage_axis):
+    """``(state, tokens_mb, targets_mb) → (state, loss)`` from a shard_map-
+    able ``grad_fn(params, tokens_mb, targets_mb) → (loss, grads)`` — the
+    one optimizer-update epilogue shared by all three schedule builders."""
+
+    def step(state: TrainState, tokens_mb, targets_mb):
+        param_specs = pp_param_specs(state.params, stage_axis)
+        loss, grads = jax.shard_map(
+            grad_fn,
+            mesh=mesh,
+            in_specs=(param_specs, P(), P()),
+            out_specs=(P(), param_specs),
+        )(state.params, tokens_mb, targets_mb)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(
+            params=params, opt_state=opt_state, step=state.step + 1
+        ), loss
+
+    return jax.jit(step, donate_argnums=(0,))
 
 
 def create_pp_train_state(
@@ -229,12 +272,7 @@ def make_pp_train_step(
     if schedule != "gpipe":
         raise ValueError(
             f"schedule must be 'gpipe', '1f1b' or 'interleaved', got {schedule!r}")
-    from flax import linen as nn
-
-    embed = nn.Embed(cfg.vocab_size, cfg.d_model)
-    pos_embed = nn.Embed(cfg.max_len, cfg.d_model)
-    head = nn.Dense(cfg.vocab_size, use_bias=False)
-    ln_f = nn.LayerNorm()
+    embed, pos_embed, head, ln_f = _lm_modules(cfg)
     fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
 
     def pipeline_loss(params, tokens_mb, targets_mb):
@@ -289,26 +327,11 @@ def make_pp_train_step(
         count = jax.lax.psum(count, stage_axis)
         return loss_sum / count
 
-    def step(state: TrainState, tokens_mb, targets_mb):
-        param_specs = pp_param_specs(state.params, stage_axis)
-        grad_fn = jax.value_and_grad(pipeline_loss)
-        loss, grads = jax.shard_map(
-            grad_fn,
-            mesh=mesh,
-            in_specs=(param_specs, P(), P()),
-            out_specs=(P(), param_specs),
-        )(state.params, tokens_mb, targets_mb)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return state.replace(params=params, opt_state=opt_state, step=state.step + 1), loss
-
-    return jax.jit(step, donate_argnums=(0,))
+    return _wrap_pp_step(jax.value_and_grad(pipeline_loss), tx, mesh, stage_axis)
 
 
 def _make_interleaved_step(cfg, tx, mesh, M, stage_axis, v):
     """The interleaved-schedule step (see make_pp_train_step's docstring)."""
-    from flax import linen as nn
-
     S = int(mesh.shape[stage_axis])
     if cfg.n_layers % (S * v):
         raise ValueError(
@@ -324,10 +347,7 @@ def _make_interleaved_step(cfg, tx, mesh, M, stage_axis, v):
     B = D + 1  # FIFO depth: a value stored during tick a is read at a+D+1
     T = v * M + S - 1
 
-    embed = nn.Embed(cfg.vocab_size, cfg.d_model)
-    pos_embed = nn.Embed(cfg.max_len, cfg.d_model)
-    head = nn.Dense(cfg.vocab_size, use_bias=False)
-    ln_f = nn.LayerNorm()
+    embed, pos_embed, head, ln_f = _lm_modules(cfg)
     ring = [(i, (i + 1) % S) for i in range(S)]
 
     def pipeline_loss(params, tokens_mb, targets_mb):
@@ -401,21 +421,7 @@ def _make_interleaved_step(cfg, tx, mesh, M, stage_axis, v):
         count = jax.lax.psum(count, stage_axis)
         return loss_sum / count
 
-    def step(state: TrainState, tokens_mb, targets_mb):
-        param_specs = pp_param_specs(state.params, stage_axis)
-        grad_fn = jax.value_and_grad(pipeline_loss)
-        loss, grads = jax.shard_map(
-            grad_fn,
-            mesh=mesh,
-            in_specs=(param_specs, P(), P()),
-            out_specs=(P(), param_specs),
-        )(state.params, tokens_mb, targets_mb)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return state.replace(params=params, opt_state=opt_state,
-                             step=state.step + 1), loss
-
-    return jax.jit(step, donate_argnums=(0,))
+    return _wrap_pp_step(jax.value_and_grad(pipeline_loss), tx, mesh, stage_axis)
 
 
 def oneF1B_tick_roles(t, s, S: int, M: int):
@@ -475,14 +481,9 @@ def _make_1f1b_step(cfg, tx, mesh, M, stage_axis):
     head / ln_f grads accumulate on the stages that own them and are
     psum-broadcast, and block grads stay ``P(stage)``-local.
     """
-    from flax import linen as nn
-
     S = int(mesh.shape[stage_axis])
     T = 2 * (M + S - 1)
-    embed = nn.Embed(cfg.vocab_size, cfg.d_model)
-    pos_embed = nn.Embed(cfg.max_len, cfg.d_model)
-    head = nn.Dense(cfg.vocab_size, use_bias=False)
-    ln_f = nn.LayerNorm()
+    embed, pos_embed, head, ln_f = _lm_modules(cfg)
     fwd_perm = [(i, i + 1) for i in range(S - 1)]
     bwd_perm = [(i + 1, i) for i in range(S - 1)]
 
@@ -495,8 +496,7 @@ def _make_1f1b_step(cfg, tx, mesh, M, stage_axis):
         # cotangents stay local and the single explicit psum after the scan
         # does the cross-stage reduction.
         def localize(path, leaf):
-            names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
-            if "blocks" in names:
+            if _is_blocks_path(path):
                 return leaf  # already stage-varying (P(stage) input)
             return jax.lax.pcast(leaf, stage_axis, to="varying")
 
@@ -554,12 +554,14 @@ def _make_1f1b_step(cfg, tx, mesh, M, stage_axis):
 
             def stage_input(m):
                 """Microbatch m's input to this stage: the parked arrival
-                (s > 0) or the recomputed embedding (stage 0)."""
-                return jnp.where(
+                (s > 0) or the recomputed embedding (stage 0). A nested cond
+                (collective-free branches) so S−1 stages skip the embedding
+                work instead of computing-and-masking it every tick."""
+                return jax.lax.cond(
                     s == 0,
-                    embed_fn(params["tok_embed"], params["pos_embed"], m),
-                    jax.lax.dynamic_index_in_dim(arrivals, m % S, axis=0,
-                                                 keepdims=False),
+                    lambda: embed_fn(params["tok_embed"], params["pos_embed"], m),
+                    lambda: jax.lax.dynamic_index_in_dim(arrivals, m % S, axis=0,
+                                                         keepdims=False),
                 )
 
             def fwd_branch(op):
@@ -591,11 +593,20 @@ def _make_1f1b_step(cfg, tx, mesh, M, stage_axis):
                 g_ce = jnp.where(is_last, inv_total, 0.0)
                 d_blocks, d_head, d_lnf, d_h = vjp_fn((g_h, g_ce))
                 # stage 0 transposes the embedding instead of sending left
-                _, evjp = jax.vjp(
-                    lambda tp, pp: embed_fn(tp, pp, m_b),
-                    params["tok_embed"], params["pos_embed"],
+                # (nested cond: the other stages skip the transpose work)
+                def embed_transpose():
+                    _, evjp = jax.vjp(
+                        lambda tp, pp: embed_fn(tp, pp, m_b),
+                        params["tok_embed"], params["pos_embed"],
+                    )
+                    return evjp(d_h)
+
+                d_tok, d_pos = jax.lax.cond(
+                    s == 0,
+                    embed_transpose,
+                    lambda: (jax.tree.map(jnp.zeros_like, params["tok_embed"]),
+                             jax.tree.map(jnp.zeros_like, params["pos_embed"])),
                 )
-                d_tok, d_pos = evjp(jnp.where(s == 0, d_h, jnp.zeros_like(d_h)))
                 grads = {
                     "blocks": jax.tree.map(jnp.add, grads["blocks"], d_blocks),
                     "head": jax.tree.map(jnp.add, grads["head"], d_head),
@@ -638,20 +649,7 @@ def _make_1f1b_step(cfg, tx, mesh, M, stage_axis):
         loss = jax.lax.psum(loss_sum, stage_axis) / (n_mask * M)
         return loss, grads
 
-    def step(state: TrainState, tokens_mb, targets_mb):
-        param_specs = pp_param_specs(state.params, stage_axis)
-        loss, grads = jax.shard_map(
-            pipeline_grads,
-            mesh=mesh,
-            in_specs=(param_specs, P(), P()),
-            out_specs=(P(), param_specs),
-        )(state.params, tokens_mb, targets_mb)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return state.replace(params=params, opt_state=opt_state,
-                             step=state.step + 1), loss
-
-    return jax.jit(step, donate_argnums=(0,))
+    return _wrap_pp_step(pipeline_grads, tx, mesh, stage_axis)
 
 
 def microbatch(tokens, targets, n_microbatches: int) -> Tuple[np.ndarray, np.ndarray]:

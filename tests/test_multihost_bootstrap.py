@@ -1,20 +1,26 @@
-"""Multi-controller rendezvous: ``runtime.initialize_distributed`` must form
-a real multi-process world on localhost — the parity test for the reference's
-``dist.init_process_group('gloo', rank, world_size)`` TCP rendezvous
+"""Multi-controller rendezvous AND cross-process device collectives: the
+parity tests for the reference's ``dist.init_process_group('gloo', rank,
+world_size)`` TCP rendezvous and its inter-process tensor traffic
 (``example/main.py:163-165``).
 
-What can and cannot be validated on this hardware, explicitly: the
-coordination service (rendezvous, barriers, key-value exchange — the DCN
-control plane) is fully exercised across real processes below. Cross-process
-*device* collectives are the TPU runtime's job (ICI/DCN under XLA) and this
-CPU build does not federate devices across processes — those paths are
-covered by the in-process 8-device virtual mesh tests and by
-``dryrun_multichip``.
+Two layers, both across REAL processes on localhost:
+
+- the coordination service (rendezvous, barriers, key-value exchange — the
+  DCN control plane), and
+- the data plane: ``test_two_process_sync_dp_matches_in_process`` runs the
+  framework's actual sync-DP train step over a 2-process mesh with JAX's
+  cross-process CPU collectives (``jax_cpu_collectives_implementation =
+  'gloo'`` — literally the same transport family the reference's
+  ``init_process_group('gloo')`` used), each process feeding half the
+  global batch, and checks the psum'd result against the identical step on
+  an in-process 2-device mesh.
 """
 
 import subprocess
 import sys
 import textwrap
+
+import numpy as np
 
 from distributed_ml_pytorch_tpu.launch import _free_port, cpu_platform_env
 
@@ -58,3 +64,117 @@ def test_two_process_rendezvous_barrier_and_kv():
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"OK proc={rank}" in out, out
+
+SYNC_DP_WORKER = textwrap.dedent(
+    """
+    import sys
+    proc, port, out_path = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    import jax
+    # the reference's gloo process group, recast as JAX's cross-process CPU
+    # collectives: XLA psum/ppermute now move real tensors BETWEEN processes
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    from distributed_ml_pytorch_tpu.runtime.mesh import initialize_distributed
+    initialize_distributed(f"localhost:{port}", num_processes=2, process_id=proc)
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_ml_pytorch_tpu.models import LeNet
+    from distributed_ml_pytorch_tpu.parallel.sync import make_sync_train_step
+    from distributed_ml_pytorch_tpu.runtime.mesh import make_mesh
+    from distributed_ml_pytorch_tpu.training.trainer import create_train_state
+
+    assert jax.process_count() == 2 and len(jax.devices()) == 2
+    mesh = make_mesh({"data": 2})
+
+    model = LeNet()
+    state, tx = create_train_state(model, jax.random.key(0), lr=0.05)
+    # identical on every process (same seed) -> replicated placement is legal
+    rep = NamedSharding(mesh, P())
+    state = jax.tree.map(
+        lambda a: jax.make_array_from_process_local_data(rep, np.asarray(a)),
+        state,
+    )
+    rng = jax.make_array_from_process_local_data(
+        rep, np.asarray(jax.random.PRNGKey(1))
+    )
+
+    data = np.random.default_rng(7)
+    xb = data.normal(size=(16, 32, 32, 3)).astype(np.float32)
+    yb = data.integers(0, 10, 16).astype(np.int32)
+    # THIS process holds only its half of the global batch
+    half = slice(proc * 8, (proc + 1) * 8)
+    gx = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), xb[half])
+    gy = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), yb[half])
+
+    step = make_sync_train_step(model, tx, mesh)
+    state, loss = step(state, gx, gy, rng)
+    state, loss = step(state, gx, gy, rng)  # 2 steps: grads flowed both ways
+    loss = float(loss)  # replicated output: addressable on every process
+    leaves = [np.asarray(l) for l in jax.tree.leaves(state.params)]
+    if proc == 0:
+        np.savez(out_path, loss=loss, *leaves)
+    print(f"SYNC-DP-OK proc={proc} loss={loss:.6f}", flush=True)
+    """
+)
+
+
+def test_two_process_sync_dp_matches_in_process(tmp_path):
+    """The reference's 3-process gloo world moved real tensors between
+    processes; this runs the framework's sync-DP data plane across 2 real
+    processes (half the global batch each, psum over gloo) and requires the
+    result to match the same compiled step on an in-process 2-device mesh."""
+    port = _free_port()
+    out_path = str(tmp_path / "rank0.npz")
+    env = cpu_platform_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", SYNC_DP_WORKER, str(rank), port, out_path],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for rank in range(2)
+    ]
+    outs = [p.communicate(timeout=240)[0] for p in procs]
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"SYNC-DP-OK proc={rank}" in out, out
+    # both processes computed the same replicated loss
+    l0 = outs[0].split("loss=")[1].split()[0]
+    l1 = outs[1].split("loss=")[1].split()[0]
+    assert l0 == l1, (l0, l1)
+
+    # in-process reference: the identical step on 2 virtual devices
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from distributed_ml_pytorch_tpu.models import LeNet
+    from distributed_ml_pytorch_tpu.parallel.sync import (
+        make_sync_train_step,
+        put_sharded,
+        replicate,
+    )
+    from distributed_ml_pytorch_tpu.training.trainer import create_train_state
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+    model = LeNet()
+    state, tx = create_train_state(model, jax.random.key(0), lr=0.05)
+    state = replicate(mesh, state)
+    rng = replicate(mesh, jax.random.PRNGKey(1))
+    data = np.random.default_rng(7)
+    xb = data.normal(size=(16, 32, 32, 3)).astype(np.float32)
+    yb = data.integers(0, 10, 16).astype(np.int32)
+    gx = put_sharded(mesh, xb, P("data"))
+    gy = put_sharded(mesh, yb, P("data"))
+    step = make_sync_train_step(model, tx, mesh)
+    state, loss = step(state, gx, gy, rng)
+    state, loss = step(state, gx, gy, rng)
+
+    got = np.load(out_path)
+    assert abs(float(got["loss"]) - float(loss)) < 1e-6
+    ref_leaves = [np.asarray(l) for l in jax.tree.leaves(state.params)]
+    cross_leaves = [got[f"arr_{i}"] for i in range(len(ref_leaves))]
+    for a, b in zip(ref_leaves, cross_leaves):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
