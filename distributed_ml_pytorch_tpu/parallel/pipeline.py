@@ -90,17 +90,12 @@ def init_pp_params(cfg: PipelineLMConfig, rng: jax.Array, sample_len: int = 8):
     ``blocks`` is the per-layer param tree *stacked on a leading layer axis*
     (vmapped init over per-layer rngs) — the axis that shards over ``stage``.
     """
-    from flax import linen as nn
-
     block = cfg.block()
     x = jnp.zeros((1, sample_len, cfg.d_model))
     layer_rngs = jax.random.split(jax.random.fold_in(rng, 0), cfg.n_layers)
     blocks = jax.vmap(lambda r: block.init(r, x)["params"])(layer_rngs)
 
-    embed = nn.Embed(cfg.vocab_size, cfg.d_model)
-    pos_embed = nn.Embed(cfg.max_len, cfg.d_model)
-    head = nn.Dense(cfg.vocab_size, use_bias=False)
-    ln_f = nn.LayerNorm()
+    embed, pos_embed, head, ln_f = _lm_modules(cfg)
     tokens = jnp.zeros((1, sample_len), jnp.int32)
     return {
         "blocks": blocks,
