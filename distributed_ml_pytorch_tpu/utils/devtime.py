@@ -131,8 +131,15 @@ def device_time(fn, *args, calls: int = 10, warmup: int = 2,
 
     own_dir = trace_dir is None
     tdir = trace_dir or tempfile.mkdtemp(prefix="devtime_")
+    # host/python tracers OFF: only device spans matter here, and the host
+    # tracer can flood the trace's ~1M-event cap on a tunneled runtime
+    # (measured: one 2 s blocked-decode call emitted 999 997 host events and
+    # the device timeline was silently truncated to 3 spans)
+    opts = jax.profiler.ProfileOptions()
+    opts.host_tracer_level = 0
+    opts.python_tracer_level = 0
     try:
-        with jax.profiler.trace(tdir):
+        with jax.profiler.trace(tdir, profiler_options=opts):
             # every call is forced individually: an unforced intermediate
             # dispatch can land outside the trace window (observed with
             # large-footprint programs), silently dropping its span. The

@@ -129,6 +129,70 @@ def test_temperature_requires_rng_and_max_len_enforced():
         generate(model, params, prompt, 63)  # 2 + 63 > max_len 64
 
 
+def test_blocked_decode_matches_unblocked_scan():
+    """Runs long enough to use the ring-buffered block path (>= DECODE_BLOCK
+    steps, spanning several merge boundaries) must pick exactly the same
+    greedy tokens as the plain one-token scan."""
+    from distributed_ml_pytorch_tpu.models.generate import (
+        DECODE_BLOCK,
+        _decode_model,
+        _generate_jit,
+    )
+
+    model = TransformerLM(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_len=128
+    )
+    params = trained_ish_params(model)
+    prompt = jnp.asarray(
+        np.random.default_rng(2).integers(0, 64, size=(2, 5)), jnp.int32
+    )
+    n = 2 * DECODE_BLOCK + 3  # crosses two merge boundaries + a padded tail
+    blocked = generate(model, params, prompt, n)
+
+    total = 5 + n
+    cache = init_cache(model, 2, total)
+    ref = _generate_jit(
+        _decode_model(model, total), n, 0.0, 0, 1.0,
+        params, cache, prompt, jax.random.key(0)
+    )
+    np.testing.assert_array_equal(np.asarray(blocked), np.asarray(ref))
+
+
+def test_single_token_prompt_long_generation_correct():
+    """A (B, 1) prompt must NOT take the blocked path (its prefill would be
+    indistinguishable from a decode step and the prompt's K/V would be
+    orphaned in the ring — found by review); it must match the naive
+    rollout exactly."""
+    model = TransformerLM(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_len=64
+    )
+    params = trained_ish_params(model)
+    prompt = jnp.asarray([[7], [13]], jnp.int32)
+    fast = generate(model, params, prompt, 20)
+
+    seq = prompt
+    for _ in range(20):
+        logits = model.apply({"params": params}, seq)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(seq))
+
+
+def test_blocked_decode_cache_has_rings():
+    """The blocked clone's cache carries per-layer rings; the plain clone's
+    does not (the standalone one-token module contract is unchanged)."""
+    model = tiny_lm()
+    plain = init_cache(model, 1, 32)
+    ringed = init_cache(model, 1, 32, decode_block=8)
+    flat_plain = {"/".join(str(k) for k in p): v.shape
+                  for p, v in jax.tree_util.tree_leaves_with_path(plain)}
+    assert not any("ring" in k for k in flat_plain)
+    flat_ring = {jax.tree_util.keystr(p): v.shape
+                 for p, v in jax.tree_util.tree_leaves_with_path(ringed)}
+    rings = [s for k, s in flat_ring.items() if "ring_k" in k]
+    assert len(rings) == model.n_layers and all(s[2] == 8 for s in rings)
+
+
 def test_tp_sharded_decode_matches_single_device():
     """Greedy TP decode on a 2x4 dp x tp mesh must be bit-identical to the
     single-device path — same compiled program, shardings propagated."""
