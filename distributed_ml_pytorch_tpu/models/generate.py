@@ -10,15 +10,20 @@ TPU-native decode structure:
 - **Prefill** runs the whole prompt through the model in ONE call, writing
   every layer's K/V into the cache (``models/transformer.MultiHeadAttention``
   with ``decode=True``) — the MXU-friendly bulk phase.
-- **Generation** is a ``lax.scan`` over single-token steps: one compiled
-  program for the entire sampled continuation, cache threaded as carry — no
-  per-token Python dispatch, no growing shapes (the cache is statically
-  sized to ``prompt + max_new_tokens``). The per-layer cache
-  ``dynamic_update_slice``s ARE updated in place inside the scan (measured:
-  per-step time is flat in cache length; do not "optimize" them — a
-  standalone, non-carried step DOES pay a full cache copy per append, and
-  a pallas ``input_output_aliases`` append kernel still materialized
-  copies on this runtime, so the scan-carry structure is the fast path).
+- **Generation** runs single-token steps under ``lax.scan`` with NO
+  per-token Python dispatch and no growing shapes. Two compiled forms:
+  the plain path (one scan, caches as carry, one-slot
+  ``dynamic_update_slice`` appends) for short runs and edge shapes, and
+  the ring-buffered BLOCKED path (``_generate_blocked_jit``) for runs of
+  ``DECODE_BLOCK`` steps or more. The blocked path exists because the
+  one-slot append lands in the TPU's tiled sublane dimension and XLA
+  materializes full-cache copies inside the scan (profiled at GPT-2-small
+  batch 32: ~10 × 18.9 MB copies per step; a pallas
+  ``input_output_aliases`` append kernel also materialized copies on this
+  runtime) — appends go to a small per-layer ring instead, merged into
+  the big cache once per block, and the unrolled outer loop gives each
+  block a static live-prefix cache read. Measured: +45% decode
+  throughput at batch 32 (BASELINE.md #8).
 - Sampling is temperature-controlled categorical (temperature 0 → greedy
   argmax) with optional top-k and/or nucleus (top-p) truncation
   (:func:`sample_tokens`), per-step rng folded from one key, fully
@@ -91,6 +96,14 @@ def _decode_model(model, cache_size: int, decode_block: int = 0):
 #: copies amortize to ~1 big-cache copy per 16 steps while the ring stays
 #: small enough to copy cheaply inside the scan)
 DECODE_BLOCK = 16
+
+#: compile-size bound for the blocked path: its outer loop is UNROLLED (one
+#: differently-shaped inner scan per block, which is what makes each
+#: block's cache read a static live-prefix slice), so program size and
+#: compile time grow linearly with the block count. Longer generations
+#: fall back to the plain one-scan path — slower per token but O(1)
+#: compile. 64 blocks = 1024 tokens at the default ring size.
+MAX_UNROLLED_BLOCKS = 64
 
 
 def _split_cache(cache):
@@ -191,6 +204,7 @@ def generate(
     blocked = (
         hasattr(model, "decode_block")
         and n_steps >= T
+        and n_blocks <= MAX_UNROLLED_BLOCKS
         # p == 1 would make the prefill call indistinguishable from a
         # single-token decode step inside _block_cached_attention (s == 1
         # is the branch discriminator): the prompt's K/V would land in the
