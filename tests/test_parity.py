@@ -92,3 +92,30 @@ def test_same_recipe_same_weights_same_trajectory():
     # and prediction-level agreement should be near-total
     agree = float((t_pred == j_pred).mean())
     assert agree > 0.995, f"prediction agreement only {agree:.4f}"
+
+
+def test_flax_init_installs_into_torch_with_identical_forward():
+    """The flax→torch direction (``bench_all.install_flax_alexnet_init``,
+    the matched-init steps-to-target leg): installing a flax init into the
+    torch AlexNet must give the same classifier function."""
+    from bench import make_torch_alexnet
+    from bench_all import install_flax_alexnet_init
+
+    flax_model = AlexNet(num_classes=10)
+    params = flax_model.init(jax.random.key(3), jnp.zeros((1, 32, 32, 3)))[
+        "params"
+    ]
+    tmodel = make_torch_alexnet()
+    install_flax_alexnet_init(
+        tmodel, jax.tree.map(np.asarray, params)
+    )
+
+    images, _ = _batches(1, 64, seed=7)
+    with torch.no_grad():
+        t_out = tmodel(
+            torch.from_numpy(images[0].transpose(0, 3, 1, 2).copy())
+        ).numpy()
+    j_out = np.asarray(
+        flax_model.apply({"params": params}, jnp.asarray(images[0]), train=False)
+    )
+    np.testing.assert_allclose(t_out, j_out, rtol=2e-4, atol=2e-5)
