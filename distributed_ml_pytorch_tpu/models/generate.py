@@ -85,10 +85,20 @@ def sample_tokens(
     return jax.random.categorical(rng, logits, axis=-1)
 
 
-def _decode_model(model, cache_size: int, decode_block: int = 0):
+def _decode_model(model, cache_size: int, decode_block: int = 0,
+                  kv_quant: bool = False):
     kw = {}
     if decode_block and hasattr(model, "decode_block"):
         kw["decode_block"] = decode_block
+        if kv_quant and hasattr(model, "kv_quant"):
+            kw["kv_quant"] = True
+    elif kv_quant:
+        # never swallow the request: an int8 cache only exists under the
+        # blocked path, and a caller sizing batch/context for the halved
+        # footprint must not silently get the full-size exact cache
+        raise ValueError(
+            "kv_quant=True requires decode_block > 0 (int8 quantization "
+            "happens at block merges; generate() enables both together)")
     return model.clone(decode=True, cache_size=cache_size, attn_fn=None, **kw)
 
 
@@ -120,7 +130,7 @@ def _split_cache(cache):
                 big[name] = b
             if s:
                 small[name] = s
-        elif name in ("cached_k", "cached_v"):
+        elif name in ("cached_k", "cached_v", "scale_k", "scale_v"):
             big[name] = val
         else:
             small[name] = val
@@ -152,10 +162,12 @@ def _check_max_len(model, total: int) -> None:
         )
 
 
-def init_cache(model, batch: int, cache_size: int, decode_block: int = 0):
+def init_cache(model, batch: int, cache_size: int, decode_block: int = 0,
+               kv_quant: bool = False):
     """Allocate the per-layer K/V cache (zeros, cursor at 0) for ``batch``
     sequences of total length ``cache_size``."""
-    dec = _decode_model(model, cache_size, decode_block=decode_block)
+    dec = _decode_model(model, cache_size, decode_block=decode_block,
+                        kv_quant=kv_quant)
     variables = jax.eval_shape(
         lambda: dec.init(
             jax.random.key(0),
@@ -175,6 +187,7 @@ def generate(
     rng: Optional[jax.Array] = None,
     top_k: int = 0,
     top_p: float = 1.0,
+    kv_quant: bool = False,
 ) -> jnp.ndarray:
     """Sample ``max_new_tokens`` continuations of ``prompt`` ([B, P] int32).
 
@@ -183,7 +196,11 @@ def generate(
     required) with optional ``top_k`` / nucleus ``top_p`` truncation
     (:func:`sample_tokens`). Jit-compiled end-to-end: one prefill program +
     one scanned generation program, both cached across calls with the same
-    shapes.
+    shapes. ``kv_quant=True`` stores completed blocks' K/V as int8 with
+    per-key scales (half the dominant decode HBM read; small quantization
+    noise on cross-block attention only) — it applies only when the
+    blocked path runs; shapes that fall back to the plain scan keep the
+    exact cache.
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature > 0 sampling needs an rng key")
@@ -215,12 +232,16 @@ def generate(
              or padded_total <= getattr(model, "max_len", padded_total))
     )
     if blocked:
-        cache = init_cache(model, b, padded_total, decode_block=T)
-        dec = _decode_model(model, padded_total, decode_block=T)
+        cache = init_cache(model, b, padded_total, decode_block=T,
+                           kv_quant=kv_quant)
+        dec = _decode_model(model, padded_total, decode_block=T,
+                            kv_quant=kv_quant)
         return _generate_blocked_jit(
             dec, int(max_new_tokens), float(temperature), int(top_k),
             float(top_p), params, cache, prompt, rng
         )
+    # kv_quant needs the blocked structure (quantize-at-merge); the plain
+    # scan keeps the exact bf16 cache — a silent upgrade in accuracy
     cache = init_cache(model, b, total)
     dec = _decode_model(model, total)
     return _generate_jit(
@@ -334,28 +355,41 @@ def _generate_jit(dec, max_new_tokens, temperature, top_k, top_p,
 
 def _tree_slice_big(big, live):
     """Static live-prefix view of every big cache: (b, h, C, d) -> (b, h,
-    live, d). A static slice fuses into the attention read, so each block
-    reads exactly the K/V written so far instead of the full padded cache."""
-    return jax.tree.map(lambda a: a[:, :, :live, :], big)
+    live, d), and (b, h, C) scale arrays -> (b, h, live). A static slice
+    fuses into the attention read, so each block reads exactly the K/V
+    written so far instead of the full padded cache."""
+    return jax.tree.map(
+        lambda a: a[:, :, :live, :] if a.ndim == 4 else a[:, :, :live], big)
 
 
 def _tree_merge_static(big, small, live):
     """Merge every layer's ring into its FULL big cache at static offset
     ``live``; returns the updated big pytree (rings themselves are reused —
-    the next block's strict ring mask hides stale slots)."""
-    new_big = {}
-    for name, val in big.items():
-        if isinstance(val, dict):
-            new_big[name] = _tree_merge_static(val, small.get(name, {}), live)
-        elif name == "cached_k":
-            new_big[name] = jax.lax.dynamic_update_slice(
-                val, small["ring_k"], (0, 0, live, 0))
-        elif name == "cached_v":
-            new_big[name] = jax.lax.dynamic_update_slice(
-                val, small["ring_v"], (0, 0, live, 0))
-        else:
-            new_big[name] = val
-    return new_big
+    the next block's strict ring mask hides stale slots). Quantized caches
+    (``kv_quant``: int8 values + scale arrays present) quantize the exact
+    bf16 ring here, once per block."""
+    if "cached_k" in big:
+        from distributed_ml_pytorch_tpu.models.transformer import quantize_kv
+
+        out = dict(big)
+        rk, rv = small["ring_k"], small["ring_v"]
+        if "scale_k" in big:
+            rk, ks = quantize_kv(rk)
+            rv, vs = quantize_kv(rv)
+            out["scale_k"] = jax.lax.dynamic_update_slice(
+                big["scale_k"], ks, (0, 0, live))
+            out["scale_v"] = jax.lax.dynamic_update_slice(
+                big["scale_v"], vs, (0, 0, live))
+        out["cached_k"] = jax.lax.dynamic_update_slice(
+            big["cached_k"], rk, (0, 0, live, 0))
+        out["cached_v"] = jax.lax.dynamic_update_slice(
+            big["cached_v"], rv, (0, 0, live, 0))
+        return out
+    return {
+        name: (_tree_merge_static(val, small.get(name, {}), live)
+               if isinstance(val, dict) else val)
+        for name, val in big.items()
+    }
 
 
 def _reset_small(small, live):

@@ -193,6 +193,57 @@ def test_blocked_decode_cache_has_rings():
     assert len(rings) == model.n_layers and all(s[2] == 8 for s in rings)
 
 
+def test_quantize_kv_roundtrip_error_bounded():
+    from distributed_ml_pytorch_tpu.models.transformer import quantize_kv
+
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 4, 16, 32)) * 0.3,
+        jnp.bfloat16,
+    )
+    q8, scale = quantize_kv(x)
+    assert q8.dtype == jnp.int8 and scale.shape == (2, 4, 16)
+    deq = np.asarray(q8, np.float32) * np.asarray(scale)[..., None]
+    err = np.abs(deq - np.asarray(x, np.float32))
+    # absmax/127 per key is the quantization step; error <= half a step
+    # plus bf16 rounding slack
+    bound = np.asarray(scale)[..., None] * 0.51 + 1e-6
+    assert (err <= bound).all()
+
+
+def test_kv_quant_decode_deterministic_and_prefill_exact():
+    """int8-cache decode must be deterministic, stay in-vocab, and agree
+    with the exact-cache path on the FIRST generated token (the quantized
+    prefill attends with the in-hand exact K/V, so prompt logits carry no
+    quantization noise). Later tokens may legitimately drift on a
+    random-init model whose logits have near-ties."""
+    model = TransformerLM(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_len=128
+    )
+    params = trained_ish_params(model)
+    prompt = jnp.asarray(
+        np.random.default_rng(5).integers(0, 64, size=(2, 6)), jnp.int32
+    )
+    exact = generate(model, params, prompt, 40)
+    q1 = generate(model, params, prompt, 40, kv_quant=True)
+    q2 = generate(model, params, prompt, 40, kv_quant=True)
+    np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+    assert q1.shape == exact.shape
+    assert int(q1.max()) < 64 and int(q1.min()) >= 0
+    np.testing.assert_array_equal(np.asarray(q1[:, 6]), np.asarray(exact[:, 6]))
+
+
+def test_kv_quant_cache_is_int8_with_scales():
+    model = tiny_lm()
+    cache = init_cache(model, 2, 32, decode_block=8, kv_quant=True)
+    leaves = {jax.tree_util.keystr(p): v
+              for p, v in jax.tree_util.tree_leaves_with_path(cache)}
+    big = [v for k, v in leaves.items() if "cached_k" in k]
+    scales = [v for k, v in leaves.items() if "scale_k" in k]
+    assert big and all(v.dtype == jnp.int8 for v in big)
+    assert scales and all(
+        v.dtype == jnp.float32 and v.shape == (2, 4, 32) for v in scales)
+
+
 def test_tp_sharded_decode_matches_single_device():
     """Greedy TP decode on a 2x4 dp x tp mesh must be bit-identical to the
     single-device path — same compiled program, shardings propagated."""
