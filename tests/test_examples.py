@@ -69,3 +69,52 @@ def test_train_lm_chunked_loss_matches_dense(capsys):
         assert m
         outs.append(float(m.group(1)))
     assert abs(outs[0] - outs[1]) < 1e-3, outs
+
+
+def test_generate_text_example_greedy_and_sampled(capsys):
+    from examples.generate_text import main as gen_main
+
+    small = ["--batch", "1", "--prompt-len", "8", "--vocab", "64",
+             "--d-model", "32", "--n-heads", "4", "--n-layers", "1",
+             "--d-ff", "64"]
+    assert gen_main(small + ["--new-tokens", "20"]) == 0
+    out = capsys.readouterr().out
+    assert "sampled:" in out and "decode (" in out
+    assert gen_main(small + ["--new-tokens", "20", "--temperature", "0.9",
+                             "--top-k", "10", "--top-p", "0.9"]) == 0
+    assert gen_main(small + ["--new-tokens", "20", "--kv-quant"]) == 0
+    out = capsys.readouterr().out
+    assert "int8 KV cache" in out
+
+
+def test_generate_text_restores_train_lm_checkpoint(tmp_path, capsys):
+    from examples.generate_text import main as gen_main
+
+    model = ["--vocab", "64", "--d-model", "32", "--n-heads", "4",
+             "--n-layers", "1", "--d-ff", "64"]
+    assert main(["--mode", "single", "--steps", "2", "--batch", "4",
+                 "--seq", "32",
+                 "--ckpt-dir", str(tmp_path / "c"), "--ckpt-every", "1"]
+                + model) == 0
+    capsys.readouterr()
+    assert gen_main(model + ["--batch", "1", "--prompt-len", "8",
+                             "--new-tokens", "12",
+                             "--ckpt-dir", str(tmp_path / "c")]) == 0
+    # a table-size mismatch must fail loudly, and --max-len must fix it
+    with pytest.raises(Exception):
+        gen_main(model + ["--batch", "1", "--prompt-len", "8",
+                          "--new-tokens", "12", "--max-len", "300",
+                          "--ckpt-dir", str(tmp_path / "c")])
+    out = capsys.readouterr().out
+    assert "restored params from step 2" in out
+
+
+def test_generate_text_rejects_bad_flags(capsys):
+    from examples.generate_text import main as gen_main
+
+    with pytest.raises(SystemExit):
+        gen_main(["--top-k", "5"])  # sampling knobs without temperature
+    with pytest.raises(SystemExit):
+        gen_main(["--d-model", "30", "--n-heads", "4"])
+    with pytest.raises(SystemExit):
+        gen_main(["--tp", "2", "--kv-quant"])  # silently-exact combination
