@@ -28,6 +28,13 @@ TPU-native decode structure:
   argmax) with optional top-k and/or nucleus (top-p) truncation
   (:func:`sample_tokens`), per-step rng folded from one key, fully
   deterministic given ``(params, prompt, rng)``.
+
+Numerics contract: blocked and plain paths compute the same attention
+mathematically and are bit-identical on CPU (tested). On the TPU's MXU the
+blocked path's three-part score concat and the fused QKV matmul reorder
+f32 accumulation in the low bits, so greedy tokens can diverge after a few
+steps when a near-random model has logit near-ties — the standard fused-
+kernel float-order caveat, quality-neutral on trained models.
 """
 
 from __future__ import annotations
@@ -85,11 +92,30 @@ def sample_tokens(
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def _fuse_qkv_params(params):
+    """Rewrite a trained param tree into the ``fused_qkv`` module layout:
+    every attention dict {q, k, v, o} becomes {qkv, o} with the three
+    kernels concatenated on the output axis (``y[..., :d] == x @ W_q``
+    etc., bit-compatible column blocks). Runs INSIDE the decode jit, so
+    checkpoints and callers keep the unfused layout; the concat is
+    loop-invariant and XLA hoists it out of the token scans."""
+    if isinstance(params, dict) and {"q", "k", "v", "o"} <= set(params):
+        out = {n: v for n, v in params.items() if n not in ("q", "k", "v")}
+        out["qkv"] = {"kernel": jnp.concatenate(
+            [params[n]["kernel"] for n in ("q", "k", "v")], axis=-1)}
+        return out
+    if isinstance(params, dict):
+        return {n: _fuse_qkv_params(v) for n, v in params.items()}
+    return params
+
+
 def _decode_model(model, cache_size: int, decode_block: int = 0,
                   kv_quant: bool = False):
     kw = {}
     if decode_block and hasattr(model, "decode_block"):
         kw["decode_block"] = decode_block
+        if hasattr(model, "fused_qkv"):
+            kw["fused_qkv"] = True
         if kv_quant and hasattr(model, "kv_quant"):
             kw["kv_quant"] = True
     elif kv_quant:
@@ -178,6 +204,36 @@ def init_cache(model, batch: int, cache_size: int, decode_block: int = 0,
     return jax.tree.map(jnp.zeros_like, variables["cache"])
 
 
+def uses_block_decode(model, prompt_len: int,
+                      max_new_tokens: int) -> Tuple[bool, int]:
+    """Whether :func:`generate` will take the ring-buffered block path for
+    this shape, plus the padded cache allocation it would use. Public so
+    callers that REQUIRE block-path behavior (``kv_quant`` only applies
+    there) can check instead of trusting a silent fallback.
+
+    The blocked path pads the step loop to a multiple of ``DECODE_BLOCK``;
+    it runs when the generation is long enough to amortize a block, short
+    enough to bound the unrolled compile, the padding fits the learned
+    position table (RoPE is unbounded), and the prompt has more than one
+    token — a one-token prompt's prefill would be indistinguishable from a
+    single-token decode step inside ``_block_cached_attention`` (``s == 1``
+    is the branch discriminator) and its K/V would be orphaned in the ring.
+    """
+    T = DECODE_BLOCK
+    n_steps = max_new_tokens - 1
+    n_blocks = -(-n_steps // T)
+    padded_total = prompt_len + n_blocks * T
+    blocked = (
+        hasattr(model, "decode_block")
+        and n_steps >= T
+        and n_blocks <= MAX_UNROLLED_BLOCKS
+        and prompt_len > 1
+        and (getattr(model, "pos_encoding", "learned") == "rope"
+             or padded_total <= getattr(model, "max_len", padded_total))
+    )
+    return blocked, padded_total
+
+
 def generate(
     model,
     params,
@@ -211,30 +267,11 @@ def generate(
     if max_new_tokens < 1:
         return prompt
 
-    # blocked decode pads the step loop to a multiple of DECODE_BLOCK; use
-    # it when the padding fits the position-embedding table (RoPE is
-    # unbounded) and the run is long enough to amortize a block
-    T = DECODE_BLOCK
-    n_steps = max_new_tokens - 1
-    n_blocks = -(-n_steps // T)
-    padded_total = p + n_blocks * T
-    blocked = (
-        hasattr(model, "decode_block")
-        and n_steps >= T
-        and n_blocks <= MAX_UNROLLED_BLOCKS
-        # p == 1 would make the prefill call indistinguishable from a
-        # single-token decode step inside _block_cached_attention (s == 1
-        # is the branch discriminator): the prompt's K/V would land in the
-        # ring and be orphaned by the first block reset. One-token prompts
-        # take the plain scan.
-        and p > 1
-        and (getattr(model, "pos_encoding", "learned") == "rope"
-             or padded_total <= getattr(model, "max_len", padded_total))
-    )
+    blocked, padded_total = uses_block_decode(model, p, max_new_tokens)
     if blocked:
-        cache = init_cache(model, b, padded_total, decode_block=T,
+        cache = init_cache(model, b, padded_total, decode_block=DECODE_BLOCK,
                            kv_quant=kv_quant)
-        dec = _decode_model(model, padded_total, decode_block=T,
+        dec = _decode_model(model, padded_total, decode_block=DECODE_BLOCK,
                             kv_quant=kv_quant)
         return _generate_blocked_jit(
             dec, int(max_new_tokens), float(temperature), int(top_k),
@@ -433,6 +470,8 @@ def _generate_blocked_jit(dec, max_new_tokens, temperature, top_k, top_p,
     b, p = prompt.shape
     n_steps = max_new_tokens - 1
     n_blocks = -(-n_steps // T)
+    if getattr(dec, "fused_qkv", False):
+        params = _fuse_qkv_params(params)
 
     positions = jnp.arange(p)[None, :]
     logits, mutated = dec.apply(

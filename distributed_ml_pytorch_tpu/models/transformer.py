@@ -112,6 +112,13 @@ class MultiHeadAttention(nn.Module):
     #: read TIME stays ~flat, so this is a capacity knob on this runtime,
     #: not a speed knob (19.2k tok/s bf16 vs 18.3k int8).
     kv_quant: bool = False
+    #: decode-path knob: compute q/k/v with ONE (d_model, 3*d_model) matmul
+    #: instead of three — one weight DMA per layer per step instead of
+    #: three, targeting the measured weight-stall share of the decode step.
+    #: Param tree changes shape (attn/qkv instead of attn/{q,k,v});
+    #: models/generate.py fuses trained q/k/v kernels on the fly
+    #: (_fuse_qkv_params), so checkpoints stay in the unfused layout.
+    fused_qkv: bool = False
 
     @nn.compact
     def __call__(self, x, positions=None):
@@ -119,7 +126,13 @@ class MultiHeadAttention(nn.Module):
         head_dim = self.d_model // self.n_heads
         proj = lambda name: nn.Dense(self.d_model, use_bias=False, dtype=self.dtype, name=name)
         split = lambda t: t.reshape(b, s, self.n_heads, head_dim).transpose(0, 2, 1, 3)
-        q, k, v = (split(proj(n)(x)) for n in ("q", "k", "v"))
+        if self.fused_qkv:
+            qkv = nn.Dense(3 * self.d_model, use_bias=False, dtype=self.dtype,
+                           name="qkv")(x)
+            q, k, v = (split(qkv[..., i * self.d_model:(i + 1) * self.d_model])
+                       for i in range(3))
+        else:
+            q, k, v = (split(proj(n)(x)) for n in ("q", "k", "v"))
         if self.rope:
             if positions is None:
                 raise ValueError("rope=True needs the tokens' global positions")
@@ -328,6 +341,7 @@ class Block(nn.Module):
     rope: bool = False
     decode_block: int = 0
     kv_quant: bool = False
+    fused_qkv: bool = False
 
     @nn.compact
     def __call__(self, x, positions=None):
@@ -336,7 +350,7 @@ class Block(nn.Module):
             self.d_model, self.n_heads, self.dtype, self.attn_fn,
             decode=self.decode, cache_size=self.cache_size, rope=self.rope,
             decode_block=self.decode_block, kv_quant=self.kv_quant,
-            name="attn",
+            fused_qkv=self.fused_qkv, name="attn",
         )(h, positions)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.d_ff, dtype=self.dtype)(h)
@@ -361,6 +375,7 @@ class TransformerLM(nn.Module):
     cache_size: int = 0
     decode_block: int = 0
     kv_quant: bool = False
+    fused_qkv: bool = False
     remat: bool = False
     pos_encoding: str = "learned"  # "learned" (table) | "rope" (rotary in-attn)
     #: head=False returns the post-LayerNorm hidden states instead of
@@ -392,7 +407,7 @@ class TransformerLM(nn.Module):
                 self.d_model, self.n_heads, self.d_ff, self.dtype, self.attn_fn,
                 decode=self.decode, cache_size=self.cache_size, rope=use_rope,
                 decode_block=self.decode_block, kv_quant=self.kv_quant,
-                name=f"block_{i}",
+                fused_qkv=self.fused_qkv, name=f"block_{i}",
             )(x, positions)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if not self.head:

@@ -78,6 +78,8 @@ def main(argv=None) -> int:
     if args.tp and args.kv_quant:
         parser.error("--kv-quant is not supported with --tp (generate_tp "
                      "runs the exact-cache path) — drop one of the flags")
+    # the kv_quant guard against runs the blocked path cannot serve lives
+    # below (it needs the constructed model)
 
     import time
 
@@ -99,10 +101,22 @@ def main(argv=None) -> int:
         dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
         pos_encoding=args.pos_encoding,
     )
-    params = lm.init(
-        jax.random.key(args.seed), jnp.zeros((1, 8), jnp.int32)
-    )["params"]
-    if args.ckpt_dir:
+    if args.kv_quant:
+        from distributed_ml_pytorch_tpu.models.generate import uses_block_decode
+
+        blocked, _ = uses_block_decode(lm, args.prompt_len, args.new_tokens)
+        if not blocked:
+            parser.error(
+                "--kv-quant only applies on the ring-buffered block path "
+                "(>= 16 new tokens, prompt length > 1, <= 1024 tokens, and "
+                "the padded run must fit --max-len) — this shape would "
+                "silently run the exact cache")
+
+    if not args.ckpt_dir:
+        params = lm.init(
+            jax.random.key(args.seed), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+    else:
         from distributed_ml_pytorch_tpu.utils.checkpoint import Checkpointer
 
         with Checkpointer(args.ckpt_dir) as ckpt:
@@ -118,8 +132,10 @@ def main(argv=None) -> int:
                 create_lm_train_state,
             )
 
-            template = create_lm_train_state(
-                lm, jax.random.key(args.seed), optax.sgd(0.1))
+            # abstract template: no wasted full init before orbax
+            # overwrites everything (Checkpointer.restore accepts shapes)
+            template = jax.eval_shape(lambda: create_lm_train_state(
+                lm, jax.random.key(args.seed), optax.sgd(0.1)))
             state, step = ckpt.restore(template)
             params = state.params
             print(f"restored params from step {step} of {args.ckpt_dir}")
