@@ -185,12 +185,22 @@ class MoEBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     router_top_k: int = 1
     attn_fn: Optional[Callable] = None
+    decode: bool = False
+    cache_size: int = 0
+    decode_block: int = 0
+    kv_quant: bool = False
+    fused_qkv: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None):
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + MultiHeadAttention(self.d_model, self.n_heads, self.dtype,
-                                   self.attn_fn, name="attn")(h)
+                                   self.attn_fn, decode=self.decode,
+                                   cache_size=self.cache_size,
+                                   decode_block=self.decode_block,
+                                   kv_quant=self.kv_quant,
+                                   fused_qkv=self.fused_qkv,
+                                   name="attn")(h, positions)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + MoEMLP(
             self.d_model, self.d_ff, self.n_experts, self.capacity_factor,
@@ -214,6 +224,20 @@ class MoETransformerLM(nn.Module):
     remat: bool = False
     router_top_k: int = 1
     attn_fn: Optional[Callable] = None
+    #: decode support (models/generate.py): same contract as TransformerLM —
+    #: the attention caches K/V; the MoE FFN needs no cache at all (routing
+    #: is per token, and a single-token step's capacity floor of 1 slot per
+    #: expert can never drop the token). Semantic note: because decode
+    #: steps never drop, decode logits match the teacher-forced forward
+    #: exactly ONLY where the full forward didn't drop tokens to capacity —
+    #: over-capacity prompts route more tokens through expert FFNs at
+    #: decode time than they did in training's forward (tested with a
+    #: drop-free capacity in tests/test_moe_topk.py)
+    decode: bool = False
+    cache_size: int = 0
+    decode_block: int = 0
+    kv_quant: bool = False
+    fused_qkv: bool = False
 
     @nn.compact
     def __call__(self, tokens, positions=None):
@@ -221,13 +245,16 @@ class MoETransformerLM(nn.Module):
             positions = jnp.arange(tokens.shape[-1])[None, :]
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="tok_embed")(tokens)
         x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype, name="pos_embed")(positions)
-        block_cls = nn.remat(MoEBlock) if self.remat else MoEBlock
+        block_cls = nn.remat(MoEBlock) if self.remat and not self.decode else MoEBlock
         for i in range(self.n_layers):
             x = block_cls(
                 self.d_model, self.n_heads, self.d_ff, self.n_experts,
                 self.capacity_factor, self.dtype,
                 router_top_k=self.router_top_k, attn_fn=self.attn_fn,
+                decode=self.decode, cache_size=self.cache_size,
+                decode_block=self.decode_block, kv_quant=self.kv_quant,
+                fused_qkv=self.fused_qkv,
                 name=f"block_{i}",
-            )(x)
+            )(x, positions)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head")(x)

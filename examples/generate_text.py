@@ -15,7 +15,7 @@ the model flags must match the training run — the restore validates
 shapes). Without it, sampling runs from a fresh init: useless text, but the
 full compiled path, which is what the example demonstrates.
 
-Decode runs the ring-buffered block path for 16+ token runs (per-step ring
+Decode runs the ring-buffered block path for 17+ token runs (per-step ring
 appends, static live-prefix cache reads, once-per-block merges — see
 ``models/generate.py``); ``--kv-quant`` stores completed blocks as int8 +
 per-key scales for half the cache footprint.
@@ -108,8 +108,8 @@ def main(argv=None) -> int:
         if not blocked:
             parser.error(
                 "--kv-quant only applies on the ring-buffered block path "
-                "(>= 16 new tokens, prompt length > 1, <= 1024 tokens, and "
-                "the padded run must fit --max-len) — this shape would "
+                "(>= 17 new tokens, prompt length > 1, <= 1025 new tokens, "
+                "and the padded run must fit --max-len) — this shape would "
                 "silently run the exact cache")
 
     if not args.ckpt_dir:
