@@ -22,8 +22,9 @@ TPU-native decode structure:
   ``input_output_aliases`` append kernel also materialized copies on this
   runtime) — appends go to a small per-layer ring instead, merged into
   the big cache once per block, and the unrolled outer loop gives each
-  block a static live-prefix cache read. Measured: +45% decode
-  throughput at batch 32 (BASELINE.md #8).
+  block a static live-prefix cache read. Measured with the fused QKV
+  projection: +53% decode throughput at batch 32 and 97% of the measured
+  HBM streaming roofline at batch 8 (BASELINE.md #8).
 - Sampling is temperature-controlled categorical (temperature 0 → greedy
   argmax) with optional top-k and/or nucleus (top-p) truncation
   (:func:`sample_tokens`), per-step rng folded from one key, fully
@@ -465,7 +466,8 @@ def _generate_blocked_jit(dec, max_new_tokens, temperature, top_k, top_p,
     The step loop is padded to a whole number of blocks; padded steps
     sample garbage the caller never sees (their K/V lands after every real
     token's, so no real attention read touches it). Net effect at batch 32:
-    2.43 ms/step -> ~1.3 ms/step (see BASELINE.md #8)."""
+    2.43 ms/step -> ~1.26 ms/step with the fused QKV projection (see
+    BASELINE.md #8)."""
     T = dec.decode_block
     b, p = prompt.shape
     n_steps = max_new_tokens - 1

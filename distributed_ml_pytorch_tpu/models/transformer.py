@@ -110,7 +110,7 @@ class MultiHeadAttention(nn.Module):
     #: time, but inside the full decode program the fused
     #: convert+dequantize read drops to ~half the bf16 GB/s — bytes halve,
     #: read TIME stays ~flat, so this is a capacity knob on this runtime,
-    #: not a speed knob (19.2k tok/s bf16 vs 18.3k int8).
+    #: not a speed knob (20.2k tok/s bf16 vs 18.8k int8, fused-QKV path).
     kv_quant: bool = False
     #: decode-path knob: compute q/k/v with ONE (d_model, 3*d_model) matmul
     #: instead of three — one weight DMA per layer per step instead of
