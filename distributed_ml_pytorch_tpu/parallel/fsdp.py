@@ -281,10 +281,9 @@ def lm_loss_builder(model, loss_chunk: int = 0) -> Callable:
     ``seq_parallel.next_token_targets`` convention) as a
     :func:`make_sharded_step` loss builder — one definition for the fsdp-LM
     and composite paths. ``loss_chunk > 0`` routes through the
-    sequence-chunked formulation (no full logits tensor; exact-equality
-    tested in f32 — under bf16 activations the chunked path's CE runs on
-    f32-upcast logits where the dense path's runs in bf16, a small
-    numerics difference in the chunked path's FAVOR)."""
+    sequence-chunked formulation (no full logits tensor; both paths share
+    the same convention — 2-D logits in the activation dtype — so exact
+    equality is tested in f32 and the bf16 numerics match too)."""
 
     def loss_builder(state, tokens, targets):
         if loss_chunk > 0:

@@ -244,9 +244,16 @@ def chunked_lm_loss(model, params, tokens, targets, chunk: int = 2048):
 
     @jax.checkpoint
     def chunk_ce(w_, h_c, t_c, m_c):
-        logits = (h_c @ w_.astype(h_c.dtype)).astype(jnp.float32)
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits, t_c)
-        return jnp.sum(ce * m_c)
+        # 2-D logits in the activation dtype — the same convention as the
+        # dense loss (fsdp.lm_loss_builder): the old per-chunk f32 upcast
+        # materialized a 412 MB f32 logits buffer per 2048-token chunk at
+        # GPT-2-small shapes (2x the bf16 bytes through HBM, twice per
+        # step under the checkpoint's recompute)
+        b_, c_, d_ = h_c.shape
+        logits = h_c.reshape(b_ * c_, d_) @ w_.astype(h_c.dtype)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, t_c.reshape(-1))
+        return jnp.sum(ce * m_c.reshape(-1))
 
     def body(carry, xs):
         h_c, t_c, m_c = xs
