@@ -282,8 +282,10 @@ def lm_loss_builder(model, loss_chunk: int = 0) -> Callable:
     :func:`make_sharded_step` loss builder — one definition for the fsdp-LM
     and composite paths. ``loss_chunk > 0`` routes through the
     sequence-chunked formulation (no full logits tensor; both paths share
-    the same convention — 2-D logits in the activation dtype — so exact
-    equality is tested in f32 and the bf16 numerics match too)."""
+    the same logits convention — 2-D, activation dtype — with exact
+    equality tested in f32; under bf16 the chunked path's f32 mask and
+    per-chunk f32 sums still differ from the dense path by bf16 rounding
+    only)."""
 
     def loss_builder(state, tokens, targets):
         if loss_chunk > 0:

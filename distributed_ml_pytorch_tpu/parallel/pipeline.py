@@ -41,8 +41,13 @@ Design:
   work) at the price of ×v cross-stage traffic and a wrap FIFO. The two
   schedules compute the same function (tested: identical loss and grads).
 
-Composes with data parallelism by adding a ``data`` mesh axis: microbatches
-are additionally split over it and the loss psum covers both axes.
+Composes with data parallelism (``data_axis=...``): each data row of a
+``(data, stage)`` mesh runs the full schedule on its shard of every
+microbatch (``(M, B, S)`` split over B), the per-row losses ``pmean`` over
+data, and the param cotangents — auto-psum'd over data by AD because the
+``P(stage, ...)`` params enter data-invariant — are divided into the mean.
+All three schedules are loss- and grad-identical to the pure-pp step on
+the same global batch (tested).
 """
 
 from __future__ import annotations
@@ -146,17 +151,42 @@ def pp_param_specs(tree, stage_axis: str = "stage"):
     return jax.tree_util.tree_map_with_path(spec_for, tree)
 
 
-def _wrap_pp_step(grad_fn, tx, mesh, stage_axis):
+def _wrap_pp_step(grad_fn, tx, mesh, stage_axis, data_axis=None):
     """``(state, tokens_mb, targets_mb) → (state, loss)`` from a shard_map-
     able ``grad_fn(params, tokens_mb, targets_mb) → (loss, grads)`` — the
-    one optimizer-update epilogue shared by all three schedule builders."""
+    one optimizer-update epilogue shared by all three schedule builders.
+
+    With ``data_axis`` (dp x pp): each data row of the mesh runs the full
+    pipeline schedule on its shard of every microbatch (``(M, B, S)`` split
+    over B). The per-row LOSS is ``pmean``ed over the data axis; the param
+    GRADS are already auto-psum'd over data by AD (params enter
+    data-invariant) and are divided by the data-axis size into the mean —
+    do NOT replace the divide with a pmean (identity on the summed tree;
+    measured to leave grads exactly 2x at dp=2). Params stay
+    ``P(stage, ...)`` (replicated over data)."""
 
     def step(state: TrainState, tokens_mb, targets_mb):
         param_specs = pp_param_specs(state.params, stage_axis)
+        if data_axis is not None:
+            n_data = int(mesh.shape[data_axis])
+
+            def fn(params, t, y):
+                loss, grads = grad_fn(params, t, y)
+                # params enter data-INVARIANT (P(stage, ...)), so AD has
+                # already psum'd their cotangents over the data axis — a
+                # pmean here would be an identity on the summed tree
+                # (measured: it left grads exactly 2x at dp=2). Divide the
+                # auto-summed grads into the mean instead.
+                grads = jax.tree.map(lambda g: g / n_data, grads)
+                return jax.lax.pmean(loss, data_axis), grads
+
+            batch_spec = P(None, data_axis)
+        else:
+            fn, batch_spec = grad_fn, P()
         loss, grads = jax.shard_map(
-            grad_fn,
+            fn,
             mesh=mesh,
-            in_specs=(param_specs, P(), P()),
+            in_specs=(param_specs, batch_spec, batch_spec),
             out_specs=(P(), param_specs),
         )(state.params, tokens_mb, targets_mb)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
@@ -232,6 +262,7 @@ def make_pp_train_step(
     stage_axis: str = "stage",
     schedule: str = "gpipe",
     virtual_stages: int = 1,
+    data_axis: str | None = None,
 ) -> Callable:
     """Build the jitted PP LM step: ``(state, tokens_mb, targets_mb) → (state, loss)``.
 
@@ -259,16 +290,22 @@ def make_pp_train_step(
             f"n_layers={cfg.n_layers} must divide evenly over {n_stages} stages"
         )
     M = int(n_microbatches)
+    if data_axis is not None and data_axis not in mesh.shape:
+        raise ValueError(f"data_axis {data_axis!r} is not in the mesh "
+                         f"(axes: {dict(mesh.shape)})")
     if schedule == "interleaved":
         return _make_interleaved_step(
-            cfg, tx, mesh, M, stage_axis, int(virtual_stages))
+            cfg, tx, mesh, M, stage_axis, int(virtual_stages), data_axis)
     if schedule == "1f1b":
-        return _make_1f1b_step(cfg, tx, mesh, M, stage_axis)
+        return _make_1f1b_step(cfg, tx, mesh, M, stage_axis, data_axis)
     if schedule != "gpipe":
         raise ValueError(
             f"schedule must be 'gpipe', '1f1b' or 'interleaved', got {schedule!r}")
     embed, pos_embed, head, ln_f = _lm_modules(cfg)
     fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+    # scan carries mix with batch activations, which vary over BOTH mesh
+    # axes under dp x pp — the carry's varying axes must match
+    vma_axes = (stage_axis,) if data_axis is None else (stage_axis, data_axis)
 
     def pipeline_loss(params, tokens_mb, targets_mb):
         s = jax.lax.axis_index(stage_axis)
@@ -311,7 +348,7 @@ def make_pp_train_step(
         # carry-type invariance under shard_map
         carry0 = jax.lax.pcast(
             (jnp.zeros((mb, seq, cfg.d_model)), jnp.zeros(()), jnp.zeros(())),
-            stage_axis,
+            vma_axes,
             to="varying",
         )
         (_, loss_sum, count), _ = jax.lax.scan(
@@ -322,10 +359,11 @@ def make_pp_train_step(
         count = jax.lax.psum(count, stage_axis)
         return loss_sum / count
 
-    return _wrap_pp_step(jax.value_and_grad(pipeline_loss), tx, mesh, stage_axis)
+    return _wrap_pp_step(jax.value_and_grad(pipeline_loss), tx, mesh,
+                         stage_axis, data_axis)
 
 
-def _make_interleaved_step(cfg, tx, mesh, M, stage_axis, v):
+def _make_interleaved_step(cfg, tx, mesh, M, stage_axis, v, data_axis=None):
     """The interleaved-schedule step (see make_pp_train_step's docstring)."""
     S = int(mesh.shape[stage_axis])
     if cfg.n_layers % (S * v):
@@ -344,6 +382,7 @@ def _make_interleaved_step(cfg, tx, mesh, M, stage_axis, v):
 
     embed, pos_embed, head, ln_f = _lm_modules(cfg)
     ring = [(i, (i + 1) % S) for i in range(S)]
+    vma_axes = (stage_axis,) if data_axis is None else (stage_axis, data_axis)
 
     def pipeline_loss(params, tokens_mb, targets_mb):
         s = jax.lax.axis_index(stage_axis)
@@ -409,14 +448,15 @@ def _make_interleaved_step(cfg, tx, mesh, M, stage_axis, v):
         carry0 = jax.lax.pcast(
             (jnp.zeros((mb, seq, cfg.d_model)), buf0, jnp.zeros(()),
              jnp.zeros(())),
-            stage_axis, to="varying")
+            vma_axes, to="varying")
         (_, _, loss_sum, count), _ = jax.lax.scan(
             tick, carry0, jnp.arange(T))
         loss_sum = jax.lax.psum(loss_sum, stage_axis)
         count = jax.lax.psum(count, stage_axis)
         return loss_sum / count
 
-    return _wrap_pp_step(jax.value_and_grad(pipeline_loss), tx, mesh, stage_axis)
+    return _wrap_pp_step(jax.value_and_grad(pipeline_loss), tx, mesh,
+                         stage_axis, data_axis)
 
 
 def oneF1B_tick_roles(t, s, S: int, M: int):
@@ -448,7 +488,7 @@ def oneF1B_tick_roles(t, s, S: int, M: int):
     return m_f, m_b
 
 
-def _make_1f1b_step(cfg, tx, mesh, M, stage_axis):
+def _make_1f1b_step(cfg, tx, mesh, M, stage_axis, data_axis=None):
     """The 1F1B schedule (VERDICT r3 #4): same function as GPipe, computed
     with a hand-scheduled backward so each stage stashes at most ``S``
     microbatch activations instead of all ``M``.
@@ -481,6 +521,7 @@ def _make_1f1b_step(cfg, tx, mesh, M, stage_axis):
     embed, pos_embed, head, ln_f = _lm_modules(cfg)
     fwd_perm = [(i, i + 1) for i in range(S - 1)]
     bwd_perm = [(i + 1, i) for i in range(S - 1)]
+    vma_axes = (stage_axis,) if data_axis is None else (stage_axis, data_axis)
 
     def pipeline_grads(params, tokens_mb, targets_mb):
         # Localize the replicated params (stage-varying view): otherwise the
@@ -586,6 +627,10 @@ def _make_1f1b_step(cfg, tx, mesh, M, stage_axis):
                 # transposes the activation hand-off
                 g_h = jnp.where(is_last, jnp.zeros_like(g_bwd_in), g_bwd_in)
                 g_ce = jnp.where(is_last, inv_total, 0.0)
+                if data_axis is not None:
+                    # the primal ce is data-varying under dp x pp; the seed
+                    # must carry the same varying axes for the vjp call
+                    g_ce = jax.lax.pcast(g_ce, data_axis, to="varying")
                 d_blocks, d_head, d_lnf, d_h = vjp_fn((g_h, g_ce))
                 # stage 0 transposes the embedding instead of sending left
                 # (nested cond: the other stages skip the transpose work)
@@ -618,13 +663,17 @@ def _make_1f1b_step(cfg, tx, mesh, M, stage_axis):
 
         zero_h = jnp.zeros((mb, seq, cfg.d_model))
         # zeros_like inherits varying axes: every params leaf is varying
-        # after localize, so the grad accumulators are too
+        # after localize, so the grad accumulators are too — over STAGE
+        # only: under dp x pp each inner jax.vjp's param cotangents are
+        # auto-psum'd over the data axis (the localized params are
+        # data-invariant), so the accumulators stay data-invariant and the
+        # wrapper's /n_data turns the sum into the mean
         grads0 = jax.tree.map(jnp.zeros_like, params)
         carry0 = jax.lax.pcast(
             (zero_h, zero_h,
              jnp.zeros((S + 1, mb, seq, cfg.d_model)),  # arrivals (+trash slot)
              jnp.zeros(())),
-            stage_axis, to="varying",
+            vma_axes, to="varying",
         )
         carry0 = carry0[:3] + (grads0, carry0[3])
         (_, _, _, grads, loss_sum), _ = jax.lax.scan(tick, carry0, jnp.arange(T))
@@ -644,7 +693,7 @@ def _make_1f1b_step(cfg, tx, mesh, M, stage_axis):
         loss = jax.lax.psum(loss_sum, stage_axis) / (n_mask * M)
         return loss, grads
 
-    return _wrap_pp_step(pipeline_grads, tx, mesh, stage_axis)
+    return _wrap_pp_step(pipeline_grads, tx, mesh, stage_axis, data_axis)
 
 
 def microbatch(tokens, targets, n_microbatches: int) -> Tuple[np.ndarray, np.ndarray]:

@@ -271,3 +271,50 @@ def test_1f1b_m_equals_s_and_m_less_than_s():
             cfg, tx, mesh, n_microbatches=M, schedule="1f1b")(
             create_pp_train_state(cfg, jax.random.key(1), tx, mesh), tmb, gmb)
         np.testing.assert_allclose(float(loss_f), float(loss_g), rtol=1e-5)
+
+
+@pytest.mark.slow  # ~20 s/case on this host (two compiled worlds per case)
+@pytest.mark.parametrize("sched,kw", [
+    ("gpipe", {}), ("interleaved", {"virtual_stages": 2}), ("1f1b", {}),
+])
+def test_dp_pp_composite_matches_pure_pp(sched, kw):
+    """dp x pp on a (data=2, stage=2) mesh must produce the same loss and
+    post-update params as pure pp on the identical global batch — for every
+    schedule. Catches both the batch-sharding spec and the grad
+    normalization (AD auto-psums param cotangents over the data axis; a
+    naive pmean left grads exactly 2x at dp=2 during development)."""
+    cfg = PipelineLMConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=4,
+                           d_ff=64, max_len=64)
+    tx = optax.sgd(0.1)
+    M, mb, seq = 4, 8, 16
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 64, size=(M, mb, seq)).astype(np.int32)
+    targets = rng.integers(0, 64, size=(M, mb, seq)).astype(np.int32)
+
+    mesh_pp = Mesh(np.array(jax.devices()[:2]), ("stage",))
+    st = create_pp_train_state(cfg, jax.random.key(0), tx, mesh_pp)
+    st1, loss_ref = make_pp_train_step(
+        cfg, tx, mesh_pp, n_microbatches=M, schedule=sched, **kw
+    )(st, tokens, targets)
+
+    mesh_dp = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                   ("data", "stage"))
+    st_dp = create_pp_train_state(cfg, jax.random.key(0), tx, mesh_dp)
+    st2, loss_dp = make_pp_train_step(
+        cfg, tx, mesh_dp, n_microbatches=M, schedule=sched,
+        data_axis="data", **kw
+    )(st_dp, tokens, targets)
+
+    assert abs(float(loss_ref) - float(loss_dp)) < 1e-5
+    for a, b in zip(jax.tree.leaves(st1.params), jax.tree.leaves(st2.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_dp_pp_rejects_unknown_data_axis():
+    cfg = PipelineLMConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                           d_ff=64, max_len=64)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("stage",))
+    with pytest.raises(ValueError, match="data_axis"):
+        make_pp_train_step(cfg, optax.sgd(0.1), mesh, n_microbatches=2,
+                           data_axis="data")
