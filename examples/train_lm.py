@@ -44,6 +44,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="(--mode moe) experts per MoE layer")
     p.add_argument("--microbatches", type=int, default=4,
                    help="(--mode pp) GPipe microbatches per step")
+    p.add_argument("--pp-dp", type=int, default=1, metavar="D",
+                   help="(--mode pp) data-parallel pipeline replicas on a "
+                        "(data=D, stage) mesh — dp x pp composition")
     p.add_argument("--loss-chunk", type=int, default=0, metavar="C",
                    help="(single/fsdp modes) compute the LM loss in C-token "
                         "sequence chunks without materializing the full "
@@ -237,17 +240,36 @@ def main(argv=None) -> int:
         )
 
         # stages must divide the layer count; microbatches must divide batch
-        n_stages = math.gcd(n_dev, args.n_layers)
+        d_pp = int(args.pp_dp)
+        if d_pp < 1:
+            parser.error(f"--pp-dp must be >= 1, got {d_pp}")
+        if n_dev % d_pp:
+            parser.error(f"--pp-dp {d_pp} must divide the device count {n_dev}")
+        n_stages = math.gcd(n_dev // d_pp, args.n_layers)
         n_mb = math.gcd(args.microbatches, args.batch)
         cfg = PipelineLMConfig(
             vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
             n_layers=args.n_layers, d_ff=args.d_ff, max_len=max(args.seq, 256),
         )
-        mesh = Mesh(np.array(jax.devices()[:n_stages]), ("stage",))
+        if d_pp > 1:
+            if (args.batch // n_mb) % d_pp:
+                parser.error(f"--pp-dp {d_pp} must divide the per-microbatch "
+                             f"batch {args.batch // n_mb}")
+            mesh = Mesh(
+                np.array(jax.devices()[: d_pp * n_stages]).reshape(
+                    d_pp, n_stages),
+                ("data", "stage"),
+            )
+            step = make_pp_train_step(cfg, tx, mesh, n_microbatches=n_mb,
+                                      data_axis="data")
+            desc = (f"{d_pp}x{n_stages} dp x pp GPipe, {n_mb} microbatches, "
+                    f"grads averaged over {d_pp} pipeline replicas")
+        else:
+            mesh = Mesh(np.array(jax.devices()[:n_stages]), ("stage",))
+            step = make_pp_train_step(cfg, tx, mesh, n_microbatches=n_mb)
+            desc = f"{n_stages}-stage GPipe, {n_mb} microbatches"
         state = create_pp_train_state(cfg, jax.random.key(args.seed), tx, mesh)
-        step = make_pp_train_step(cfg, tx, mesh, n_microbatches=n_mb)
         shard = lambda t, g: microbatch(t, g, n_mb)
-        desc = f"{n_stages}-stage GPipe, {n_mb} microbatches"
     elif args.mode == "moe":
         from distributed_ml_pytorch_tpu.models.moe import MoETransformerLM
         from distributed_ml_pytorch_tpu.parallel.expert_parallel import (

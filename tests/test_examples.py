@@ -118,3 +118,14 @@ def test_generate_text_rejects_bad_flags(capsys):
         gen_main(["--d-model", "30", "--n-heads", "4"])
     with pytest.raises(SystemExit):
         gen_main(["--tp", "2", "--kv-quant"])  # silently-exact combination
+
+
+def test_train_lm_dp_pp_composite():
+    """--mode pp --pp-dp 2 runs the dp x pp composition (2 pipeline
+    replicas x 2 stages on the 8-device mesh) and learns."""
+    rc = main([
+        "--mode", "pp", "--pp-dp", "2", "--steps", "4", "--batch", "8",
+        "--seq", "32", "--vocab", "64", "--d-model", "32", "--n-heads", "8",
+        "--n-layers", "2", "--d-ff", "64",
+    ])
+    assert rc == 0
