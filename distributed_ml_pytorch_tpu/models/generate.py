@@ -93,20 +93,34 @@ def sample_tokens(
     return jax.random.categorical(rng, logits, axis=-1)
 
 
-def _fuse_qkv_params(params):
+def _fuse_qkv_params(params, name: str = ""):
     """Rewrite a trained param tree into the ``fused_qkv`` module layout:
     every attention dict {q, k, v, o} becomes {qkv, o} with the three
     kernels concatenated on the output axis (``y[..., :d] == x @ W_q``
     etc., bit-compatible column blocks). Runs INSIDE the decode jit, so
     checkpoints and callers keep the unfused layout; the concat is
-    loop-invariant and XLA hoists it out of the token scans."""
-    if isinstance(params, dict) and {"q", "k", "v", "o"} <= set(params):
+    loop-invariant and XLA hoists it out of the token scans.
+
+    The rewrite is anchored on the attention module NAME ("attn", as
+    ``TransformerBlock`` declares it) in addition to the {q,k,v,o} child
+    keys, so an unrelated module that happens to have those child names is
+    left alone — and the q/k/v kernels are checked 2-D and equal-shaped
+    before concatenating (the MHA projections are all (d_model, d_model))."""
+    if (
+        isinstance(params, dict)
+        and name == "attn"
+        and {"q", "k", "v", "o"} <= set(params)
+    ):
+        kernels = [params[n]["kernel"] for n in ("q", "k", "v")]
+        if not all(k.ndim == 2 and k.shape == kernels[0].shape for k in kernels):
+            raise ValueError(
+                "attn q/k/v kernels are not same-shaped 2-D: "
+                f"{[k.shape for k in kernels]}")
         out = {n: v for n, v in params.items() if n not in ("q", "k", "v")}
-        out["qkv"] = {"kernel": jnp.concatenate(
-            [params[n]["kernel"] for n in ("q", "k", "v")], axis=-1)}
+        out["qkv"] = {"kernel": jnp.concatenate(kernels, axis=-1)}
         return out
     if isinstance(params, dict):
-        return {n: _fuse_qkv_params(v) for n, v in params.items()}
+        return {n: _fuse_qkv_params(v, name=n) for n, v in params.items()}
     return params
 
 
@@ -192,7 +206,16 @@ def _check_max_len(model, total: int) -> None:
 def init_cache(model, batch: int, cache_size: int, decode_block: int = 0,
                kv_quant: bool = False):
     """Allocate the per-layer K/V cache (zeros, cursor at 0) for ``batch``
-    sequences of total length ``cache_size``."""
+    sequences of total length ``cache_size``.
+
+    ``kv_quant=True`` caches carry a SINGLE-PREFILL CONTRACT: the first
+    multi-token apply must happen at cursor 0 (a fresh cache). A second
+    multi-token prefill into a non-empty quantized cache returns NaN
+    outputs by design (``MultiHeadAttention._block_cached_attention``) —
+    the quant prefill attends with its exact in-hand K/V and deliberately
+    does not read earlier blocks back. :func:`generate` always satisfies
+    this; direct module users chaining prefills must re-init the cache
+    (or use the exact bf16 cache, which has no such restriction)."""
     dec = _decode_model(model, cache_size, decode_block=decode_block,
                         kv_quant=kv_quant)
     variables = jax.eval_shape(
@@ -257,7 +280,10 @@ def generate(
     per-key scales (half the dominant decode HBM read; small quantization
     noise on cross-block attention only) — it applies only when the
     blocked path runs; shapes that fall back to the plain scan keep the
-    exact cache.
+    exact full-size cache and a ``UserWarning`` is emitted (pre-check with
+    :func:`uses_block_decode` to avoid the fallback). Quantized caches are
+    single-prefill (see :func:`init_cache`); ``generate`` always satisfies
+    that contract internally.
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature > 0 sampling needs an rng key")
@@ -278,8 +304,26 @@ def generate(
             dec, int(max_new_tokens), float(temperature), int(top_k),
             float(top_p), params, cache, prompt, rng
         )
+    if kv_quant:
+        # the plain scan keeps the exact full-size bf16 cache — more
+        # accurate, but NOT the halved footprint the caller sized for, so
+        # the fallback must be audible (callers can pre-check with
+        # uses_block_decode())
+        import warnings
+
+        warnings.warn(
+            "kv_quant=True requested but this shape falls back to the plain "
+            "decode scan (int8 quantization only exists under the blocked "
+            "path: needs prompt_len > 1 and "
+            f"{DECODE_BLOCK} <= max_new_tokens - 1 <= "
+            f"{DECODE_BLOCK * MAX_UNROLLED_BLOCKS}, within max_len) — using "
+            "the exact FULL-SIZE bf16 cache; the halved-footprint capacity "
+            "win does not apply",
+            stacklevel=2,
+        )
     # kv_quant needs the blocked structure (quantize-at-merge); the plain
-    # scan keeps the exact bf16 cache — a silent upgrade in accuracy
+    # scan keeps the exact full-size cache (warned above — more accurate,
+    # but not the halved footprint the caller asked for)
     cache = init_cache(model, b, total)
     dec = _decode_model(model, total)
     return _generate_jit(

@@ -236,6 +236,39 @@ def test_kv_quant_decode_deterministic_and_prefill_exact():
     np.testing.assert_array_equal(np.asarray(q1[:, 6]), np.asarray(exact[:, 6]))
 
 
+def test_kv_quant_fallback_to_plain_scan_warns():
+    """kv_quant=True on a shape the blocked path can't take (here: too few
+    new tokens to fill one block) must be AUDIBLE — the plain scan keeps
+    the exact full-size cache, not the halved int8 footprint the caller
+    sized for (ADVICE r4)."""
+    model = tiny_lm()
+    params = trained_ish_params(model)
+    prompt = jnp.asarray(
+        np.random.default_rng(5).integers(0, 64, size=(1, 6)), jnp.int32
+    )
+    with pytest.warns(UserWarning, match="kv_quant.*fall"):
+        out = generate(model, params, prompt, 4, kv_quant=True)
+    assert out.shape == (1, 10)
+
+
+def test_fuse_qkv_params_only_rewrites_attn_named_modules():
+    """The fused-QKV rewrite is anchored on the module NAME 'attn' plus the
+    {q,k,v,o} child keys — a non-attention module that happens to have
+    those child names must pass through untouched (ADVICE r4)."""
+    from distributed_ml_pytorch_tpu.models.generate import _fuse_qkv_params
+
+    k = jnp.ones((4, 4))
+    attn = {"q": {"kernel": k}, "k": {"kernel": k}, "v": {"kernel": k},
+            "o": {"kernel": k}}
+    impostor = {"q": {"kernel": k}, "k": {"kernel": k}, "v": {"kernel": k},
+                "o": {"kernel": k}, "extra": {"kernel": k}}
+    tree = {"block_0": {"attn": attn, "lookup": impostor}}
+    out = _fuse_qkv_params(tree)
+    assert set(out["block_0"]["attn"]) == {"qkv", "o"}
+    assert out["block_0"]["attn"]["qkv"]["kernel"].shape == (4, 12)
+    assert set(out["block_0"]["lookup"]) == set(impostor)  # untouched
+
+
 def test_kv_quant_cache_is_int8_with_scales():
     model = tiny_lm()
     cache = init_cache(model, 2, 32, decode_block=8, kv_quant=True)

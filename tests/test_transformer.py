@@ -172,6 +172,65 @@ def test_chunked_lm_loss_matches_dense_loss_and_grads():
                                    rtol=1e-4, atol=1e-6)
 
 
+def test_chunked_lm_loss_bf16_ce_tracks_f32_ce_training():
+    """Measured justification for the bf16-CE default (ADVICE r4): train a
+    bf16-activation LM at a 16k vocabulary for 60 SGD steps with the
+    chunked loss twice — CE on bf16 logits (default) vs CE on per-chunk
+    f32-upcast logits (``ce_dtype=jnp.float32``) — from identical init on
+    the identical batch stream. The trajectories must track: same descent,
+    final-loss delta within noise. This is the loss-quality evidence the
+    +3.7% bf16-CE change rests on."""
+    import optax
+
+    from distributed_ml_pytorch_tpu.training.trainer import chunked_lm_loss
+
+    vocab = 16384
+    lm = TransformerLM(vocab_size=vocab, d_model=64, n_heads=4, n_layers=2,
+                       d_ff=128, max_len=64, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(1)
+    init_tokens = jnp.zeros((2, 32), jnp.int32)
+    params0 = lm.init(jax.random.key(0), init_tokens)["params"]
+    tx = optax.sgd(0.05)
+    # one fixed batch, memorized over 60 steps — random next-token targets
+    # are unlearnable (loss pinned at log vocab), memorization descends,
+    # and a fixed batch makes the two runs exactly comparable
+    tok = jnp.asarray(rng.integers(0, vocab, (2, 32)), jnp.int32)
+    tgt = jnp.roll(tok, -1, axis=1)
+
+    def run(ce_dtype):
+        params = params0
+        opt_state = tx.init(params)
+        losses = []
+        loss_fn = jax.jit(jax.value_and_grad(
+            lambda p: chunked_lm_loss(
+                lm, p, tok, tgt, chunk=8, ce_dtype=ce_dtype)))
+        for _ in range(60):
+            loss, grads = loss_fn(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            losses.append(float(loss))
+        return losses
+
+    bf16_losses = run(None)
+    f32_losses = run(jnp.float32)
+
+    # both must memorize: ~log(16384)=9.7 down to < 1 nat
+    assert bf16_losses[0] > 9.0 and bf16_losses[-1] < 1.0, bf16_losses[-1]
+    assert f32_losses[0] > 9.0 and f32_losses[-1] < 1.0, f32_losses[-1]
+    # trajectories track: the gap oscillates in BOTH directions (bf16
+    # activations make each run jittery; measured max one-step gap ~0.56
+    # on a 9.5-nat descent, crossing sign repeatedly) but the mean gap and
+    # the final losses stay within a few % of the descent
+    descent = bf16_losses[0] - bf16_losses[-1]
+    gaps = [abs(a - b) for a, b in zip(bf16_losses, f32_losses)]
+    # measured on this CPU backend: max one-step gap ~0.059*descent, mean
+    # ~0.011*descent, final ~0.008*descent; bounds leave >2.5x headroom
+    # for backend-dependent bf16 accumulation order
+    assert max(gaps) < 0.15 * descent, (max(gaps), descent)
+    assert sum(gaps) / len(gaps) < 0.05 * descent, sum(gaps) / len(gaps)
+    assert abs(bf16_losses[-1] - f32_losses[-1]) < 0.04 * descent
+
+
 def test_chunked_lm_loss_rejects_indivisible_chunk():
     from distributed_ml_pytorch_tpu.training.trainer import chunked_lm_loss
 
