@@ -48,6 +48,21 @@ data, and the param cotangents — auto-psum'd over data by AD because the
 ``P(stage, ...)`` params enter data-invariant — are divided into the mean.
 All three schedules are loss- and grad-identical to the pure-pp step on
 the same global batch (tested).
+
+Composes with TENSOR parallelism (``model_axis=...``): the canonical deep-LM
+pairing — tp inside each stage, pp across stages, on a ``(stage, model)``
+(optionally ``(data, stage, model)``) mesh. Block params gain Megatron
+sharding WITHIN their stage shard (q/k/v column- / heads-split, o
+row-split, MLP up column- / down row-split — :func:`pp_param_specs` with
+``model_axis``), and the stage forward becomes the explicit-collective
+Megatron block: two ``psum``s over ``model`` per layer (after the o
+projection and after the MLP down projection), placed where the sharded
+contraction ends, so activations stay model-INVARIANT at every hand-off
+(ppermutes, stashes, and FIFOs carry no extra copies, and the carry's
+varying axes don't change). Embedding, final LN, and head stay replicated
+over ``model`` (vocab sharding belongs to the pure-tp path,
+``tensor_parallel.py``). All three schedules accept it; loss and grads
+match pure-pp numerically (tested).
 """
 
 from __future__ import annotations
@@ -61,7 +76,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_ml_pytorch_tpu.models.transformer import Block
+from distributed_ml_pytorch_tpu.models.transformer import Block, default_attn_fn
 from distributed_ml_pytorch_tpu.training.trainer import TrainState
 
 
@@ -133,7 +148,8 @@ def _lm_modules(cfg: PipelineLMConfig):
     )
 
 
-def pp_param_specs(tree, stage_axis: str = "stage"):
+def pp_param_specs(tree, stage_axis: str = "stage",
+                   model_axis: str | None = None):
     """Spec tree: any leaf under a ``"blocks"`` key is layer-stacked on its
     leading axis → ``P(stage, ...)``; everything else replicated.
 
@@ -141,17 +157,55 @@ def pp_param_specs(tree, stage_axis: str = "stage"):
     param paths — a whole ``TrainState`` included (optimizer momentum mirrors
     the params), same single-rule design as
     ``tensor_parallel.tp_param_specs`` / ``expert_parallel.ep_param_specs``.
+
+    With ``model_axis`` (pp×tp), block leaves ADDITIONALLY carry the
+    Megatron sharding of ``tensor_parallel.tp_param_specs`` within their
+    stage shard (leaf shapes have the leading stacked-layer axis):
+
+    ==============================  ======================  ====================
+    blocks leaf                     shape                   spec
+    ==============================  ======================  ====================
+    attn q/k/v kernels              (L, d_model, d_model)   P(stage, None, model)
+    attn o kernel                   (L, d_model, d_model)   P(stage, model, None)
+    MLP up kernel (Dense_0)         (L, d_model, d_ff)      P(stage, None, model)
+    MLP up bias                     (L, d_ff)               P(stage, model)
+    MLP down kernel (Dense_1)       (L, d_ff, d_model)      P(stage, model, None)
+    MLP down bias / LayerNorms      (L, d_model)            P(stage, None)
+    ==============================  ======================  ====================
+
+    Embed / head / final LN stay ``P()`` (replicated over every axis).
     """
 
     def spec_for(path, leaf):
-        if _is_blocks_path(path):
-            return P(*((stage_axis,) + (None,) * (leaf.ndim - 1)))
-        return P()
+        if not _is_blocks_path(path):
+            return P()
+        if model_axis is not None:
+            names = [getattr(k, "key", str(k)) for k in path]
+            if "attn" in names:
+                if names[-2] in ("q", "k", "v"):
+                    return P(stage_axis, None, model_axis)
+                if names[-2] == "o":
+                    return P(stage_axis, model_axis, None)
+            if "Dense_0" in names:
+                return (P(stage_axis, None, model_axis) if leaf.ndim == 3
+                        else P(stage_axis, model_axis))
+            if "Dense_1" in names and leaf.ndim == 3:
+                return P(stage_axis, model_axis, None)
+        return P(*((stage_axis,) + (None,) * (leaf.ndim - 1)))
 
     return jax.tree_util.tree_map_with_path(spec_for, tree)
 
 
-def _wrap_pp_step(grad_fn, tx, mesh, stage_axis, data_axis=None):
+def _check_tp_divisibility(cfg: PipelineLMConfig, n_model: int) -> None:
+    for name, dim in (("n_heads", cfg.n_heads), ("d_ff", cfg.d_ff)):
+        if dim % n_model:
+            raise ValueError(
+                f"cfg.{name}={dim} is not divisible by the tp axis size "
+                f"{n_model} — the sharded dimension must split evenly")
+
+
+def _wrap_pp_step(grad_fn, tx, mesh, stage_axis, data_axis=None,
+                  model_axis=None):
     """``(state, tokens_mb, targets_mb) → (state, loss)`` from a shard_map-
     able ``grad_fn(params, tokens_mb, targets_mb) → (loss, grads)`` — the
     one optimizer-update epilogue shared by all three schedule builders.
@@ -166,7 +220,7 @@ def _wrap_pp_step(grad_fn, tx, mesh, stage_axis, data_axis=None):
     ``P(stage, ...)`` (replicated over data)."""
 
     def step(state: TrainState, tokens_mb, targets_mb):
-        param_specs = pp_param_specs(state.params, stage_axis)
+        param_specs = pp_param_specs(state.params, stage_axis, model_axis)
         if data_axis is not None:
             n_data = int(mesh.shape[data_axis])
 
@@ -204,19 +258,23 @@ def create_pp_train_state(
     tx: optax.GradientTransformation,
     mesh: Mesh,
     stage_axis: str = "stage",
+    model_axis: str | None = None,
 ) -> TrainState:
-    """Init a ``TrainState`` with block layers sharded over the stages."""
+    """Init a ``TrainState`` with block layers sharded over the stages (and,
+    with ``model_axis``, Megatron-sharded within each stage — pp×tp)."""
     n_stages = int(mesh.shape[stage_axis])
     if cfg.n_layers % n_stages:
         raise ValueError(
             f"n_layers={cfg.n_layers} must divide evenly over {n_stages} stages"
         )
+    if model_axis is not None:
+        _check_tp_divisibility(cfg, int(mesh.shape[model_axis]))
 
     def init_fn(rng):
         return TrainState.create(init_pp_params(cfg, rng), tx)
 
     state_shapes = jax.eval_shape(init_fn, rng)
-    specs = pp_param_specs(state_shapes, stage_axis)
+    specs = pp_param_specs(state_shapes, stage_axis, model_axis)
     shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
     )
@@ -232,6 +290,57 @@ def _stage_forward(cfg: PipelineLMConfig, block_params, h):
 
     h, _ = jax.lax.scan(body, h, block_params)
     return h
+
+
+def _make_stage_forward(cfg: PipelineLMConfig, mesh: Mesh,
+                        model_axis: str | None):
+    """``(block_params, h) → h`` for one stage — plain (``model_axis=None``)
+    or tensor-parallel (tp width read off the mesh).
+
+    The tp version is the explicit-collective Megatron block, written out
+    because the schedules run inside ``shard_map`` (GSPMD annotations don't
+    reach here): each device computes its ``n_heads/mp`` attention heads and
+    its ``d_ff/mp`` MLP slice from its column-sharded kernels, the
+    row-sharded o / down projections end the sharded contraction, and ONE
+    ``psum`` over ``model`` after each closes the partial sums — the same
+    two-all-reduces-per-layer count XLA derives for the pjit tp path
+    (``tensor_parallel.tp_param_specs``). Replicated pieces (LayerNorms,
+    down bias, residual adds) compute on model-INVARIANT values, so every
+    activation crossing a stage boundary stays model-invariant. Math is
+    identical to ``Block.apply`` (same flax submodule calls, same
+    ``default_attn_fn`` on the local heads); loss/grad parity with the
+    unsharded stage forward is tested to float tolerance (psum
+    reassociation).
+    """
+    if model_axis is None:
+        return partial(_stage_forward, cfg)
+
+    from flax import linen as nn
+
+    local_heads = cfg.n_heads // int(mesh.shape[model_axis])
+    head_dim = cfg.d_model // cfg.n_heads
+
+    def body(h, lp):
+        b, s, _ = h.shape
+
+        def split(t):  # (b, s, local_heads*hd) → (b, local_heads, s, hd)
+            return t.reshape(b, s, local_heads, head_dim).transpose(0, 2, 1, 3)
+
+        ln0 = nn.LayerNorm().apply({"params": lp["LayerNorm_0"]}, h)
+        q, k, v = (split(ln0 @ lp["attn"][n]["kernel"]) for n in ("q", "k", "v"))
+        out = default_attn_fn(q, k, v)  # causal, per-head → head-local
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, local_heads * head_dim)
+        x = h + jax.lax.psum(out @ lp["attn"]["o"]["kernel"], model_axis)
+        ln1 = nn.LayerNorm().apply({"params": lp["LayerNorm_1"]}, x)
+        up = nn.gelu(ln1 @ lp["Dense_0"]["kernel"] + lp["Dense_0"]["bias"])
+        down = jax.lax.psum(up @ lp["Dense_1"]["kernel"], model_axis)
+        return x + down + lp["Dense_1"]["bias"], None
+
+    def forward(block_params, h):
+        h, _ = jax.lax.scan(body, h, block_params)
+        return h
+
+    return forward
 
 
 def interleave_layer_order(n_layers: int, n_stages: int, v: int) -> np.ndarray:
@@ -263,6 +372,7 @@ def make_pp_train_step(
     schedule: str = "gpipe",
     virtual_stages: int = 1,
     data_axis: str | None = None,
+    model_axis: str | None = None,
 ) -> Callable:
     """Build the jitted PP LM step: ``(state, tokens_mb, targets_mb) → (state, loss)``.
 
@@ -270,6 +380,14 @@ def make_pp_train_step(
     on the leading axis, replicated across stages). The loss is the global
     next-token CE over all M microbatches, masking the final position of each
     sequence (``seq_parallel.next_token_targets`` convention).
+
+    ``model_axis`` (pp×tp, any schedule, composes with ``data_axis`` for
+    dp×pp×tp): blocks are Megatron-sharded within their stage
+    (:func:`pp_param_specs`), the stage forward runs the explicit-collective
+    tp block (:func:`_make_stage_forward`), and everything crossing stage
+    boundaries stays model-invariant, so the schedules themselves are
+    untouched. The state must come from :func:`create_pp_train_state` with
+    the same ``model_axis``.
 
     ``schedule="interleaved"`` with ``virtual_stages=v > 1`` runs the
     Megatron-style interleaved schedule: each stage holds ``v`` strided
@@ -293,18 +411,29 @@ def make_pp_train_step(
     if data_axis is not None and data_axis not in mesh.shape:
         raise ValueError(f"data_axis {data_axis!r} is not in the mesh "
                          f"(axes: {dict(mesh.shape)})")
+    if model_axis is not None:
+        if model_axis not in mesh.shape:
+            raise ValueError(f"model_axis {model_axis!r} is not in the mesh "
+                             f"(axes: {dict(mesh.shape)})")
+        _check_tp_divisibility(cfg, int(mesh.shape[model_axis]))
     if schedule == "interleaved":
         return _make_interleaved_step(
-            cfg, tx, mesh, M, stage_axis, int(virtual_stages), data_axis)
+            cfg, tx, mesh, M, stage_axis, int(virtual_stages), data_axis,
+            model_axis)
     if schedule == "1f1b":
-        return _make_1f1b_step(cfg, tx, mesh, M, stage_axis, data_axis)
+        return _make_1f1b_step(cfg, tx, mesh, M, stage_axis, data_axis,
+                               model_axis)
     if schedule != "gpipe":
         raise ValueError(
             f"schedule must be 'gpipe', '1f1b' or 'interleaved', got {schedule!r}")
     embed, pos_embed, head, ln_f = _lm_modules(cfg)
+    stage_fwd = _make_stage_forward(cfg, mesh, model_axis)
     fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
     # scan carries mix with batch activations, which vary over BOTH mesh
-    # axes under dp x pp — the carry's varying axes must match
+    # axes under dp x pp — the carry's varying axes must match. The model
+    # axis is NOT in the carry's varying set: tp activations are
+    # model-invariant at every stage boundary (psums close each layer's
+    # sharded contraction inside the stage forward)
     vma_axes = (stage_axis,) if data_axis is None else (stage_axis, data_axis)
 
     def pipeline_loss(params, tokens_mb, targets_mb):
@@ -324,7 +453,7 @@ def make_pp_train_step(
             h = jnp.where(s == 0, embed_mb(t), h_in)
             m_here = t - s  # microbatch this stage holds at tick t
             valid = (m_here >= 0) & (m_here < M)
-            h_out = _stage_forward(cfg, params["blocks"], h)
+            h_out = stage_fwd(params["blocks"], h)
             h_out = jnp.where(valid, h_out, h)  # bubbles pass through untouched
             # last stage: head + loss for its microbatch (masked elsewhere)
             logits = head.apply(
@@ -360,10 +489,11 @@ def make_pp_train_step(
         return loss_sum / count
 
     return _wrap_pp_step(jax.value_and_grad(pipeline_loss), tx, mesh,
-                         stage_axis, data_axis)
+                         stage_axis, data_axis, model_axis)
 
 
-def _make_interleaved_step(cfg, tx, mesh, M, stage_axis, v, data_axis=None):
+def _make_interleaved_step(cfg, tx, mesh, M, stage_axis, v, data_axis=None,
+                           model_axis=None):
     """The interleaved-schedule step (see make_pp_train_step's docstring)."""
     S = int(mesh.shape[stage_axis])
     if cfg.n_layers % (S * v):
@@ -381,6 +511,7 @@ def _make_interleaved_step(cfg, tx, mesh, M, stage_axis, v, data_axis=None):
     T = v * M + S - 1
 
     embed, pos_embed, head, ln_f = _lm_modules(cfg)
+    stage_fwd = _make_stage_forward(cfg, mesh, model_axis)
     ring = [(i, (i + 1) % S) for i in range(S)]
     vma_axes = (stage_axis,) if data_axis is None else (stage_axis, data_axis)
 
@@ -408,7 +539,7 @@ def _make_interleaved_step(cfg, tx, mesh, M, stage_axis, v, data_axis=None):
                 lambda x: jax.lax.dynamic_index_in_dim(x, r, axis=0,
                                                        keepdims=False),
                 local_blocks)
-            return _stage_forward(cfg, chunk, h)
+            return stage_fwd(chunk, h)
 
         def tick(carry, t):
             h_in, buf, loss_sum, count = carry
@@ -456,7 +587,7 @@ def _make_interleaved_step(cfg, tx, mesh, M, stage_axis, v, data_axis=None):
         return loss_sum / count
 
     return _wrap_pp_step(jax.value_and_grad(pipeline_loss), tx, mesh,
-                         stage_axis, data_axis)
+                         stage_axis, data_axis, model_axis)
 
 
 def oneF1B_tick_roles(t, s, S: int, M: int):
@@ -488,7 +619,8 @@ def oneF1B_tick_roles(t, s, S: int, M: int):
     return m_f, m_b
 
 
-def _make_1f1b_step(cfg, tx, mesh, M, stage_axis, data_axis=None):
+def _make_1f1b_step(cfg, tx, mesh, M, stage_axis, data_axis=None,
+                    model_axis=None):
     """The 1F1B schedule (VERDICT r3 #4): same function as GPipe, computed
     with a hand-scheduled backward so each stage stashes at most ``S``
     microbatch activations instead of all ``M``.
@@ -515,10 +647,21 @@ def _make_1f1b_step(cfg, tx, mesh, M, stage_axis, data_axis=None):
     the loss cotangent is seeded as ``1/Σmask`` on the last stage, embed /
     head / ln_f grads accumulate on the stages that own them and are
     psum-broadcast, and block grads stay ``P(stage)``-local.
+
+    pp×tp note (``model_axis``): the tp stage forward's ``psum``s over
+    ``model`` — and the model-axis collectives AD inserts when the inner
+    ``jax.vjp``s transpose model-invariant values out of model-varying
+    compute — DO run inside the ``lax.cond`` branches here, unlike the
+    stage-axis collectives the docstring above banishes. That is safe, not
+    a deadlock: the branch predicates (``do_fwd``/``do_bwd``) depend only
+    on ``(t, s)``, so all model-peers of a stage — the only participants
+    in a model-axis collective — always take the same branch together.
+    The stage-axis argument doesn't transfer: stage-peers DO diverge.
     """
     S = int(mesh.shape[stage_axis])
     T = 2 * (M + S - 1)
     embed, pos_embed, head, ln_f = _lm_modules(cfg)
+    stage_fwd = _make_stage_forward(cfg, mesh, model_axis)
     fwd_perm = [(i, i + 1) for i in range(S - 1)]
     bwd_perm = [(i + 1, i) for i in range(S - 1)]
     vma_axes = (stage_axis,) if data_axis is None else (stage_axis, data_axis)
@@ -551,7 +694,7 @@ def _make_1f1b_step(cfg, tx, mesh, M, stage_axis, data_axis=None):
         def stage_loss_fn(blocks_p, head_p, lnf_p, h, tgt):
             """Local layers + (masked-elsewhere) head CE — the unit of work
             whose vjp is one stage's backward."""
-            h_out = _stage_forward(cfg, blocks_p, h)
+            h_out = stage_fwd(blocks_p, h)
             logits = head.apply(
                 {"params": head_p}, ln_f.apply({"params": lnf_p}, h_out)
             )
@@ -693,7 +836,8 @@ def _make_1f1b_step(cfg, tx, mesh, M, stage_axis, data_axis=None):
         loss = jax.lax.psum(loss_sum, stage_axis) / (n_mask * M)
         return loss, grads
 
-    return _wrap_pp_step(pipeline_grads, tx, mesh, stage_axis, data_axis)
+    return _wrap_pp_step(pipeline_grads, tx, mesh, stage_axis, data_axis,
+                         model_axis)
 
 
 def microbatch(tokens, targets, n_microbatches: int) -> Tuple[np.ndarray, np.ndarray]:
