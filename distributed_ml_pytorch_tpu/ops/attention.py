@@ -758,13 +758,16 @@ def _resolve_flash_config(q, k, causal, block_q, block_k,
         block_q = _default(sq, "sq")
     if block_k is None:
         block_k = _default(sk, "sk")
-    # backward defaults: largest dividing candidate per side (the fused
-    # backward prefers (1024, 1024)); an explicit forward block is the
-    # fallback for lengths no candidate divides — it divides by definition
-    if block_q_bwd is None:
-        block_q_bwd = _side_block_choice(sq) or block_q
-    if block_k_bwd is None:
-        block_k_bwd = _side_block_choice(sk) or block_k
+    # backward defaults via flash_bwd_block_choice (square at short
+    # sequences, (·, 2048) key blocks at sk >= 4096 — see its docstring);
+    # an explicit forward block is the fallback for lengths no candidate
+    # divides — it divides by definition
+    if block_q_bwd is None or block_k_bwd is None:
+        bwd_default = flash_bwd_block_choice(sq, sk)
+        if block_q_bwd is None:
+            block_q_bwd = bwd_default[0] if bwd_default else block_q
+        if block_k_bwd is None:
+            block_k_bwd = bwd_default[1] if bwd_default else block_k
     if sq % block_q or sk % block_k or sq % block_q_bwd or sk % block_k_bwd:
         raise ValueError(
             f"flash_attention needs seq multiples of block sizes, got "
@@ -892,8 +895,21 @@ def flash_block_choice(sq: int, sk: int):
 
 def flash_bwd_block_choice(sq: int, sk: int):
     """Backward blocking: the fused backward's v5e sweep prefers square
-    (1024, 1024) — larger key blocks amortize the per-(i, j) dq-partial
-    write, and the kernel has no (block_q, block_k) score transpose asymmetry
-    the forward has. Currently the same per-side preference as the forward
-    (one candidate list, _side_block_choice)."""
-    return flash_block_choice(sq, sk)
+    (1024, 1024) at short-to-mid sequences — larger key blocks amortize the
+    per-(i, j) dq-partial write, and the kernel has no (block_q, block_k)
+    score transpose asymmetry the forward has.
+
+    At sk = 8192 exactly, (1024, 2048) wins twice over — the kernel itself
+    is faster (measured 5.901 vs 6.155 ms fwd+bwd per GPT-2-small layer at
+    S=8192, device-true) AND the dq-partials buffer has sk/2048 blocks
+    instead of sk/1024, halving the partials reduction that follows the
+    kernel (12 × 0.53 → 0.27 ms/step). The gate is deliberately exact:
+    measured at sk=4096 the square blocking is faster (1.63 vs 1.68 ms),
+    and at sk ≥ 16384 block_k 2048 fails to compile (scoped-vmem OOM in
+    the fused backward: 16.43M > the 16M limit at S=32768; same class of
+    failure b8 × S2048 hit in the round-3 sweep). block_k 4096 fails to
+    compile even at sk=8192."""
+    choice = flash_block_choice(sq, sk)
+    if choice is not None and sk == 8192:
+        return (choice[0], 2048)
+    return choice

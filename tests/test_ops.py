@@ -293,6 +293,43 @@ def test_sharded_attn_island_matches_reference():
     assert np.isfinite(np.asarray(g)).all()
 
 
+def test_flash_bwd_block_choice_gates_long_key_blocks():
+    """The (·, 2048) backward key block applies at sk == 8192 EXACTLY:
+    measured faster there (and it halves the dq-partials reduce), but
+    slower at 4096 and scoped-vmem-OOM at >= 16384 (see the docstring's
+    measurements) — the gate must not widen silently."""
+    from distributed_ml_pytorch_tpu.ops.attention import (
+        flash_bwd_block_choice,
+    )
+
+    assert flash_bwd_block_choice(8192, 8192) == (1024, 2048)
+    assert flash_bwd_block_choice(2048, 2048) == (1024, 1024)
+    assert flash_bwd_block_choice(4096, 4096) == (1024, 1024)
+    assert flash_bwd_block_choice(16384, 16384) == (1024, 1024)
+    assert flash_bwd_block_choice(32768, 32768) == (1024, 1024)
+
+
+def test_flash_bwd_2048_key_block_grads_match_reference():
+    """The sk=8192 backward blocking computes the same gradients as the
+    square blocking (interpret mode, small head count)."""
+    rng = np.random.default_rng(11)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 1, 8192, 8)), jnp.float32)
+               for _ in range(3))
+
+    def loss(blocks):
+        def f(q, k, v):
+            return flash_attention(
+                q, k, v, causal=True, block_q_bwd=blocks[0],
+                block_k_bwd=blocks[1], interpret=True).sum()
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    g_a = loss((1024, 1024))
+    g_b = loss((1024, 2048))
+    for a, b in zip(g_a, g_b):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
 def test_flash_attention_default_blocks_adapt_to_sequence():
     """Default (unspecified) blocks must derive from flash_block_choice so
     lengths like 1536 — divisible by 512 but not 1024 — still work."""
