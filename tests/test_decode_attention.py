@@ -1,0 +1,88 @@
+"""The decode-attention kernel (ops/decode_attention.py — a measured
+record, not integrated) must compute exactly the XLA blocked-decode
+attention math: masked live-prefix scores (+ int8 per-key scales), masked
+ring scores, fresh-token score, f32 softmax, three-part value sum."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.ops.decode_attention import (
+    decode_attention_step,
+    kernel_supported,
+)
+from distributed_ml_pytorch_tpu.ops.fused_update import force_pallas_interpret
+from distributed_ml_pytorch_tpu.models.transformer import quantize_kv
+
+
+def _xla_reference(q, k_new, v_new, big_k, big_v, ring_k, ring_v, t,
+                   ring_base, scale_k=None, scale_v=None):
+    """The transformer.py blocked-path math, extracted."""
+    d = q.shape[-1]
+    scale = jnp.sqrt(jnp.asarray(d, jnp.float32))
+    C, T = big_k.shape[2], ring_k.shape[2]
+    s_big = jnp.einsum("bhsd,bhcd->bhsc", q, big_k.astype(q.dtype),
+                       preferred_element_type=jnp.float32)
+    if scale_k is not None:
+        s_big = s_big * scale_k[:, :, None, :]
+    s_big = jnp.where((jnp.arange(C) < ring_base)[None, None, None, :],
+                      s_big, -jnp.inf)
+    s_ring = jnp.einsum("bhsd,bhtd->bhst", q, ring_k,
+                        preferred_element_type=jnp.float32)
+    s_ring = jnp.where((jnp.arange(T) < t)[None, None, None, :],
+                       s_ring, -jnp.inf)
+    s_self = jnp.einsum("bhsd,bhsd->bhs", q, k_new,
+                        preferred_element_type=jnp.float32)
+    scores = jnp.concatenate([s_big, s_ring, s_self[..., None]],
+                             axis=-1) / scale
+    probs = jax.nn.softmax(scores, axis=-1)
+    p_big = probs[..., :C]
+    if scale_v is not None:
+        p_big = p_big * scale_v[:, :, None, :]
+    out = (
+        jnp.einsum("bhsc,bhcd->bhsd", p_big.astype(q.dtype),
+                   big_v.astype(q.dtype), preferred_element_type=jnp.float32)
+        + jnp.einsum("bhst,bhtd->bhsd",
+                     probs[..., C:C + T].astype(q.dtype), ring_v,
+                     preferred_element_type=jnp.float32)
+        + probs[..., C + T:].astype(jnp.float32) * v_new
+    )
+    return out.astype(q.dtype)
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_decode_attention_kernel_matches_xla_math(quant):
+    rng = np.random.default_rng(0)
+    b, h, C, T, d = 3, 4, 40, 16, 32
+    q, k_new, v_new = (
+        jnp.asarray(rng.normal(size=(b, h, 1, d)), jnp.float32)
+        for _ in range(3))
+    ring_k, ring_v = (
+        jnp.asarray(rng.normal(size=(b, h, T, d)), jnp.float32)
+        for _ in range(2))
+    big_k_f = jnp.asarray(rng.normal(size=(b, h, C, d)), jnp.float32)
+    big_v_f = jnp.asarray(rng.normal(size=(b, h, C, d)), jnp.float32)
+    if quant:
+        big_k, scale_k = quantize_kv(big_k_f)
+        big_v, scale_v = quantize_kv(big_v_f)
+    else:
+        big_k, big_v, scale_k, scale_v = big_k_f, big_v_f, None, None
+    t, ring_base = jnp.asarray(5), jnp.asarray(32)
+
+    want = _xla_reference(q, k_new, v_new, big_k, big_v, ring_k, ring_v,
+                          t, ring_base, scale_k, scale_v)
+    with force_pallas_interpret():
+        assert kernel_supported(big_k)
+        got = decode_attention_step(q, k_new, v_new, big_k, big_v,
+                                    ring_k, ring_v, t, ring_base,
+                                    scale_k, scale_v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_kernel_supported_gates_context_and_backend():
+    big = jnp.zeros((1, 2, 8192, 16), jnp.bfloat16)
+    with force_pallas_interpret():
+        assert not kernel_supported(big)  # context beyond the VMEM gate
+        assert kernel_supported(jnp.zeros((1, 2, 64, 16), jnp.bfloat16))
