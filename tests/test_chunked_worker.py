@@ -101,7 +101,14 @@ def test_chunk_step_matches_per_step_device_math():
 
 def test_boundary_send_sequence_matches_per_step_client():
     """Driving boundary() at the schedule's gaps must emit the same message
-    codes, in the same order, as N per-step step() calls + finish()."""
+    codes as N per-step step() calls + finish(), in the same PER-KIND order.
+
+    Pushes ride the background flusher (overlap with compute — VERDICT r4
+    #5) while pull requests go out from the training thread, so the
+    interleaving BETWEEN the two kinds is intentionally unordered (the
+    async-DownPour contract); the cadence guarantee is that each kind's
+    own sequence — and hence its count and payload schedule — is
+    identical. finish() drains the flusher, so capture is complete."""
     model = get_model("lenet")
     params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))["params"]
     zero_grads = jax.tree.map(jnp.zeros_like, params)
@@ -126,6 +133,10 @@ def test_boundary_send_sequence_matches_per_step_client():
         opt_b.idx = gap + length  # the compiled chunk advances the steps
     opt_b.finish()
 
-    assert sent_a == sent_b
+    def by_kind(sent):
+        return ([c for c in sent if c == MessageCode.GradientUpdate],
+                [c for c in sent if c != MessageCode.GradientUpdate])
+
+    assert by_kind(sent_a) == by_kind(sent_b)
     assert MessageCode.GradientUpdate in sent_a
     assert MessageCode.ParameterRequest in sent_a
