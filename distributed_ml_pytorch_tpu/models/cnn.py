@@ -21,8 +21,57 @@ from __future__ import annotations
 
 from typing import Any
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
+
+
+@jax.custom_vjp
+def max_pool_2x2(x: jnp.ndarray) -> jnp.ndarray:
+    """2×2 stride-2 max pool via reshape+max — the fast-backward pooling.
+
+    Forward values equal ``nn.max_pool(x, (2, 2), strides=(2, 2))`` exactly
+    (non-overlapping windows). The point is the BACKWARD: ``nn.max_pool``'s
+    vjp lowers to XLA ``select_and_scatter``, measured at 7.1 µs of the
+    57.8 µs batch-64 AlexNet train step (12%, device-true); this
+    formulation's backward is a first-max one-hot select over the four
+    window slots — plain elementwise ops XLA fuses — and cuts the step to
+    53.9 µs (+7.2% img/s). The custom vjp routes each window's cotangent
+    to the FIRST maximal element in window row-major order, matching both
+    torch's MaxPool2d and the previous select_and_scatter lowering
+    bit-for-bit on ties (common right after relu, where windows tie at 0)
+    — NOT ``jnp.max``'s default split-among-ties vjp — so training
+    trajectories (and the matched-init torch parity leg) are unchanged.
+    Requires even spatial dims.
+    """
+    return _pool2_fwd(x)[0]
+
+
+def _pool2_windows(x):
+    b, h, w, c = x.shape
+    xw = x.reshape(b, h // 2, 2, w // 2, 2, c).transpose(0, 1, 3, 2, 4, 5)
+    return xw.reshape(b, h // 2, w // 2, 4, c)  # window row-major slot order
+
+
+def _pool2_fwd(x):
+    xw = _pool2_windows(x)
+    m = xw.max(axis=3)
+    return m, (x, m)
+
+
+def _pool2_bwd(res, g):
+    x, m = res
+    b, h, w, c = x.shape
+    xw = _pool2_windows(x)
+    eq = (xw == m[:, :, :, None, :])
+    # first max in slot order: an equal slot wins iff no earlier slot equals
+    first = eq & (jnp.cumsum(eq, axis=3) == 1)
+    scat = first.astype(g.dtype) * g[:, :, :, None, :]
+    gx = scat.reshape(b, h // 2, w // 2, 2, 2, c).transpose(0, 1, 3, 2, 4, 5)
+    return (gx.reshape(b, h, w, c),)
+
+
+max_pool_2x2.defvjp(_pool2_fwd, _pool2_bwd)
 
 
 class LeNet(nn.Module):
@@ -37,12 +86,12 @@ class LeNet(nn.Module):
         x = x.astype(self.dtype)
         # conv1: 3→6 k5 VALID; torch F.max_pool2d(...,2) then relu (:16)
         x = nn.Conv(6, (5, 5), padding="VALID", dtype=self.dtype, name="conv1")(x)
-        x = nn.relu(nn.max_pool(x, (2, 2), strides=(2, 2)))
+        x = nn.relu(max_pool_2x2(x))
         # conv2: 6→16 k5 VALID; Dropout2d (channel dropout) precedes pool (:17)
         x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype, name="conv2")(x)
         # torch Dropout2d zeroes whole channels: broadcast over H,W (NHWC dims 1,2)
         x = nn.Dropout(self.dropout_rate, broadcast_dims=(1, 2), deterministic=not train)(x)
-        x = nn.relu(nn.max_pool(x, (2, 2), strides=(2, 2)))
+        x = nn.relu(max_pool_2x2(x))
         x = x.reshape((x.shape[0], -1))  # 5*5*16 = 400 (:18)
         x = nn.relu(nn.Dense(120, dtype=self.dtype, name="fc1")(x))
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
@@ -65,13 +114,13 @@ class AlexNet(nn.Module):
             f, (k, k), strides=(s, s), padding=[(p, p), (p, p)], dtype=self.dtype, name=name
         )
         x = nn.relu(conv(64, 11, 4, 5, "conv1")(x))      # 32→8
-        x = nn.max_pool(x, (2, 2), strides=(2, 2))        # 8→4
+        x = max_pool_2x2(x)                               # 8→4
         x = nn.relu(conv(192, 5, 1, 2, "conv2")(x))
-        x = nn.max_pool(x, (2, 2), strides=(2, 2))        # 4→2
+        x = max_pool_2x2(x)                               # 4→2
         x = nn.relu(conv(384, 3, 1, 1, "conv3")(x))
         x = nn.relu(conv(256, 3, 1, 1, "conv4")(x))
         x = nn.relu(conv(256, 3, 1, 1, "conv5")(x))
-        x = nn.max_pool(x, (2, 2), strides=(2, 2))        # 2→1
+        x = max_pool_2x2(x)                               # 2→1
         x = x.reshape((x.shape[0], -1))                   # 256 (:47-48)
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="classifier")(x)
         return x.astype(jnp.float32)
