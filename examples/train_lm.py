@@ -47,6 +47,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pp-dp", type=int, default=1, metavar="D",
                    help="(--mode pp) data-parallel pipeline replicas on a "
                         "(data=D, stage) mesh — dp x pp composition")
+    p.add_argument("--pp-tp", type=int, default=1, metavar="T",
+                   help="(--mode pp) tensor-parallel width INSIDE each "
+                        "pipeline stage (Megatron block sharding over a "
+                        "model axis); composes with --pp-dp for the full "
+                        "dp x pp x tp 3-D layout")
     p.add_argument("--loss-chunk", type=int, default=0, metavar="C",
                    help="(single/fsdp modes) compute the LM loss in C-token "
                         "sequence chunks without materializing the full "
@@ -241,34 +246,54 @@ def main(argv=None) -> int:
 
         # stages must divide the layer count; microbatches must divide batch
         d_pp = int(args.pp_dp)
+        d_tp = int(args.pp_tp)
         if d_pp < 1:
             parser.error(f"--pp-dp must be >= 1, got {d_pp}")
-        if n_dev % d_pp:
-            parser.error(f"--pp-dp {d_pp} must divide the device count {n_dev}")
-        n_stages = math.gcd(n_dev // d_pp, args.n_layers)
+        if d_tp < 1:
+            parser.error(f"--pp-tp must be >= 1, got {d_tp}")
+        if n_dev % (d_pp * d_tp):
+            parser.error(f"--pp-dp {d_pp} x --pp-tp {d_tp} must divide the "
+                         f"device count {n_dev}")
+        if args.n_heads % d_tp or args.d_ff % d_tp:
+            parser.error(f"--pp-tp {d_tp} must divide n_heads "
+                         f"{args.n_heads} and d_ff {args.d_ff}")
+        n_stages = math.gcd(n_dev // (d_pp * d_tp), args.n_layers)
         n_mb = math.gcd(args.microbatches, args.batch)
         cfg = PipelineLMConfig(
             vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
             n_layers=args.n_layers, d_ff=args.d_ff, max_len=max(args.seq, 256),
         )
+        model_axis = "model" if d_tp > 1 else None
+        tp_desc = f" x {d_tp} tp-in-stage" if d_tp > 1 else ""
         if d_pp > 1:
             if (args.batch // n_mb) % d_pp:
                 parser.error(f"--pp-dp {d_pp} must divide the per-microbatch "
                              f"batch {args.batch // n_mb}")
+            shape = ((d_pp, n_stages, d_tp) if d_tp > 1
+                     else (d_pp, n_stages))
+            axes = (("data", "stage", "model") if d_tp > 1
+                    else ("data", "stage"))
             mesh = Mesh(
-                np.array(jax.devices()[: d_pp * n_stages]).reshape(
-                    d_pp, n_stages),
-                ("data", "stage"),
+                np.array(jax.devices()[: d_pp * n_stages * d_tp]).reshape(
+                    shape),
+                axes,
             )
             step = make_pp_train_step(cfg, tx, mesh, n_microbatches=n_mb,
-                                      data_axis="data")
-            desc = (f"{d_pp}x{n_stages} dp x pp GPipe, {n_mb} microbatches, "
-                    f"grads averaged over {d_pp} pipeline replicas")
+                                      data_axis="data", model_axis=model_axis)
+            desc = (f"{d_pp}x{n_stages} dp x pp GPipe{tp_desc}, {n_mb} "
+                    f"microbatches, grads averaged over {d_pp} pipeline "
+                    "replicas")
         else:
-            mesh = Mesh(np.array(jax.devices()[:n_stages]), ("stage",))
-            step = make_pp_train_step(cfg, tx, mesh, n_microbatches=n_mb)
-            desc = f"{n_stages}-stage GPipe, {n_mb} microbatches"
-        state = create_pp_train_state(cfg, jax.random.key(args.seed), tx, mesh)
+            shape = (n_stages, d_tp) if d_tp > 1 else (n_stages,)
+            axes = ("stage", "model") if d_tp > 1 else ("stage",)
+            mesh = Mesh(
+                np.array(jax.devices()[: n_stages * d_tp]).reshape(shape),
+                axes)
+            step = make_pp_train_step(cfg, tx, mesh, n_microbatches=n_mb,
+                                      model_axis=model_axis)
+            desc = f"{n_stages}-stage GPipe{tp_desc}, {n_mb} microbatches"
+        state = create_pp_train_state(cfg, jax.random.key(args.seed), tx,
+                                      mesh, model_axis=model_axis)
         shard = lambda t, g: microbatch(t, g, n_mb)
     elif args.mode == "moe":
         from distributed_ml_pytorch_tpu.models.moe import MoETransformerLM
