@@ -129,3 +129,19 @@ def test_train_lm_dp_pp_composite():
         "--n-layers", "2", "--d-ff", "64",
     ])
     assert rc == 0
+
+
+def test_train_lm_pp_tp_and_3d_composite():
+    """--pp-tp shards blocks inside each stage (pp x tp); with --pp-dp the
+    full dp x pp x tp 3-D layout runs on the 8-device mesh."""
+    args = ["--mode", "pp", "--steps", "3", "--batch", "8",
+            "--seq", "32", "--vocab", "64", "--d-model", "32", "--n-heads",
+            "8", "--n-layers", "2", "--d-ff", "64"]
+    assert main(args + ["--pp-tp", "2"]) == 0
+    assert main(args + ["--pp-dp", "2", "--pp-tp", "2"]) == 0
+
+
+def test_train_lm_pp_tp_rejects_indivisible_heads(capsys):
+    with pytest.raises(SystemExit):
+        main(["--mode", "pp", "--pp-tp", "3", "--n-heads", "8",
+              "--steps", "1"])
