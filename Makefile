@@ -46,8 +46,19 @@ local-sgd:
 p2p:
 	$(PY) -m distributed_ml_pytorch_tpu.parallel.p2p
 
+# continuous-batching inference hub (serving/cli.py); CTRL-C prints the
+# SLO summary. `make serve-demo` runs the self-contained in-process demo.
+serve:
+	$(PY) -m distributed_ml_pytorch_tpu.serving.cli
+
+serve-demo:
+	$(PY) -m distributed_ml_pytorch_tpu.serving.cli --demo 6
+
 bench:
 	$(PY) bench.py
+
+bench-serving:
+	$(PY) bench_serving.py
 
 bench-all:
 	$(PY) bench_all.py
@@ -80,4 +91,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p bench bench-all test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo bench bench-serving bench-all test test-all verify-real-data graph install dist

@@ -1,0 +1,162 @@
+"""Serving benchmark: synthetic Poisson arrivals through the
+continuous-batching engine (``distributed_ml_pytorch_tpu/serving/``).
+
+An open-loop load generator: request inter-arrival times are exponential
+(rate ``--rate`` req/s), prompt and generation lengths are uniform in the
+given ranges, and a fraction of requests sample with temperature/top-k
+(the rest decode greedily) — all from one seed, so a run is reproducible.
+The driver submits each request when its arrival time passes and spins the
+engine's scheduling loop in between; TTFT therefore includes real queueing
+delay under load, not just prefill time.
+
+Prints exactly ONE JSON line on stdout (BENCH convention, like
+``bench.py``); narration goes to stderr. Runs on whatever the default jax
+platform is — CPU in the test rig, the TPU chip under the driver.
+
+    python bench_serving.py --requests 32 --rate 8 --slots 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--requests", type=int, default=24)
+    p.add_argument("--rate", type=float, default=8.0,
+                   help="mean arrival rate, requests/sec (Poisson)")
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--cache-size", type=int, default=160)
+    p.add_argument("--decode-block", type=int, default=8)
+    p.add_argument("--kv-quant", action="store_true")
+    p.add_argument("--max-queue", type=int, default=256)
+    p.add_argument("--prefill-bucket", type=int, default=16)
+    p.add_argument("--prompt-len", type=int, nargs=2, default=(4, 16),
+                   metavar=("LO", "HI"))
+    p.add_argument("--new-tokens", type=int, nargs=2, default=(8, 48),
+                   metavar=("LO", "HI"))
+    p.add_argument("--sampled-frac", type=float, default=0.5,
+                   help="fraction of requests using temperature sampling")
+    # tiny-LM shape: serving overhead is the subject, not model FLOPs
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--n-heads", type=int, default=4)
+    p.add_argument("--n-layers", type=int, default=2)
+    p.add_argument("--d-ff", type=int, default=128)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json-out", type=str, default="",
+                   help="also write the result JSON to this file")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ml_pytorch_tpu.models import TransformerLM
+    from distributed_ml_pytorch_tpu.serving.engine import ServingEngine
+
+    lm = TransformerLM(
+        vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+        n_layers=args.n_layers, d_ff=args.d_ff,
+        max_len=max(args.cache_size, 256))
+    params = lm.init(jax.random.key(args.seed),
+                     jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = ServingEngine(
+        lm, params, slots=args.slots, cache_size=args.cache_size,
+        decode_block=args.decode_block, kv_quant=args.kv_quant,
+        max_queue=args.max_queue, prefill_bucket=args.prefill_bucket)
+
+    rng = np.random.default_rng(args.seed)
+    plo, phi = args.prompt_len
+    nlo, nhi = args.new_tokens
+    arrivals = np.cumsum(rng.exponential(1.0 / args.rate, args.requests))
+    plan = [
+        dict(
+            prompt=rng.integers(0, args.vocab, size=int(rng.integers(plo, phi + 1))),
+            max_new_tokens=int(rng.integers(nlo, nhi + 1)),
+            **({"temperature": 0.8, "top_k": 16, "seed": int(i)}
+               if rng.random() < args.sampled_frac else {}),
+        )
+        for i in range(args.requests)
+    ]
+
+    # warmup: compile EVERY prefill bucket the prompt-length range can hit
+    # plus the decode block, outside the timed window (bench.py's
+    # traced-call discipline) — a mid-range bucket compiling inside the
+    # loop would land XLA compile time in the TTFT percentiles
+    log("warmup: compiling prefill buckets + decode block ...")
+    for bucket_len in sorted({
+            max(2, -(-int(L) // args.prefill_bucket) * args.prefill_bucket)
+            for L in range(plo, phi + 1)}):
+        # a bucket-length prompt maps exactly to its own bucket (a shorter
+        # one can fall into a smaller bucket at --prefill-bucket 1)
+        w = engine.submit(np.zeros(bucket_len, np.int32),
+                          args.decode_block + 2)
+        engine.run_until_idle()
+        assert w.done
+    engine.reset_metrics()  # warmup must not pollute the SLO samples
+
+    log(f"offered load: {args.requests} requests at {args.rate}/s "
+        f"(prompts {plo}-{phi}, {nlo}-{nhi} new tokens, "
+        f"{args.slots} slots, block {args.decode_block}"
+        + (", int8 kv" if args.kv_quant else "") + ")")
+    handles = []
+    next_i = 0
+    t0 = time.perf_counter()
+    while len(handles) < args.requests or not all(h.done for h in handles):
+        now = time.perf_counter() - t0
+        while next_i < args.requests and arrivals[next_i] <= now:
+            handles.append(engine.submit(**plan[next_i]))
+            next_i += 1
+        if not engine.step():
+            if next_i < args.requests:
+                time.sleep(min(0.002, max(0.0, arrivals[next_i] - now)))
+    wall = time.perf_counter() - t0
+
+    total_tokens = sum(len(h.tokens) for h in handles)
+    summary = engine.slo_summary()
+    throughput = total_tokens / wall
+    log(f"served {args.requests} requests / {total_tokens} tokens "
+        f"in {wall:.2f}s -> {throughput:.1f} tok/s on "
+        f"{jax.devices()[0].platform}")
+
+    result = {
+        "metric": "serving_decode_throughput",
+        "value": round(throughput, 2),
+        "unit": "tokens/sec",
+        "requests": args.requests,
+        "offered_rate_rps": args.rate,
+        "wall_s": round(wall, 3),
+        "ttft_ms": summary["ttft_ms"],
+        "tpot_ms": summary["tpot_ms"],
+        "queue_depth": summary["queue_depth"],
+        "slot_occupancy": round(summary["slot_occupancy"], 4),
+        "slots": args.slots,
+        "decode_block": args.decode_block,
+        "kv_quant": bool(args.kv_quant),
+        "platform": jax.devices()[0].platform,
+    }
+    line = json.dumps(result)
+    print(line)
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+        log(f"wrote {args.json_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

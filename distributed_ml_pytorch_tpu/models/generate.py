@@ -93,6 +93,56 @@ def sample_tokens(
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def sample_tokens_dynamic(
+    logits: jnp.ndarray,
+    rngs: jax.Array,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+) -> jnp.ndarray:
+    """Per-row sampling over ``[B, vocab]`` logits with PER-ROW params.
+
+    The serving engine's heterogeneous-batch face of :func:`sample_tokens`:
+    every argument after ``logits`` is a length-``B`` array (one rng key,
+    temperature, top-k, top-p per row), all TRACED — one compiled program
+    serves any mix of greedy and sampled requests. Row semantics match
+    :func:`sample_tokens` exactly: for a single row, the token equals
+    ``sample_tokens(logits[None], key, t, k, p)[0]`` bit-for-bit on CPU
+    (tested), because the masking math mirrors it op-for-op and a
+    categorical draw over ``[vocab]`` consumes the same random bits as one
+    over ``[1, vocab]``. ``temperature <= 0`` rows are greedy argmax.
+    """
+    vocab = logits.shape[-1]
+    neg = jnp.asarray(jnp.finfo(logits.dtype).min, logits.dtype)
+
+    def one(lg, key, t, k, p):
+        greedy = jnp.argmax(lg, axis=-1)
+        scaled = lg / jnp.where(t > 0.0, t, 1.0).astype(lg.dtype)
+        # ONE descending sort serves both filters (same as sample_tokens);
+        # the filters gate on their own params so off rows pass through
+        sort_desc = jnp.sort(scaled, axis=-1)[::-1]
+        kk = jnp.clip(k, 0, vocab)
+        kth = sort_desc[jnp.maximum(kk - 1, 0)]
+        use_k = kk > 0
+        scaled = jnp.where(use_k & (scaled < kth), neg, scaled)
+        sort_desc = jnp.where(use_k & (jnp.arange(vocab) >= kk), neg, sort_desc)
+        probs = jax.nn.softmax(sort_desc, axis=-1)
+        exceeded = (jnp.cumsum(probs, axis=-1) - probs) >= p
+        exceeded = exceeded.at[0].set(False)
+        cut = jnp.where(exceeded, jnp.inf, sort_desc)
+        thresh = jnp.min(cut, axis=-1)
+        scaled = jnp.where((p < 1.0) & (scaled < thresh), neg, scaled)
+        sampled = jax.random.categorical(key, scaled, axis=-1)
+        return jnp.where(t > 0.0, sampled, greedy)
+
+    return jax.vmap(one)(
+        logits, rngs,
+        jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(top_k, jnp.int32),
+        jnp.asarray(top_p, jnp.float32),
+    )
+
+
 def _fuse_qkv_params(params, name: str = ""):
     """Rewrite a trained param tree into the ``fused_qkv`` module layout:
     every attention dict {q, k, v, o} becomes {qkv, o} with the three
@@ -157,16 +207,17 @@ DECODE_BLOCK = 16
 MAX_UNROLLED_BLOCKS = 64
 
 
-def _split_cache(cache):
+def split_cache(cache):
     """Split a decode cache pytree into (big, small): the per-layer big K/V
     caches vs everything else (rings, cursors, ring_base). The big part is
     closed over as a CONSTANT by the blocked scan's inner loop — carrying it
     would reintroduce the per-step full-cache copies the ring exists to
-    avoid."""
+    avoid. Public: the serving slot pool (``serving/cache.py``) splits its
+    stacked per-slot caches with the same name-based rule."""
     big, small = {}, {}
     for name, val in cache.items():
         if isinstance(val, dict):
-            b, s = _split_cache(val)
+            b, s = split_cache(val)
             if b:
                 big[name] = b
             if s:
@@ -178,11 +229,12 @@ def _split_cache(cache):
     return big, small
 
 
-def _join_cache(big, small):
+def join_cache(big, small):
+    """Inverse of :func:`split_cache`: reassemble the full cache pytree."""
     out = dict(small)
     for name, val in big.items():
         if isinstance(val, dict):
-            out[name] = _join_cache(val, small.get(name, {}))
+            out[name] = join_cache(val, small.get(name, {}))
         else:
             out[name] = val
     return out
@@ -215,7 +267,11 @@ def init_cache(model, batch: int, cache_size: int, decode_block: int = 0,
     the quant prefill attends with its exact in-hand K/V and deliberately
     does not read earlier blocks back. :func:`generate` always satisfies
     this; direct module users chaining prefills must re-init the cache
-    (or use the exact bf16 cache, which has no such restriction)."""
+    (or use the exact bf16 cache, which has no such restriction). The
+    serving slot pool (``serving/cache.py``) also satisfies it under slot
+    REUSE: every admission prefills a fresh zeroed lane cache and scatters
+    it over the recycled slot, so the contract holds per occupancy, not
+    just per allocation."""
     dec = _decode_model(model, cache_size, decode_block=decode_block,
                         kv_quant=kv_quant)
     variables = jax.eval_shape(
@@ -444,12 +500,14 @@ def _tree_slice_big(big, live):
         lambda a: a[:, :, :live, :] if a.ndim == 4 else a[:, :, :live], big)
 
 
-def _tree_merge_static(big, small, live):
-    """Merge every layer's ring into its FULL big cache at static offset
-    ``live``; returns the updated big pytree (rings themselves are reused —
-    the next block's strict ring mask hides stale slots). Quantized caches
+def merge_ring_caches(big, small, live):
+    """Merge every layer's ring into its FULL big cache at offset ``live``;
+    returns the updated big pytree (rings themselves are reused — the next
+    block's strict ring mask hides stale slots). Quantized caches
     (``kv_quant``: int8 values + scale arrays present) quantize the exact
-    bf16 ring here, once per block."""
+    bf16 ring here, once per block. ``live`` may be a static int (the
+    blocked generate path — the static offset fuses) or a traced scalar
+    (the serving slot pool vmaps this over slots with per-slot offsets)."""
     if "cached_k" in big:
         from distributed_ml_pytorch_tpu.models.transformer import quantize_kv
 
@@ -468,19 +526,20 @@ def _tree_merge_static(big, small, live):
             big["cached_v"], rv, (0, 0, live, 0))
         return out
     return {
-        name: (_tree_merge_static(val, small.get(name, {}), live)
+        name: (merge_ring_caches(val, small.get(name, {}), live)
                if isinstance(val, dict) else val)
         for name, val in big.items()
     }
 
 
-def _reset_small(small, live):
+def reset_ring_state(small, live):
     """Per-block small-state reset: cursor and ring_base both sit at the
-    block's start position ``live`` (rings keep stale data — masked out)."""
+    block's start position ``live`` (rings keep stale data — masked out).
+    ``live`` may be static or traced, like :func:`merge_ring_caches`."""
     out = {}
     for name, val in small.items():
         if isinstance(val, dict):
-            out[name] = _reset_small(val, live)
+            out[name] = reset_ring_state(val, live)
         elif name in ("cursor", "ring_base"):
             out[name] = jnp.asarray(live, jnp.int32)
         else:
@@ -523,7 +582,7 @@ def _generate_blocked_jit(dec, max_new_tokens, temperature, top_k, top_p,
     logits, mutated = dec.apply(
         {"params": params, "cache": cache}, prompt, positions, mutable=["cache"]
     )
-    big, small = _split_cache(mutated["cache"])
+    big, small = split_cache(mutated["cache"])
 
     def sample(logits, step_rng):
         return sample_tokens(
@@ -536,22 +595,22 @@ def _generate_blocked_jit(dec, max_new_tokens, temperature, top_k, top_p,
         live = p + blk * T
         dec_blk = dec.clone(cache_size=live)
         big_view = _tree_slice_big(big, live)
-        small = _reset_small(small, live)
+        small = reset_ring_state(small, live)
 
         def inner(carry, t, dec_blk=dec_blk, big_view=big_view, blk=blk):
             small, tok = carry
             step_idx = blk * T + t
             pos = jnp.full((b, 1), p, jnp.int32) + step_idx
             logits, mut = dec_blk.apply(
-                {"params": params, "cache": _join_cache(big_view, small)},
+                {"params": params, "cache": join_cache(big_view, small)},
                 tok[:, None], pos, mutable=["cache"],
             )
-            _, small = _split_cache(mut["cache"])
+            _, small = split_cache(mut["cache"])
             nxt = sample(logits[:, -1], jax.random.fold_in(rng, step_idx + 1))
             return (small, nxt), tok
 
         (small, tok), toks = jax.lax.scan(inner, (small, tok), jnp.arange(T))
-        big = _tree_merge_static(big, small, live)
+        big = merge_ring_caches(big, small, live)
         all_toks.append(jnp.moveaxis(toks, 0, 1))  # [B, T] inputs of each step
 
     generated = jnp.concatenate(all_toks + [tok[:, None]], axis=1)

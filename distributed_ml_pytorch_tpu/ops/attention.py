@@ -293,7 +293,8 @@ def _vma_struct(shape, dtype, like):
     silently producing wrong replicated-param grads under
     ``check_vma=False``). Outside shard_map ``vma`` is empty/absent and
     this degrades to a plain struct."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    typeof = getattr(jax, "typeof", None)  # absent before jax grew vma types
+    vma = getattr(typeof(like), "vma", None) if typeof is not None else None
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

@@ -87,8 +87,7 @@ def run_demo(n_devices: int = 2) -> np.ndarray:
 
 
 if __name__ == "__main__":
-    if len(jax.devices()) < 2:
-        from distributed_ml_pytorch_tpu.runtime.mesh import force_cpu_devices
+    from distributed_ml_pytorch_tpu.runtime.mesh import ensure_min_devices
 
-        force_cpu_devices(2)
+    ensure_min_devices(2)  # virtual CPU devices when the host has one chip
     run_demo(2)

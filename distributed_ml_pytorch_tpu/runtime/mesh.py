@@ -61,11 +61,23 @@ def simulate_cpu_devices(n: int = 8) -> None:
     unit tests exercise real ``psum``/``ppermute`` collectives on an n-device
     CPU mesh without TPU hardware.
     """
+    _set_host_device_count_flag(n)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _set_host_device_count_flag(n: int) -> None:
+    """Put the host-platform device-count token into XLA_FLAGS, replacing
+    any token with a different count (last-request-wins, e.g. an
+    ``ensure_min_devices(2)`` demo bootstrap followed by the test
+    conftest's ``force_cpu_devices(8)``). Only effective before the first
+    CPU client is created — the runtime parses the flag once."""
     flags = os.environ.get("XLA_FLAGS", "")
     token = f"--xla_force_host_platform_device_count={n}"
-    if token not in flags:
-        os.environ["XLA_FLAGS"] = (flags + " " + token).strip()
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if token in flags.split():
+        return
+    kept = [f for f in flags.split()
+            if not f.startswith("--xla_force_host_platform_device_count")]
+    os.environ["XLA_FLAGS"] = " ".join(kept + [token])
 
 
 def force_cpu_devices(n: int = 8) -> None:
@@ -77,6 +89,11 @@ def force_cpu_devices(n: int = 8) -> None:
     """
     import jax as _jax
 
+    # Set the device-count flag BEFORE touching jax.devices(): the CPU client
+    # reads XLA_FLAGS once at its first creation, so on runtimes without the
+    # jax_num_cpu_devices config option this is the only lever — and it only
+    # works if no CPU backend exists yet.
+    simulate_cpu_devices(n)
     devs = _jax.devices()
     if len(devs) >= n and devs[0].platform == "cpu":
         return
@@ -85,8 +102,33 @@ def force_cpu_devices(n: int = 8) -> None:
     xla_bridge._clear_backends()
     xla_bridge.get_backend.cache_clear()
     _jax.config.update("jax_platforms", "cpu")
-    _jax.config.update("jax_num_cpu_devices", n)
+    try:
+        _jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        # older jax: no such option; the re-created backend re-reads the
+        # XLA_FLAGS token set above on runtimes that parse flags per-client
+        pass
     assert len(_jax.devices()) == n, _jax.devices()
+
+
+def ensure_min_devices(n: int) -> None:
+    """Guarantee at least ``n`` devices, provisioning virtual CPU devices
+    only when needed.
+
+    Unlike calling ``jax.devices()`` and then :func:`force_cpu_devices`,
+    this sets the host-platform device-count flag BEFORE the first backend
+    creation when no backend exists yet — on runtimes without the
+    ``jax_num_cpu_devices`` config option that order is the only one that
+    works. Only the flag is set pre-boot (never ``JAX_PLATFORMS``), so a
+    host with real accelerator chips still initializes them and is left
+    untouched when they satisfy ``n``.
+    """
+    from jax._src import xla_bridge
+
+    if not xla_bridge._backends:
+        _set_host_device_count_flag(n)
+    if len(jax.devices()) < n:
+        force_cpu_devices(n)
 
 
 def make_mesh(

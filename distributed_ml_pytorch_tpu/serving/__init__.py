@@ -1,0 +1,45 @@
+"""Serving layer: a continuous-batching inference engine for the
+TransformerLM family.
+
+The training half of the framework ends at ``models/generate.py`` — one
+prompt batch, one ``generate()`` call. This package is the inference half
+the ROADMAP's "heavy traffic from millions of users" north star needs: many
+concurrent requests of different lengths share ONE compiled decode step
+(the paper's DownPour shape transposed to serving — many asynchronous
+clients, one compiled data plane, host-side control plane).
+
+- :mod:`serving.cache` — fixed-capacity KV **slot pool** over the
+  ring-buffered blocked decode cache (``models/generate.py``), per-slot
+  live-length tracking, optional int8 ``kv_quant`` storage.
+- :mod:`serving.engine` — the scheduler: admit queued requests into free
+  slots between decode blocks, per-request sampling params, eviction,
+  admission control/backpressure, SLO metrics (TTFT/TPOT/occupancy).
+- :mod:`serving.frontend` — request/response transport over the L1
+  messaging layer (``utils/messaging.py``): in-process and TCP clients
+  submit prompts and stream tokens back.
+- :mod:`serving.cli` — the ``serve`` entrypoint.
+"""
+
+from distributed_ml_pytorch_tpu.serving.cache import SlotKVPool
+from distributed_ml_pytorch_tpu.serving.engine import (
+    QueueFullError,
+    Request,
+    SamplingParams,
+    ServingEngine,
+)
+from distributed_ml_pytorch_tpu.serving.frontend import (
+    RequestRejected,
+    ServingClient,
+    ServingFrontend,
+)
+
+__all__ = [
+    "SlotKVPool",
+    "ServingEngine",
+    "Request",
+    "SamplingParams",
+    "QueueFullError",
+    "ServingFrontend",
+    "ServingClient",
+    "RequestRejected",
+]

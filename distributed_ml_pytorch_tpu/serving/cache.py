@@ -1,0 +1,299 @@
+"""Slot-based KV cache pool — the serving data plane.
+
+``models/generate.py`` decodes ONE prompt batch: every sequence starts
+together, shares one cursor, and the whole cache dies with the call. A
+serving engine needs the opposite: S long-lived cache *slots*, each holding
+an independent sequence at its own length, all advanced by one compiled
+decode step per token. This module provides that pool by **vmapping the
+existing ring-buffered blocked decode module over the slot axis**:
+
+- every slot is a batch-1 instance of the exact cache ``generate()`` uses
+  (big per-layer K/V + per-layer ring + cursor/ring_base), stacked to a
+  leading ``(slots, ...)`` axis;
+- under ``jax.vmap`` the per-layer ``cursor``/``ring_base`` scalars become
+  per-slot vectors — which is precisely the per-slot live-length tracking a
+  heterogeneous batch needs, with zero changes to the attention module;
+- decode steps write each slot's ring; once per block the rings merge into
+  the big caches at PER-SLOT offsets (``merge_ring_caches`` vmapped with a
+  traced ``live``), and ``ring_base`` advances — the same amortization
+  that removed the full-cache copies from the decode scan (DESIGN.md §5b),
+  minus the static live-prefix read (slot lengths differ, so reads cover
+  the full allocation under the ``key_pos < ring_base`` mask).
+
+Admission (prefill) runs per request on a FRESH zeroed lane cache and is
+scattered into the pool at the target slot. That freshness is what makes
+slot REUSE safe under ``kv_quant``: the int8 cache's single-prefill
+contract (``init_cache``) requires the first multi-token apply to happen at
+cursor 0, and a recycled slot always restarts from a zero lane rather than
+the previous occupant's state. Prompts may be right-padded to a bucket
+length to bound prefill compile count: padded positions write garbage K/V
+past the prompt, but causal masking keeps real logits exact, the cursor is
+rewound to the true length, and the ``key_pos < ring_base`` mask hides the
+garbage until decode merges overwrite it.
+
+Exactness contract (CPU): a request decoded through the pool picks
+token-for-token what a standalone ``generate()`` picks for the same
+``(params, prompt, rng)`` — the attention math is the same module, the
+extra masked cache tail contributes exact zeros, and the sampler consumes
+the same folded keys (tested in ``tests/test_serving.py``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_ml_pytorch_tpu.models.generate import (
+    DECODE_BLOCK,
+    _decode_model,
+    _fuse_qkv_params,
+    init_cache,
+    join_cache,
+    merge_ring_caches,
+    sample_tokens_dynamic,
+    split_cache,
+)
+
+
+def find_cache_leaf(tree, name: str):
+    """First leaf called ``name`` in a (possibly stacked) cache pytree.
+
+    Every attention layer carries its own ``cursor``/``ring_base`` and the
+    blocked decode advances them in lockstep, so any one leaf is the
+    per-slot truth (deterministic traversal order for trace stability).
+    """
+    if isinstance(tree, dict):
+        for key in sorted(tree):
+            val = tree[key]
+            if key == name and not isinstance(val, dict):
+                return val
+            if isinstance(val, dict):
+                found = find_cache_leaf(val, name)
+                if found is not None:
+                    return found
+    return None
+
+
+def replace_cache_leaves(tree, mapping):
+    """Rebuild a cache pytree with every leaf named in ``mapping`` replaced
+    by the mapped value (cast to the leaf's dtype, broadcast to its shape).
+    Used to rewind cursors after a padded prefill and to reset freed slots."""
+    out = {}
+    for name, val in tree.items():
+        if isinstance(val, dict):
+            out[name] = replace_cache_leaves(val, mapping)
+        elif name in mapping:
+            out[name] = jnp.broadcast_to(
+                jnp.asarray(mapping[name], val.dtype), val.shape)
+        else:
+            out[name] = val
+    return out
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _admit_jit(dec, params, pool, slot, prompt, real_len, seed,
+               temperature, top_k, top_p):
+    """Prefill ``prompt`` ([1, bucket] int32, right-padded past ``real_len``)
+    on a fresh lane cache, sample the request's first token, and scatter the
+    lane into ``pool`` at ``slot``. Returns ``(pool, first_token)``."""
+    lane = jax.tree.map(lambda x: jnp.zeros(x.shape[1:], x.dtype), pool)
+    bucket = prompt.shape[1]
+    positions = jnp.arange(bucket)[None, :]
+    logits, mutated = dec.apply(
+        {"params": params, "cache": lane}, prompt, positions, mutable=["cache"]
+    )
+    # rewind cursor/ring_base from the padded bucket end to the true prompt
+    # length: the pad region's K/V is garbage the ``key_pos < ring_base``
+    # mask hides until decode merges overwrite it
+    lane = replace_cache_leaves(
+        mutated["cache"], {"cursor": real_len, "ring_base": real_len})
+    last = jax.lax.dynamic_index_in_dim(logits[0], real_len - 1, keepdims=False)
+    keys = jax.vmap(
+        lambda s: jax.random.fold_in(jax.random.key(s), 0))(seed[None])
+    tok0 = sample_tokens_dynamic(
+        last[None], keys, temperature[None], top_k[None], top_p[None])[0]
+    pool = jax.tree.map(
+        lambda P, L: jax.lax.dynamic_update_slice(
+            P, L[None], (slot,) + (0,) * L.ndim),
+        pool, lane)
+    return pool, tok0.astype(jnp.int32)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _reset_slots_jit(pool, mask):
+    """Zero the cursor/ring_base of every slot where ``mask`` is True: the
+    freed slot's cache contents become invisible (``key_pos < ring_base``)
+    and its live length reads 0 until the next admission overwrites it."""
+
+    def walk(tree):
+        out = {}
+        for name, val in tree.items():
+            if isinstance(val, dict):
+                out[name] = walk(val)
+            elif name in ("cursor", "ring_base"):
+                out[name] = jnp.where(mask, 0, val)
+            else:
+                out[name] = val
+        return out
+
+    return walk(pool)
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _decode_block_jit(dec, params, pool, tok, n_gen, seeds,
+                      temps, top_ks, top_ps, active):
+    """One decode block for the whole pool: ``decode_block`` single-token
+    steps vmapped over slots, then per-slot ring merges.
+
+    Mirrors ``_generate_blocked_jit``'s structure: the big caches cross the
+    scan as constants (only the small ring state is carried), appends hit
+    the per-layer rings, and the merge amortizes the big-cache write to
+    once per block. Slots where ``active`` is False decode garbage from a
+    zeroed state (their tokens are discarded by the scheduler) and are
+    re-zeroed on exit so their cursors never creep toward the cache edge.
+    Token ``g`` of a request is sampled with ``fold_in(key(seed), g)`` —
+    the same per-step key schedule ``generate()`` uses, which is what makes
+    engine output bit-match a standalone ``generate`` call on CPU.
+    """
+    T = dec.decode_block
+    big, small = split_cache(pool)
+    base = find_cache_leaf(small, "ring_base")  # (S,) per-slot block start
+
+    def lane_apply(lane_cache, tok1, pos1):
+        logits, mutated = dec.apply(
+            {"params": params, "cache": lane_cache},
+            tok1[None, None], pos1[None, None], mutable=["cache"],
+        )
+        return logits[0, -1], mutated["cache"]
+
+    def step(carry, _):
+        small, tok, g = carry
+        cursor = find_cache_leaf(small, "cursor")  # (S,) = absolute position
+        logits, cache = jax.vmap(lane_apply)(join_cache(big, small), tok, cursor)
+        _, small = split_cache(cache)
+        keys = jax.vmap(
+            lambda s, i: jax.random.fold_in(jax.random.key(s), i))(seeds, g)
+        nxt = sample_tokens_dynamic(
+            logits, keys, temps, top_ks, top_ps).astype(jnp.int32)
+        return (small, nxt, g + 1), nxt
+
+    (small, _, _), toks = jax.lax.scan(
+        step, (small, tok, jnp.asarray(n_gen, jnp.int32)), None, length=T)
+
+    live = jnp.where(active, base, 0)
+    big = jax.vmap(merge_ring_caches)(big, small, live)
+    cursor = find_cache_leaf(small, "cursor")
+    small = replace_cache_leaves(small, {
+        "cursor": jnp.where(active, cursor, 0),
+        "ring_base": jnp.where(active, base + T, 0),
+    })
+    return join_cache(big, small), jnp.moveaxis(toks, 0, 1)  # [S, T]
+
+
+class SlotKVPool:
+    """Fixed-capacity pool of ``slots`` independent KV cache slots, each of
+    total length ``cache_size``, over the blocked decode module.
+
+    The pool is the compiled data plane; the scheduler
+    (``serving/engine.py``) owns which slot belongs to which request. All
+    per-request sampling state (seed/temperature/top-k/top-p) is traced, so
+    one compiled block program serves any mix of greedy and sampled
+    requests.
+    """
+
+    def __init__(self, model, params, *, slots: int, cache_size: int,
+                 decode_block: int = DECODE_BLOCK, kv_quant: bool = False):
+        if slots < 1:
+            raise ValueError(f"need at least one slot, got {slots}")
+        if decode_block < 1:
+            raise ValueError(
+                "the slot pool rides the ring-buffered blocked cache — "
+                f"decode_block must be >= 1, got {decode_block}")
+        max_len = getattr(model, "max_len", None)
+        if (max_len is not None and cache_size > max_len
+                and getattr(model, "pos_encoding", "learned") != "rope"):
+            raise ValueError(
+                f"cache_size {cache_size} exceeds the model's learned "
+                f"position table max_len={max_len} (RoPE models have no "
+                "such bound)")
+        self.slots = int(slots)
+        self.cache_size = int(cache_size)
+        self.decode_block = int(decode_block)
+        self.kv_quant = bool(kv_quant)
+        self.model = model
+        self.dec = _decode_model(model, cache_size, decode_block=decode_block,
+                                 kv_quant=kv_quant)
+        self.params = (
+            _fuse_qkv_params(params)
+            if getattr(self.dec, "fused_qkv", False) else params)
+        lane = jax.eval_shape(lambda: init_cache(
+            model, 1, self.cache_size, decode_block=self.decode_block,
+            kv_quant=self.kv_quant))
+        self.cache = jax.tree.map(
+            lambda s: jnp.zeros((self.slots,) + s.shape, s.dtype), lane)
+
+    def admit(self, slot: int, prompt: np.ndarray, real_len: int, *,
+              seed: int = 0, temperature: float = 0.0, top_k: int = 0,
+              top_p: float = 1.0) -> int:
+        """Prefill a (bucketed) prompt into ``slot``; returns the request's
+        first sampled token. One compiled program per bucket length."""
+        prompt = jnp.asarray(prompt, jnp.int32)[None, :]
+        if prompt.shape[1] < 2:
+            # s == 1 is the decode-step discriminator inside the blocked
+            # module: a 1-token "prefill" would write the ring, orphaning
+            # the prompt's K/V (the hazard uses_block_decode documents) —
+            # callers must pad 1-token prompts (ServingEngine._bucket_len)
+            raise ValueError(
+                "admit() needs a prompt of length >= 2 — pad 1-token "
+                "prompts (a length-1 apply is a decode step, not a prefill)")
+        self.cache, tok0 = _admit_jit(
+            self.dec, self.params, self.cache,
+            jnp.asarray(slot, jnp.int32), prompt,
+            jnp.asarray(real_len, jnp.int32),
+            jnp.asarray(seed, jnp.uint32),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_k, jnp.int32),
+            jnp.asarray(top_p, jnp.float32))
+        return int(tok0)
+
+    def decode_block_step(self, tok, n_gen, seeds, temps, top_ks, top_ps,
+                          active) -> np.ndarray:
+        """Advance every slot by one ``decode_block``-token block; returns
+        the sampled tokens ``[slots, decode_block]`` (host array — the
+        fetch is the block's device sync point)."""
+        self.cache, toks = _decode_block_jit(
+            self.dec, self.params, self.cache,
+            jnp.asarray(tok, jnp.int32), jnp.asarray(n_gen, jnp.int32),
+            jnp.asarray(seeds, jnp.uint32), jnp.asarray(temps, jnp.float32),
+            jnp.asarray(top_ks, jnp.int32), jnp.asarray(top_ps, jnp.float32),
+            jnp.asarray(active, bool))
+        return np.asarray(toks)
+
+    def reset_slots(self, slot_indices) -> None:
+        """Mark the given slots empty (cursor/ring_base back to 0)."""
+        mask = np.zeros(self.slots, bool)
+        mask[list(slot_indices)] = True
+        self.cache = _reset_slots_jit(self.cache, jnp.asarray(mask))
+
+    def live_lengths(self) -> np.ndarray:
+        """Per-slot live sequence length (prompt + generated), from the
+        cache's own cursors — the observability face of slot occupancy."""
+        cur = find_cache_leaf(self.cache, "cursor")
+        return np.asarray(cur).reshape(self.slots)
+
+    def blocks_needed(self, max_new_tokens: int) -> int:
+        """Decode blocks a request of ``max_new_tokens`` occupies a slot for
+        (its first token comes from prefill, the rest from whole blocks)."""
+        return -(-(max_new_tokens - 1) // self.decode_block)
+
+    def capacity_needed(self, prompt_len: int, bucket_len: int,
+                        max_new_tokens: int) -> int:
+        """Cache rows the request can touch: the padded prefill writes up to
+        ``bucket_len``, and block-granular decode writes merges from the
+        true prompt length through the rounded-up tail block."""
+        decoded = self.blocks_needed(max_new_tokens) * self.decode_block
+        return max(bucket_len, prompt_len + decoded)

@@ -1,0 +1,222 @@
+"""``serve`` — the serving-side CLI (counterpart of ``training/cli.py``).
+
+Starts a continuous-batching engine for a ``TransformerLM`` and exposes it
+over a messaging transport::
+
+    # TCP server: waits for --clients client processes on --port
+    python -m distributed_ml_pytorch_tpu.serving.cli --port 29600 --clients 1
+
+    # restore trained params (examples/train_lm.py checkpoint)
+    python -m distributed_ml_pytorch_tpu.serving.cli --ckpt-dir /tmp/lm ...
+
+    # self-contained demo: an in-process client drives N mixed
+    # greedy/sampled requests through the full frontend path, prints the
+    # SLO summary, exits (what the CLI tests run)
+    python -m distributed_ml_pytorch_tpu.serving.cli --demo 6
+
+Engine knobs: ``--slots`` (concurrent sequences), ``--cache-size`` (rows
+per slot: prompt + padded decode blocks), ``--decode-block`` (tokens per
+compiled block — admission latency vs merge amortization), ``--kv-quant``
+(int8 slot caches: half the pool HBM, see the single-prefill note in
+``serving/cache.py``), ``--max-queue`` (backpressure threshold),
+``--prefill-bucket`` (prompt-length bucketing: compile count vs pad waste).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="Continuous-batching TransformerLM serving engine")
+    # model size (mirrors examples/generate_text.py)
+    p.add_argument("--vocab", type=int, default=512)
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--n-heads", type=int, default=8)
+    p.add_argument("--n-layers", type=int, default=2)
+    p.add_argument("--d-ff", type=int, default=256)
+    p.add_argument("--max-len", type=int, default=0,
+                   help="learned-position table size (0 = derived from "
+                        "--cache-size; checkpoint restores must match the "
+                        "training run's table)")
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--pos-encoding", default="learned",
+                   choices=["learned", "rope"])
+    p.add_argument("--ckpt-dir", type=str, default="",
+                   help="restore params from an examples/train_lm.py orbax "
+                        "checkpoint (default: fresh random init)")
+    # engine
+    p.add_argument("--slots", type=int, default=4,
+                   help="concurrent sequences sharing the compiled decode step")
+    p.add_argument("--cache-size", type=int, default=256,
+                   help="KV rows per slot (bounds prompt + generation)")
+    p.add_argument("--decode-block", type=int, default=16,
+                   help="tokens per compiled decode block (admission happens "
+                        "between blocks)")
+    p.add_argument("--kv-quant", action="store_true",
+                   help="int8 slot caches with per-key scales — half the "
+                        "pool footprint")
+    p.add_argument("--max-queue", type=int, default=64,
+                   help="queued-request cap; beyond it submissions are "
+                        "rejected (backpressure)")
+    p.add_argument("--prefill-bucket", type=int, default=16,
+                   help="round prompt lengths up to this multiple for "
+                        "prefill compilation (1 = exact lengths)")
+    # transport
+    p.add_argument("--port", type=str, default="29600",
+                   help="TCP port the engine's rank-0 hub binds")
+    p.add_argument("--master", type=str, default="localhost")
+    p.add_argument("--clients", type=int, default=1,
+                   help="client processes the TCP rendezvous waits for "
+                        "(clients may later drop and rejoin)")
+    p.add_argument("--demo", type=int, default=0, metavar="N",
+                   help="serve N synthetic requests from an in-process "
+                        "client, print the SLO summary, exit")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def _build_engine(args, parser):
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ml_pytorch_tpu.models import TransformerLM
+    from distributed_ml_pytorch_tpu.serving.engine import ServingEngine
+
+    if args.d_model % args.n_heads:
+        parser.error(f"--d-model {args.d_model} must divide by --n-heads "
+                     f"{args.n_heads}")
+    lm = TransformerLM(
+        vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+        n_layers=args.n_layers, d_ff=args.d_ff,
+        max_len=args.max_len or max(args.cache_size, 256),
+        dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+        pos_encoding=args.pos_encoding,
+    )
+    if not args.ckpt_dir:
+        params = lm.init(
+            jax.random.key(args.seed), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+    else:
+        import optax
+
+        from distributed_ml_pytorch_tpu.parallel.seq_parallel import (
+            create_lm_train_state,
+        )
+        from distributed_ml_pytorch_tpu.utils.checkpoint import Checkpointer
+
+        with Checkpointer(args.ckpt_dir) as ckpt:
+            step = ckpt.latest_step()
+            if step is None:
+                raise SystemExit(
+                    f"no checkpoint under {args.ckpt_dir} — train one with "
+                    "examples/train_lm.py --ckpt-dir first")
+            template = jax.eval_shape(lambda: create_lm_train_state(
+                lm, jax.random.key(args.seed), optax.sgd(0.1)))
+            state, step = ckpt.restore(template)
+            params = state.params
+            print(f"restored params from step {step} of {args.ckpt_dir}")
+    return ServingEngine(
+        lm, params, slots=args.slots, cache_size=args.cache_size,
+        decode_block=args.decode_block, kv_quant=args.kv_quant,
+        max_queue=args.max_queue, prefill_bucket=args.prefill_bucket)
+
+
+def _print_summary(engine) -> None:
+    import json
+
+    summary = engine.slo_summary()
+    print("SLO summary:", json.dumps(summary, indent=2, default=float))
+
+
+def _run_demo(args, engine) -> int:
+    import threading
+
+    import numpy as np
+
+    from distributed_ml_pytorch_tpu.serving.frontend import (
+        ServingClient,
+        ServingFrontend,
+    )
+    from distributed_ml_pytorch_tpu.utils.messaging import InProcessTransport
+
+    world = InProcessTransport.create_world(2)
+    frontend = ServingFrontend(engine, world[0])
+    client = ServingClient(world[1])
+    server = threading.Thread(target=frontend.serve_forever, daemon=True)
+    server.start()
+
+    rng = np.random.default_rng(args.seed)
+    # cap generation lengths so every demo request fits the slot capacity
+    # check in ServingEngine.submit (bucketed prompt + whole decode blocks)
+    budget = max(
+        2, min(24, args.cache_size - args.prefill_bucket - args.decode_block))
+    try:
+        # submit everything up front so the engine actually batches the
+        # requests together, then collect the streams
+        submitted = []
+        for i in range(args.demo):
+            prompt = rng.integers(
+                0, args.vocab, size=int(rng.integers(2, 12))).astype(np.int32)
+            new = int(rng.integers(2, budget + 1))
+            sampled = bool(i % 2)
+            rid = client.submit(
+                prompt, new,
+                temperature=0.8 if sampled else 0.0,
+                top_k=8 if sampled else 0, seed=int(i))
+            submitted.append((rid, new))
+        results = {
+            rid: (new, list(client.stream(rid, timeout=120.0)))
+            for rid, new in submitted
+        }
+        for rid, (new, toks) in results.items():
+            if len(toks) != new or any(t < 0 or t >= args.vocab for t in toks):
+                print(f"demo request {rid}: bad stream {toks}", file=sys.stderr)
+                return 1
+        print(f"served {args.demo} demo requests "
+              f"({sum(len(t) for _, t in results.values())} tokens)")
+        _print_summary(engine)
+        print("serving demo complete")
+        return 0
+    finally:
+        frontend.stop()
+        server.join(timeout=5)
+        for t in world.values():
+            t.close()
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    print(args)
+    engine = _build_engine(args, parser)
+    if args.demo:
+        return _run_demo(args, engine)
+
+    from distributed_ml_pytorch_tpu.serving.frontend import ServingFrontend
+    from distributed_ml_pytorch_tpu.utils.messaging import TCPTransport
+
+    transport = TCPTransport(
+        rank=0, world_size=1 + args.clients, master=args.master,
+        port=int(args.port))
+    frontend = ServingFrontend(engine, transport)
+    print(f"serving on {args.master}:{args.port} "
+          f"({args.slots} slots x {args.cache_size} rows, "
+          f"block {args.decode_block}"
+          + (", int8 kv" if args.kv_quant else "") + ")")
+    try:
+        frontend.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        frontend.stop()
+        transport.close()
+        _print_summary(engine)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

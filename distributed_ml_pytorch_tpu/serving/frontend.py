@@ -1,0 +1,258 @@
+"""Request/response transport for the serving engine over the L1 messaging
+layer (``utils/messaging.py``).
+
+The same tagged-float32 star topology that carries the DownPour control
+plane carries inference traffic: clients are "workers" dialing the engine's
+rank-0 hub over either transport (:class:`InProcessTransport` for tests and
+single-process demos, :class:`TCPTransport`/native for real processes —
+the frontend never sees which). Four codes (``MessageCode`` 5-8):
+
+- ``SubmitRequest``  client → engine: ``[id, max_new, temperature, top_k,
+  top_p, seed, eos, *prompt]`` (``eos < 0`` means none);
+- ``StreamTokens``   engine → client: ``[id, done_flag, *tokens]`` — one
+  frame per stream advance (admission's first token, then block shares);
+- ``ServeReject``    engine → client: ``[id]`` — queue full, backpressure;
+- ``CancelRequest``  client → engine: ``[id]``.
+
+Token ids and metadata ride float32 exactly (< 2^24), so no wire-format
+change was needed — the serving plane interoperates with every transport
+the PS stack already has, including the native C++ one.
+
+Request ids are client-assigned and namespaced by sender rank on the
+engine side, so concurrent clients can't collide.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from distributed_ml_pytorch_tpu.serving.engine import (
+    QueueFullError,
+    ServingEngine,
+)
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    SERVER_RANK,
+    MessageCode,
+    Transport,
+)
+
+
+class RequestRejected(RuntimeError):
+    """Client-side face of engine backpressure (a ``ServeReject`` frame)."""
+
+
+_WIRE_EXACT = 1 << 24  # largest contiguous integer range float32 carries
+
+
+def encode_submit(request_id: int, prompt, max_new_tokens: int, *,
+                  temperature: float = 0.0, top_k: int = 0,
+                  top_p: float = 1.0, seed: int = 0,
+                  eos_token: Optional[int] = None) -> np.ndarray:
+    # integers ride float32, which is exact only below 2^24 — a silently
+    # rounded seed would break the cross-transport determinism contract
+    # (the remote engine would fold a DIFFERENT key schedule), so reject
+    # out-of-range values loudly here
+    for name, val in (("request_id", request_id), ("seed", seed),
+                      ("max_new_tokens", max_new_tokens), ("top_k", top_k),
+                      ("eos_token", eos_token or 0)):
+        if not -_WIRE_EXACT < int(val) < _WIRE_EXACT:
+            raise ValueError(
+                f"{name}={val} does not fit the float32 wire exactly "
+                f"(|value| must be < 2^24)")
+    head = [float(request_id), float(max_new_tokens), float(temperature),
+            float(top_k), float(top_p), float(seed),
+            float(-1 if eos_token is None else eos_token)]
+    return np.concatenate(
+        [np.asarray(head, np.float32),
+         np.asarray(prompt, np.float32).reshape(-1)])
+
+
+def decode_submit(payload: np.ndarray) -> Tuple[int, dict, np.ndarray]:
+    if payload.size < 8:
+        raise ValueError(f"malformed SubmitRequest frame (size {payload.size})")
+    rid = int(payload[0])
+    eos = int(payload[6])
+    kwargs = dict(
+        max_new_tokens=int(payload[1]), temperature=float(payload[2]),
+        top_k=int(payload[3]), top_p=float(payload[4]), seed=int(payload[5]),
+        eos_token=None if eos < 0 else eos)
+    prompt = payload[7:].astype(np.int32)
+    return rid, kwargs, prompt
+
+
+class ServingFrontend:
+    """Bridges one :class:`ServingEngine` to a rank-0 transport hub.
+
+    A listener thread drains inbound frames into the engine; the engine's
+    ``on_tokens`` callback streams results back to whichever rank submitted
+    the request. :meth:`serve_forever` runs the scheduling loop in the
+    calling thread (the engine itself stays single-threaded on the data
+    plane); :meth:`stop` unblocks it.
+    """
+
+    def __init__(self, engine: ServingEngine, transport: Transport):
+        if engine.on_tokens is not None:
+            raise ValueError("engine already has an on_tokens consumer")
+        self.engine = engine
+        self.transport = transport
+        engine.on_tokens = self._on_tokens
+        #: engine-side request key -> (client rank, client request id).
+        #: Keys start far above the engine's own id counter so locally
+        #: submitted requests can never alias a transport route.
+        self._routes: Dict[int, Tuple[int, int]] = {}
+        self._route_ids = itertools.count(1 << 32)
+        self._stop = threading.Event()
+        self._listener = threading.Thread(target=self._pump, daemon=True)
+        self._listener.start()
+
+    def _pump(self) -> None:
+        while not self._stop.is_set():
+            msg = self.transport.recv(timeout=0.1)
+            if msg is None:
+                continue
+            sender, code, payload = msg
+            try:
+                self._handle(sender, code, payload)
+            except (ValueError, IndexError, OverflowError):
+                # malformed frame (bad layout, or non-finite floats whose
+                # int() conversion overflows): drop it, like the PS server
+                # does — the pump thread must never die on client garbage
+                continue
+
+    def _handle(self, sender: int, code: MessageCode,
+                payload: np.ndarray) -> None:
+        if code == MessageCode.SubmitRequest:
+            try:
+                rid, kwargs, prompt = decode_submit(payload)
+            except (ValueError, IndexError, OverflowError):
+                # malformed submit: reject loudly when the frame at least
+                # carries an id — silently dropping it would leave the
+                # client blocked until its stream timeout
+                if payload.size >= 1:
+                    self.transport.send(
+                        MessageCode.ServeReject,
+                        np.asarray([payload[0]], np.float32), dst=sender)
+                return
+            key = next(self._route_ids)
+            self._routes[key] = (sender, rid)
+            try:
+                self.engine.submit(prompt, request_id=key, **kwargs)
+            except (QueueFullError, ValueError):
+                del self._routes[key]
+                self.transport.send(
+                    MessageCode.ServeReject,
+                    np.asarray([rid], np.float32), dst=sender)
+        elif code == MessageCode.CancelRequest and payload.size >= 1:
+            rid = int(payload[0])
+            for key, (rank, cid) in list(self._routes.items()):
+                if rank == sender and cid == rid:
+                    self.engine.cancel(key)
+                    break
+
+    def _on_tokens(self, req, new_tokens: List[int], done: bool) -> None:
+        route = self._routes.get(req.request_id)
+        if route is None:
+            return  # locally-submitted request (no transport client)
+        rank, rid = route
+        frame = np.concatenate(
+            [np.asarray([rid, 1.0 if done else 0.0], np.float32),
+             np.asarray(new_tokens, np.float32)])
+        self.transport.send(MessageCode.StreamTokens, frame, dst=rank)
+        if done:
+            self._routes.pop(req.request_id, None)
+
+    def serve_forever(self, idle_sleep: float = 0.002) -> None:
+        while not self._stop.is_set():
+            if not self.engine.step():
+                time.sleep(idle_sleep)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class ServingClient:
+    """Submit prompts and stream tokens back over any Transport.
+
+    Single-threaded: frames are drained on demand by the stream/generate
+    calls and demultiplexed by request id, so one client can hold several
+    streams open at once.
+    """
+
+    def __init__(self, transport: Transport, server_rank: int = SERVER_RANK):
+        self.transport = transport
+        self.server_rank = server_rank
+        self._ids = itertools.count(1)
+        self._buffers: Dict[int, "queue.Queue[Tuple[List[int], bool]]"] = {}
+        self._rejected: set = set()
+
+    def submit(self, prompt, max_new_tokens: int, **kwargs) -> int:
+        rid = next(self._ids)
+        self._buffers[rid] = queue.Queue()
+        self.transport.send(
+            MessageCode.SubmitRequest,
+            encode_submit(rid, prompt, max_new_tokens, **kwargs),
+            dst=self.server_rank)
+        return rid
+
+    def cancel(self, request_id: int) -> None:
+        self.transport.send(
+            MessageCode.CancelRequest,
+            np.asarray([request_id], np.float32), dst=self.server_rank)
+
+    def _drain_one(self, timeout: float) -> bool:
+        msg = self.transport.recv(timeout=timeout)
+        if msg is None:
+            return False
+        _sender, code, payload = msg
+        rid = int(payload[0])
+        if code == MessageCode.ServeReject:
+            self._rejected.add(rid)
+        elif code == MessageCode.StreamTokens:
+            buf = self._buffers.get(rid)
+            if buf is not None:
+                buf.put((payload[2:].astype(np.int32).tolist(),
+                         bool(payload[1])))
+        return True
+
+    def stream(self, request_id: int,
+               timeout: float = 60.0) -> Iterator[int]:
+        """Yield the request's tokens as frames arrive; raises
+        :class:`RequestRejected` on backpressure, ``TimeoutError`` when the
+        engine goes silent for ``timeout`` seconds."""
+        buf = self._buffers[request_id]
+        deadline = time.monotonic() + timeout
+        done = False
+        try:
+            while not done:
+                if request_id in self._rejected:
+                    self._rejected.discard(request_id)
+                    raise RequestRejected(
+                        f"request {request_id} rejected (queue full)")
+                try:
+                    tokens, done = buf.get_nowait()
+                except queue.Empty:
+                    if time.monotonic() >= deadline:
+                        raise TimeoutError(
+                            f"no frames for request {request_id} in {timeout}s")
+                    self._drain_one(timeout=0.05)
+                    continue
+                deadline = time.monotonic() + timeout
+                for t in tokens:
+                    yield int(t)
+        finally:
+            # every exit path — completion, reject, timeout, an abandoned
+            # generator — must release the demux buffer, or late frames
+            # accumulate in an orphaned queue for the client's lifetime
+            self._buffers.pop(request_id, None)
+
+    def generate(self, prompt, max_new_tokens: int, timeout: float = 60.0,
+                 **kwargs) -> List[int]:
+        """Blocking submit + full stream collection."""
+        rid = self.submit(prompt, max_new_tokens, **kwargs)
+        return list(self.stream(rid, timeout=timeout))

@@ -74,6 +74,10 @@ def compiled_flops(jitted, *args, **kwargs) -> Optional[float]:
         return None
     if not analysis:
         return None
+    if isinstance(analysis, (list, tuple)):
+        # jax-version compatibility: older runtimes return one dict per
+        # computation instead of a flat dict
+        analysis = analysis[0] if analysis and analysis[0] else {}
     flops = analysis.get("flops")
     return float(flops) if flops and flops > 0 else None
 

@@ -51,6 +51,11 @@ class MessageCode(enum.IntEnum):
     reference server never returns), and ``Heartbeat`` carries worker
     liveness for failure detection (``utils/failure.py`` — the reference has
     none, SURVEY.md §5.3).
+
+    Codes 5-8 are the serving control plane (``serving/frontend.py``): the
+    same tagged-float32 wire carries inference requests and streamed tokens
+    between clients and the continuous-batching engine — token ids and
+    request metadata are exact in float32 (< 2^24).
     """
 
     ParameterUpdate = 0
@@ -58,6 +63,10 @@ class MessageCode(enum.IntEnum):
     GradientUpdate = 2
     WorkerDone = 3
     Heartbeat = 4
+    SubmitRequest = 5   # client → engine: [id, max_new, temp, top_k, top_p, seed, eos, *prompt]
+    StreamTokens = 6    # engine → client: [id, done_flag, *tokens]
+    ServeReject = 7     # engine → client: [id] — queue full (backpressure)
+    CancelRequest = 8   # client → engine: [id]
 
 
 Message = Tuple[int, MessageCode, np.ndarray]

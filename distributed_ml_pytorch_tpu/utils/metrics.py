@@ -16,6 +16,40 @@ from typing import Dict, List, Optional
 import numpy as np
 
 
+def percentile(values, q: float) -> float:
+    """Linear-interpolation percentile of a 1-D sample (``q`` in [0, 100]).
+
+    The serving SLO reporter's primitive (TTFT/TPOT summaries,
+    ``serving/engine.py`` and ``bench_serving.py``). A thin, loud wrapper
+    over ``np.percentile``: empty samples and out-of-range ``q`` raise
+    instead of returning NaN — an SLO line with a silent NaN percentile is
+    worse than a crash.
+    """
+    arr = np.asarray(list(values), np.float64)
+    if arr.size == 0:
+        raise ValueError("percentile() of an empty sample")
+    if not 0.0 <= q <= 100.0:
+        raise ValueError(f"percentile q must be in [0, 100], got {q}")
+    return float(np.percentile(arr, q))
+
+
+def latency_summary(values, percentiles=(50, 90, 99)) -> Optional[Dict]:
+    """Summary dict over a latency sample: count/mean/max plus the given
+    percentiles (keys ``p50`` etc.). Returns ``None`` for an empty sample so
+    callers can print "n/a" instead of fabricating numbers."""
+    arr = np.asarray(list(values), np.float64)
+    if arr.size == 0:
+        return None
+    out = {
+        "count": int(arr.size),
+        "mean": float(arr.mean()),
+        "max": float(arr.max()),
+    }
+    for q in percentiles:
+        out[f"p{q:g}"] = percentile(arr, q)
+    return out
+
+
 class MetricsLogger:
     """Accumulates per-iteration log records and dumps one CSV per rank."""
 

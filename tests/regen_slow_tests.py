@@ -7,6 +7,12 @@ SLOW_MODULES in conftest.py), and rewrites slow_tests.txt with its header.
 
     python tests/regen_slow_tests.py          # ~45 min on this 1-core host
 
+Incremental mode re-measures ONLY the given test files and merges their
+>=4s node IDs into the existing list (entries for other files are kept
+verbatim) — the cheap path when a PR adds new test modules:
+
+    python tests/regen_slow_tests.py --paths tests/test_serving.py ...
+
 The conftest marks listed node IDs slow; while this sweep runs they are
 still executed (nothing passes -m "not slow" here), so the regenerated
 list is a complete re-measurement, not an increment.
@@ -34,12 +40,21 @@ HEADER = """# Tests deselected from `make test` (the fast core signal) because o
 """
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument(
+        "--paths", nargs="+", default=None, metavar="TEST_FILE",
+        help="re-measure only these test files and merge their >=4s node "
+             "IDs into the existing list (default: full re-measurement)")
+    args = parser.parse_args(argv)
     sys.path.insert(0, HERE)
     from conftest import SLOW_MODULES
 
+    targets = args.paths or ["tests/"]
     proc = subprocess.run(
-        [sys.executable, "-m", "pytest", "tests/", "-q", "--durations=0"],
+        [sys.executable, "-m", "pytest", *targets, "-q", "--durations=0"],
         cwd=os.path.dirname(HERE), capture_output=True, text=True,
     )
     sys.stdout.write(proc.stdout[-2000:])
@@ -56,6 +71,25 @@ def main() -> int:
         if t >= THRESHOLD_S
         and name.split("::")[0].rsplit("/", 1)[-1][:-3] not in SLOW_MODULES
     )
+    if args.paths:
+        # merge: keep every existing entry that is NOT under a re-measured
+        # file, then add the fresh measurements. Normalize each given path
+        # to the repo-root-relative spelling pytest uses in node IDs, so
+        # absolute and ../-style spellings prune correctly too.
+        root = os.path.dirname(HERE)
+        measured = {
+            os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/")
+            for p in args.paths
+        }
+        kept = []
+        if os.path.exists(OUT):
+            with open(OUT, encoding="utf-8") as fh:
+                kept = [
+                    line.strip() for line in fh
+                    if line.strip() and not line.startswith("#")
+                    and line.split("::")[0] not in measured
+                ]
+        slow = sorted(set(kept) | set(slow))
     with open(OUT, "w", encoding="utf-8") as fh:
         fh.write(HEADER)
         for name in slow:

@@ -353,6 +353,33 @@ def test_sample_tokens_topp_keeps_nucleus_only():
     assert seen == {0, 1}
 
 
+def test_sample_tokens_topk_topp_combined_restricts_support():
+    """top-k AND top-p together: nucleus truncation applies to the
+    POST-top-k RENORMALIZED distribution, so the combined support can be
+    strictly smaller than either filter alone. With p = (0.5, 0.3, 0.12,
+    0.08) and all-distinct logits (ties at the k-th logit are kept by
+    contract, so distinctness matters): top_k=3 alone keeps {0, 1, 2};
+    top_p=0.85 alone keeps {0, 1, 2} (exclusive mass before token 2 is
+    0.8 < 0.85, before token 3 is 0.92); combined, the top-3 renormalize
+    to (0.543, 0.326, 0.130) and the mass before token 2 becomes
+    0.870 >= 0.85 — support {0, 1}, smaller than both."""
+    from distributed_ml_pytorch_tpu.models.generate import sample_tokens
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.12, 0.08]], jnp.float32))
+    combined, k_only, p_only = set(), set(), set()
+    for i in range(150):
+        combined.add(int(sample_tokens(
+            logits, jax.random.key(i), temperature=1.0, top_k=3,
+            top_p=0.85)[0]))
+        k_only.add(int(sample_tokens(
+            logits, jax.random.key(i), temperature=1.0, top_k=3)[0]))
+        p_only.add(int(sample_tokens(
+            logits, jax.random.key(i), temperature=1.0, top_p=0.85)[0]))
+    assert combined == {0, 1}
+    assert k_only == {0, 1, 2}
+    assert p_only == {0, 1, 2}
+
+
 def test_generate_with_topk_topp_runs_and_stays_in_vocab():
     model = tiny_lm()
     params = trained_ish_params(model)

@@ -1,0 +1,49 @@
+"""utils/metrics.py helpers: the percentile/latency-summary primitives the
+serving SLO reporter builds on."""
+
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.utils.metrics import latency_summary, percentile
+
+
+def test_percentile_linear_interpolation():
+    values = [1.0, 2.0, 3.0, 4.0]
+    assert percentile(values, 0) == 1.0
+    assert percentile(values, 100) == 4.0
+    assert percentile(values, 50) == 2.5  # numpy linear interpolation
+    assert percentile([7.0], 99) == 7.0
+    # order-independent
+    assert percentile([4.0, 1.0, 3.0, 2.0], 50) == 2.5
+
+
+def test_percentile_matches_numpy_on_random_sample():
+    rng = np.random.default_rng(0)
+    sample = rng.exponential(size=257).tolist()
+    for q in (50, 90, 99, 99.9):
+        assert percentile(sample, q) == pytest.approx(
+            float(np.percentile(sample, q)))
+
+
+def test_percentile_rejects_bad_input():
+    with pytest.raises(ValueError, match="empty"):
+        percentile([], 50)
+    with pytest.raises(ValueError, match="0, 100"):
+        percentile([1.0], 101)
+    with pytest.raises(ValueError, match="0, 100"):
+        percentile([1.0], -1)
+
+
+def test_latency_summary_shape_and_values():
+    s = latency_summary([10.0, 20.0, 30.0])
+    assert s["count"] == 3
+    assert s["mean"] == pytest.approx(20.0)
+    assert s["max"] == 30.0
+    assert s["p50"] == 20.0
+    assert set(s) == {"count", "mean", "max", "p50", "p90", "p99"}
+    custom = latency_summary([1.0, 2.0], percentiles=(25,))
+    assert set(custom) == {"count", "mean", "max", "p25"}
+
+
+def test_latency_summary_empty_is_none():
+    assert latency_summary([]) is None

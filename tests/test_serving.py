@@ -1,0 +1,315 @@
+"""Continuous-batching serving engine: parity with generate(), scheduling,
+admission control, and the slot pool's exactness contract.
+
+The load-bearing property is ARRIVAL-ORDER-INDEPENDENT EXACTNESS: whatever
+mix of requests shares the slot pool, each request's output must be
+token-identical (CPU) to a standalone ``generate()`` with the same
+``(params, prompt, rng)`` — slots are independent vmap lanes over the same
+attention module, so sharing a batch must never leak between requests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.models.generate import (
+    generate,
+    sample_tokens,
+    sample_tokens_dynamic,
+)
+from distributed_ml_pytorch_tpu.models.transformer import TransformerLM
+from distributed_ml_pytorch_tpu.serving.engine import (
+    QueueFullError,
+    ServingEngine,
+)
+
+VOCAB = 64
+
+
+def tiny_lm():
+    return TransformerLM(
+        vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_len=128
+    )
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    model = tiny_lm()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def make_engine(lm_and_params, **kw):
+    model, params = lm_and_params
+    kw.setdefault("slots", 3)
+    kw.setdefault("cache_size", 96)
+    kw.setdefault("decode_block", 4)
+    kw.setdefault("prefill_bucket", 8)
+    return ServingEngine(model, params, **kw)
+
+
+def ref_tokens(model, params, prompt, max_new, **kw):
+    """Standalone generate() continuation for one request (the oracle)."""
+    out = generate(model, params, jnp.asarray(prompt, jnp.int32)[None],
+                   max_new, **kw)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def prompts_rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def test_single_request_greedy_matches_generate(lm_and_params):
+    model, params = lm_and_params
+    eng = make_engine(lm_and_params)
+    prompt = prompts_rng(1).integers(0, VOCAB, size=5)
+    req = eng.submit(prompt, 20)
+    eng.run_until_idle()
+    assert req.done and len(req.tokens) == 20
+    assert req.tokens == ref_tokens(model, params, prompt, 20)
+
+
+def test_mixed_arrival_parity_and_midflight_admission(lm_and_params):
+    """The acceptance-criterion test: a late request is admitted while an
+    earlier one is mid-decode, and EVERY request still matches its
+    standalone generate() output exactly."""
+    model, params = lm_and_params
+    eng = make_engine(lm_and_params)
+    rng = prompts_rng(2)
+    pa = rng.integers(0, VOCAB, size=6)
+    pb = rng.integers(0, VOCAB, size=3)
+    pc = rng.integers(0, VOCAB, size=9)
+
+    ra = eng.submit(pa, 30)
+    eng.step()  # admits A, decodes one block
+    eng.step()
+    assert not ra.done and len(ra.tokens) > 1  # A is mid-decode
+    rb = eng.submit(pb, 9)
+    rc = eng.submit(pc, 17)
+    eng.run_until_idle()
+
+    assert rb.active_at_admit >= 1  # B joined while A held a slot
+    for req, prompt, n in ((ra, pa, 30), (rb, pb, 9), (rc, pc, 17)):
+        assert req.done and len(req.tokens) == n
+        assert req.tokens == ref_tokens(model, params, prompt, n), (
+            f"request {req.request_id} diverged from standalone generate()")
+
+
+def test_sampled_request_matches_generate_rng(lm_and_params):
+    """Temperature/top-k/top-p requests must reproduce generate()'s exact
+    token stream for the same seed — the per-slot fold_in key schedule is
+    part of the engine's contract, not just greedy argmax."""
+    model, params = lm_and_params
+    eng = make_engine(lm_and_params)
+    prompt = prompts_rng(3).integers(0, VOCAB, size=4)
+    req = eng.submit(prompt, 18, temperature=0.8, top_k=7, top_p=0.9, seed=11)
+    other = eng.submit(prompts_rng(4).integers(0, VOCAB, size=7), 12)
+    eng.run_until_idle()
+    want = ref_tokens(model, params, prompt, 18, temperature=0.8,
+                      top_k=7, top_p=0.9, rng=jax.random.key(11))
+    assert req.tokens == want
+    assert other.done and len(other.tokens) == 12
+
+
+def test_parity_independent_of_arrival_order(lm_and_params):
+    """Same request set, two arrival orders -> identical per-request
+    outputs (and equal to running each alone)."""
+    model, params = lm_and_params
+    rng = prompts_rng(5)
+    reqs = [(rng.integers(0, VOCAB, size=int(rng.integers(2, 10))),
+             int(rng.integers(5, 22))) for _ in range(4)]
+    outs = []
+    for order in (range(4), reversed(range(4))):
+        eng = make_engine(lm_and_params)
+        handles = {}
+        for i in order:
+            prompt, n = reqs[i]
+            handles[i] = eng.submit(prompt, n)
+            eng.step()  # interleave admission with decode
+        eng.run_until_idle()
+        outs.append({i: handles[i].tokens for i in range(4)})
+    assert outs[0] == outs[1]
+    for i, (prompt, n) in enumerate(reqs):
+        assert outs[0][i] == ref_tokens(model, params, prompt, n)
+
+
+def test_prefill_bucketing_is_exact(lm_and_params):
+    """Right-padding prompts to the prefill bucket must not change a single
+    token (padded K/V is causally invisible and cursor-rewound)."""
+    model, params = lm_and_params
+    prompt = prompts_rng(6).integers(0, VOCAB, size=5)
+    outs = []
+    for bucket in (1, 8):
+        eng = make_engine(lm_and_params, prefill_bucket=bucket)
+        req = eng.submit(prompt, 13)
+        eng.run_until_idle()
+        outs.append(req.tokens)
+    assert outs[0] == outs[1] == ref_tokens(model, params, prompt, 13)
+
+
+def test_single_token_prompt_pads_past_decode_discriminator(lm_and_params):
+    """A 1-token prompt must still prefill correctly: inside the blocked
+    module ``s == 1`` means a DECODE step, so admission pads the prompt to
+    at least 2 even at prefill_bucket=1 — and stays exact vs generate()."""
+    model, params = lm_and_params
+    eng = make_engine(lm_and_params, prefill_bucket=1)
+    prompt = np.asarray([7], np.int32)
+    req = eng.submit(prompt, 14)
+    eng.run_until_idle()
+    assert req.tokens == ref_tokens(model, params, prompt, 14)
+
+
+def test_kv_quant_pool_deterministic_and_in_vocab(lm_and_params):
+    """int8 slot caches: deterministic, shape-correct, in-vocab; and the
+    first generated token matches the exact-cache engine (prefill logits
+    carry no quantization noise — the single-prefill contract holds for
+    every fresh slot admission)."""
+    outs = []
+    prompt = prompts_rng(7).integers(0, VOCAB, size=6)
+    for quant in (True, True, False):
+        eng = make_engine(lm_and_params, kv_quant=quant)
+        req = eng.submit(prompt, 15)
+        eng.run_until_idle()
+        outs.append(req.tokens)
+    assert outs[0] == outs[1]
+    assert len(outs[0]) == 15
+    assert all(0 <= t < VOCAB for t in outs[0])
+    assert outs[0][0] == outs[2][0]
+
+
+def test_queue_backpressure_raises(lm_and_params):
+    eng = make_engine(lm_and_params, slots=1, max_queue=2)
+    prompt = np.arange(4)
+    eng.submit(prompt, 6)
+    eng.submit(prompt, 6)
+    with pytest.raises(QueueFullError):
+        eng.submit(prompt, 6)
+    eng.run_until_idle()
+    summary = eng.slo_summary()
+    assert summary["rejected"] == 1 and summary["completed"] == 2
+
+
+def test_submit_rejects_oversized_request(lm_and_params):
+    eng = make_engine(lm_and_params, cache_size=32)
+    with pytest.raises(ValueError, match="cache rows"):
+        eng.submit(np.arange(4), 40)
+    with pytest.raises(ValueError):
+        eng.submit(np.arange(4), 0)
+
+
+def test_cancel_queued_and_active(lm_and_params):
+    eng = make_engine(lm_and_params, slots=1)
+    ra = eng.submit(np.arange(5), 25)
+    rb = eng.submit(np.arange(3), 10)
+    eng.step()  # A active, B queued
+    assert eng.cancel(rb.request_id)
+    eng.step()
+    assert eng.cancel(ra.request_id)
+    eng.run_until_idle()
+    assert ra.done and ra.cancelled and len(ra.tokens) < 25
+    assert rb.done and rb.cancelled and rb.tokens == []
+    assert not eng.cancel(12345)
+
+
+def test_eos_token_truncates_stream(lm_and_params):
+    model, params = lm_and_params
+    prompt = prompts_rng(8).integers(0, VOCAB, size=5)
+    full = ref_tokens(model, params, prompt, 20)
+    eos = full[4]  # force an early stop at a token greedy decode emits
+    eng = make_engine(lm_and_params)
+    req = eng.submit(prompt, 20, eos_token=eos)
+    eng.run_until_idle()
+    stop = full.index(eos)
+    assert req.tokens == full[: stop + 1]
+
+
+def test_max_new_tokens_one_completes_at_admission(lm_and_params):
+    model, params = lm_and_params
+    prompt = prompts_rng(9).integers(0, VOCAB, size=6)
+    eng = make_engine(lm_and_params)
+    req = eng.submit(prompt, 1)
+    eng.run_until_idle()
+    assert req.done and req.tokens == ref_tokens(model, params, prompt, 1)
+    # the slot freed at admission must be swept like any evicted slot
+    assert eng.pool.live_lengths().max() == 0
+
+
+def test_slot_reuse_after_completion_is_clean(lm_and_params):
+    """A recycled slot must give the same output as a fresh engine — no
+    leakage from the previous occupant's cache."""
+    model, params = lm_and_params
+    eng = make_engine(lm_and_params, slots=1)
+    p1 = prompts_rng(10).integers(0, VOCAB, size=7)
+    p2 = prompts_rng(11).integers(0, VOCAB, size=4)
+    eng.submit(p1, 12)
+    eng.run_until_idle()
+    req = eng.submit(p2, 16)  # reuses the single slot
+    eng.run_until_idle()
+    assert req.tokens == ref_tokens(model, params, p2, 16)
+
+
+def test_slo_summary_reports_percentiles(lm_and_params):
+    eng = make_engine(lm_and_params)
+    for seed in range(3):
+        eng.submit(prompts_rng(seed).integers(0, VOCAB, size=4), 9)
+    eng.run_until_idle()
+    s = eng.slo_summary()
+    assert s["completed"] == 3
+    assert s["ttft_ms"] is not None and s["ttft_ms"]["count"] == 3
+    assert set(s["ttft_ms"]) >= {"count", "mean", "p50", "p90", "p99", "max"}
+    assert s["tpot_ms"]["count"] == 3 and s["tpot_ms"]["p50"] > 0
+    assert 0 < s["slot_occupancy"] <= 1
+    assert s["queue_depth"]["max"] >= 0
+
+
+def test_live_lengths_track_slot_progress(lm_and_params):
+    eng = make_engine(lm_and_params)
+    eng.submit(np.arange(1, 6), 20)
+    eng.step()
+    lens = eng.pool.live_lengths()
+    assert lens.shape == (3,)
+    assert lens.max() == 5 + eng.pool.decode_block  # prompt + one block
+    eng.run_until_idle()
+    assert eng.pool.live_lengths().max() == 0  # everything evicted + reset
+
+
+def test_sample_tokens_dynamic_matches_scalar_rowwise():
+    """The traced-params sampler must agree bit-for-bit with sample_tokens
+    for every configuration a request can carry (greedy, temp-only, top-k,
+    top-p, combined) — this equivalence is what lets one compiled block
+    program serve heterogeneous sampling params."""
+    logits = jnp.asarray(
+        np.random.default_rng(0).normal(size=(5, VOCAB)) * 2.0, jnp.float32)
+    configs = [
+        (0.0, 0, 1.0), (1.0, 0, 1.0), (0.7, 5, 1.0), (0.7, 0, 0.9),
+        (1.3, 8, 0.85), (0.5, 1, 1.0), (0.9, VOCAB + 10, 0.5),
+    ]
+    for i, (t, k, p) in enumerate(configs):
+        key = jax.random.key(100 + i)
+        for row in range(logits.shape[0]):
+            want = sample_tokens(
+                logits[row][None], key, temperature=t, top_k=k, top_p=p)[0]
+            got = sample_tokens_dynamic(
+                logits[row][None], key[None],
+                jnp.asarray([t]), jnp.asarray([k]), jnp.asarray([p]))[0]
+            assert int(got) == int(want), (t, k, p, row)
+
+
+def test_sample_tokens_dynamic_heterogeneous_rows():
+    """A batch mixing greedy and differently-truncated sampled rows equals
+    running each row separately."""
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(4, VOCAB)), jnp.float32)
+    keys = jax.vmap(jax.random.key)(jnp.arange(4, dtype=jnp.uint32))
+    temps = jnp.asarray([0.0, 0.8, 1.2, 0.6])
+    ks = jnp.asarray([0, 5, 0, 3])
+    ps = jnp.asarray([1.0, 1.0, 0.8, 0.7])
+    batched = sample_tokens_dynamic(logits, keys, temps, ks, ps)
+    for row in range(4):
+        alone = sample_tokens_dynamic(
+            logits[row][None], keys[row][None], temps[row][None],
+            ks[row][None], ps[row][None])[0]
+        assert int(batched[row]) == int(alone)
