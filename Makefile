@@ -63,6 +63,12 @@ bench-serving:
 bench-all:
 	$(PY) bench_all.py
 
+# seeded fault-injection suite (utils/chaos.py + the reliability layer):
+# deterministic drop/dup/corrupt/partition/crash scenarios on the PS and
+# serving planes, soak variants included (they carry both markers)
+chaos:
+	$(PY) -m pytest tests/ -q -m chaos
+
 # fast core signal: everything that runs in-process (no subprocess worlds,
 # no end-to-end example trainings) — a couple of minutes on one core
 test:
@@ -91,4 +97,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo bench bench-serving bench-all test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo bench bench-serving bench-all chaos test test-all verify-real-data graph install dist

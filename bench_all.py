@@ -1183,6 +1183,83 @@ def transport_phase() -> None:
             srv.wait()
 
 
+def reliability_phase() -> None:
+    """Config 7, reliability-overhead leg (ISSUE 2 satellite): the same
+    Python-TCP echo as ``transport_phase`` with the reliability layer on vs
+    off — what the seq+CRC envelope, the ack frames and receiver dedup cost
+    on the PS wire. The ack timeout is set well above one 9.9 MB transfer
+    time on this rig so the measurement is protocol overhead, not spurious
+    retransmits."""
+    import subprocess
+    import sys as _sys
+
+    from distributed_ml_pytorch_tpu.launch import _free_port, cpu_platform_env
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        MessageCode,
+        ReliableTransport,
+        make_transport,
+    )
+
+    payload = np.zeros(2_472_266, np.float32)  # raveled AlexNet size
+    n_iter = 20
+    server_src = (
+        "import sys\n"
+        "from distributed_ml_pytorch_tpu.utils.messaging import (\n"
+        "    ReliableTransport, make_transport)\n"
+        "t = make_transport(0, 2, port=int(sys.argv[1]), kind='python')\n"
+        "if sys.argv[2] == 'on':\n"
+        "    t = ReliableTransport(t, ack_timeout=5.0, max_backoff=10.0)\n"
+        f"for _ in range({n_iter} + 2):\n"
+        "    sender, code, payload = t.recv(timeout=120)\n"
+        "    t.send(code, payload, dst=sender)\n"
+        "t.close()\n"
+    )
+    rates = {}
+    for acks in ("off", "on"):
+        port = _free_port()
+        srv = subprocess.Popen(
+            [_sys.executable, "-c", server_src, port, acks],
+            env=cpu_platform_env(),
+        )
+        t = None
+        try:
+            t = make_transport(1, 2, port=int(port), kind="python",
+                               connect_timeout=120)
+            if acks == "on":
+                t = ReliableTransport(t, ack_timeout=5.0, max_backoff=10.0)
+            for _ in range(2):  # warm both directions
+                t.send(MessageCode.GradientUpdate, payload)
+                t.recv(timeout=120)
+            t0 = time.perf_counter()
+            for _ in range(n_iter):
+                t.send(MessageCode.GradientUpdate, payload)
+                t.recv(timeout=120)
+            dt = time.perf_counter() - t0
+            rates[acks] = rate = n_iter / dt
+            mbps = 2 * payload.nbytes * rate / 1e6
+            emit(7, f"ps_transport_roundtrip_python_acks_{acks}", rate,
+                 "roundtrips/sec", "2 processes, localhost TCP",
+                 f"9.9 MB gradient payload echo ({mbps:.0f} MB/s both ways) "
+                 f"with the reliability layer {acks} — seq+CRC envelope, "
+                 "ack frames, receiver dedup (utils/messaging."
+                 "ReliableTransport)")
+        except Exception as e:
+            log(f"reliability bench (acks {acks}) failed: {e}")
+        finally:
+            if t is not None:
+                t.close()
+            if srv.poll() is None:
+                srv.kill()
+            srv.wait()
+    if "on" in rates and "off" in rates:
+        emit(7, "ps_reliability_layer_overhead",
+             100 * (1 - rates["on"] / rates["off"]), "percent", "derived",
+             "roundtrip-rate cost of acks+CRC+dedup on the 9.9 MB PS echo "
+             "(positive = reliability slower); the exactly-once apply "
+             "guarantee under drop/dup/corrupt is what it buys "
+             "(tests/test_chaos.py)")
+
+
 def cpu_mesh_phase() -> None:
     """Virtual-device measurements — runs LAST (re-initializing the backend
     onto CPU is one-way within a process)."""
@@ -1342,6 +1419,7 @@ def main() -> None:
     sharded_ps_phase()
     ps_tpu_phase()
     transport_phase()
+    reliability_phase()
     cpu_mesh_phase()
     # LAST: the 4 gloo subprocesses leave the 1-core host briefly saturated
     # as they tear down — running this before cpu_mesh_phase measured the

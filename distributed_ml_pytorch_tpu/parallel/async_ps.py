@@ -112,11 +112,19 @@ class ParameterServer:
 
         return os.path.join(self.ckpt_dir, "ps_central.npy")
 
+    def _meta_path(self) -> str:
+        import os
+
+        return os.path.join(self.ckpt_dir, "ps_meta.json")
+
     def save_checkpoint(self) -> None:
         """Atomically persist the central flat params (write-then-rename, so
-        a preemption mid-write can never leave a torn checkpoint)."""
+        a preemption mid-write can never leave a torn checkpoint), plus a
+        sidecar with the central version / push count so a restarted server
+        resumes the staleness clock, not just the vector (ISSUE 2)."""
         if not self.ckpt_dir:
             return
+        import json
         import os
 
         os.makedirs(self.ckpt_dir, exist_ok=True)
@@ -125,13 +133,20 @@ class ParameterServer:
         with open(tmp, "wb") as f:
             np.save(f, self.central)
         os.replace(tmp, path)
+        meta_tmp = self._meta_path() + ".tmp"
+        with open(meta_tmp, "w") as f:
+            json.dump({"version": self.staleness.version,
+                       "push_count": self._push_count}, f)
+        os.replace(meta_tmp, self._meta_path())
 
     def maybe_restore(self) -> bool:
-        """Adopt a previously-saved central vector; False if none exists.
-        A size mismatch (different model) fails loudly — silently training a
-        fresh init while claiming to resume is the one wrong answer."""
+        """Adopt a previously-saved central vector (and its version sidecar,
+        when present); False if none exists. A size mismatch (different
+        model) fails loudly — silently training a fresh init while claiming
+        to resume is the one wrong answer."""
         if not self.ckpt_dir:
             return False
+        import json
         import os
 
         path = self._ckpt_path()
@@ -144,6 +159,11 @@ class ParameterServer:
                 f"model ravels to {self.central.shape[0]} — wrong --model?"
             )
         self.central = arr.astype(np.float32)
+        if os.path.exists(self._meta_path()):
+            with open(self._meta_path()) as f:
+                meta = json.load(f)
+            self.staleness.version = int(meta.get("version", 0))
+            self._push_count = int(meta.get("push_count", 0))
         self._restored = True
         return True
 
@@ -160,9 +180,7 @@ class ParameterServer:
             ):
                 self.save_checkpoint()
         elif code == MessageCode.ParameterRequest:
-            send_message(
-                MessageCode.ParameterUpdate, self.central, dst=sender, transport=self.transport
-            )
+            self._reply(sender, self.central)
             self.staleness.on_pull(sender)
         elif code == MessageCode.ParameterUpdate:
             if self._restored:
@@ -182,12 +200,24 @@ class ParameterServer:
                     "answering with authoritative params",
                     self.rejected_installs, sender,
                 )
-                send_message(
-                    MessageCode.ParameterUpdate, self.central, dst=sender,
-                    transport=self.transport,
-                )
+                self._reply(sender, self.central)
             else:
                 self.central = payload.astype(np.float32).copy()
+
+    def _reply(self, sender: int, payload: np.ndarray) -> None:
+        """Answer one worker; a worker that died between its request and
+        this reply must not take the whole server down (the send raises on
+        a crashed peer — robustness, not protocol)."""
+        try:
+            send_message(
+                MessageCode.ParameterUpdate, payload, dst=sender,
+                transport=self.transport,
+            )
+        except (OSError, ConnectionError, KeyError):
+            _LOGGER.warning(
+                "reply to worker %d failed (peer gone?) — dropping it; the "
+                "worker re-pulls on its next cadence if it returns", sender,
+            )
 
     def run(self, timeout: Optional[float] = None) -> None:
         """Serve until all workers finish (or ``stop()``/``timeout``).
@@ -672,6 +702,13 @@ class Asynchronous:
         # in-flight pushes must land BEFORE the final one (cadence order)
         self._flusher.drain()
         self._send(MessageCode.GradientUpdate, np.asarray(self.accum[: self._flat_n]))
+        # over a reliable transport, WorkerDone must barrier behind every
+        # prior push: the layer guarantees delivery, not ordering, so an
+        # unflushed retry could land after the server counted this worker
+        # done and exited (the listener is still pumping acks here)
+        flush = getattr(self.transport, "flush", None)
+        if flush is not None and not self.server_down:
+            flush(timeout=10.0)
         self._send(MessageCode.WorkerDone, np.zeros(0, np.float32))
         self._flusher.stop()
         if self.heartbeat is not None:
@@ -917,6 +954,7 @@ def run_ps_process(args) -> int:
         args.master,
         int(args.port),
         kind=getattr(args, "transport", "auto"),
+        reliable=getattr(args, "reliable", False),
     )
     heartbeat = None
     try:

@@ -234,7 +234,12 @@ class ShardedAsynchronous:
         """Flush the final push and close out every shard."""
         self._flusher.drain()  # in-flight pushes land before the final one
         self._push_all(np.asarray(self.accum[: self._flat_n]))
-        for s in range(len(self.transports)):
+        for s, t in enumerate(self.transports):
+            # reliable transports: WorkerDone barriers behind prior pushes
+            # (delivery is guaranteed, ordering is not — async_ps.finish)
+            flush = getattr(t, "flush", None)
+            if flush is not None and not self.shard_down[s]:
+                flush(timeout=10.0)
             self._send(s, MessageCode.WorkerDone, np.zeros(0, np.float32))
         self._flusher.stop()
         for listener in self.listeners:
@@ -266,10 +271,12 @@ def run_sharded_ps_process(args) -> int:
             f"--n-servers {k} leaves no workers in --world-size {args.world_size}"
         )
     kind = getattr(args, "transport", "auto")
+    reliable = getattr(args, "reliable", False)
     if args.rank < k:
         shard = args.rank
         transport = make_transport(
-            0, n_workers + 1, args.master, int(args.port) + shard, kind=kind
+            0, n_workers + 1, args.master, int(args.port) + shard, kind=kind,
+            reliable=reliable,
         )
         try:
             model = get_model(getattr(args, "model", "alexnet"))
@@ -299,7 +306,8 @@ def run_sharded_ps_process(args) -> int:
     star_rank = args.rank - k + 1
     transports = [
         make_transport(
-            star_rank, n_workers + 1, args.master, int(args.port) + s, kind=kind
+            star_rank, n_workers + 1, args.master, int(args.port) + s,
+            kind=kind, reliable=reliable,
         )
         for s in range(k)
     ]

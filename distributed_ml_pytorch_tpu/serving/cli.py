@@ -72,6 +72,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--clients", type=int, default=1,
                    help="client processes the TCP rendezvous waits for "
                         "(clients may later drop and rejoin)")
+    p.add_argument("--reliable", action="store_true",
+                   help="wrap the hub transport in the reliability layer "
+                        "(seq + CRC + ack/retry + dedup, utils/messaging."
+                        "ReliableTransport); clients must wrap too")
+    p.add_argument("--client-deadline", type=float, default=30.0,
+                   metavar="SEC",
+                   help="cancel + free a request whose client has been "
+                        "silent this long (disconnect/abandon cleanup); "
+                        "streaming clients refresh liveness via StreamAck")
     p.add_argument("--demo", type=int, default=0, metavar="N",
                    help="serve N synthetic requests from an in-process "
                         "client, print the SLO summary, exit")
@@ -197,12 +206,18 @@ def main(argv=None) -> int:
         return _run_demo(args, engine)
 
     from distributed_ml_pytorch_tpu.serving.frontend import ServingFrontend
-    from distributed_ml_pytorch_tpu.utils.messaging import TCPTransport
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        ReliableTransport,
+        TCPTransport,
+    )
 
     transport = TCPTransport(
         rank=0, world_size=1 + args.clients, master=args.master,
         port=int(args.port))
-    frontend = ServingFrontend(engine, transport)
+    if args.reliable:
+        transport = ReliableTransport(transport)
+    frontend = ServingFrontend(engine, transport,
+                               client_deadline=args.client_deadline)
     print(f"serving on {args.master}:{args.port} "
           f"({args.slots} slots x {args.cache_size} rows, "
           f"block {args.decode_block}"

@@ -9,21 +9,42 @@ the frontend never sees which). Four codes (``MessageCode`` 5-8):
 
 - ``SubmitRequest``  client → engine: ``[id, max_new, temperature, top_k,
   top_p, seed, eos, *prompt]`` (``eos < 0`` means none);
-- ``StreamTokens``   engine → client: ``[id, done_flag, *tokens]`` — one
-  frame per stream advance (admission's first token, then block shares);
-- ``ServeReject``    engine → client: ``[id]`` — queue full, backpressure;
-- ``CancelRequest``  client → engine: ``[id]``.
+- ``StreamTokens``   engine → client: ``[id, done_flag, start_index,
+  *tokens]`` — one frame per stream advance (admission's first token, then
+  block shares); ``start_index`` is how many tokens of this request were
+  emitted before the frame, so the client can detect dropped/duplicated/
+  reordered frames by simple arithmetic;
+- ``ServeReject``    engine → client: ``[id]`` — queue full, or a resume
+  for a request the engine no longer knows;
+- ``CancelRequest``  client → engine: ``[id]``;
+- ``StreamAck``      client → engine: ``[id, n_received]`` — progress +
+  liveness (the engine reaps requests whose client goes silent);
+- ``ResumeStream``   client → engine: ``[id, n_received]`` — re-send the
+  stream from that offset (gap recovery AND reconnect-and-resume: the
+  frontend keeps each live request's emitted tokens, so a client that
+  reconnects can replay from wherever it left off by request id).
 
 Token ids and metadata ride float32 exactly (< 2^24), so no wire-format
 change was needed — the serving plane interoperates with every transport
-the PS stack already has, including the native C++ one.
+the PS stack already has, including the native C++ one, and composes with
+``ReliableTransport`` / ``FaultyTransport`` (ISSUE 2).
 
 Request ids are client-assigned and namespaced by sender rank on the
 engine side, so concurrent clients can't collide.
+
+Fault model: stream frames are fire-and-forget; recovery is end-to-end
+(client-driven resume against the frontend's per-request history) rather
+than per-frame, so a lossy wire costs retransmits but never corrupts a
+stream — under injected frame loss the collected tokens stay identical to
+a standalone ``generate()`` (tests/test_chaos.py). Requests whose client
+goes silent past ``client_deadline`` are cancelled and their slot, queues
+and history freed — a disconnected or abandoned TCP client cannot leak
+engine state.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import queue
 import threading
@@ -86,6 +107,20 @@ def decode_submit(payload: np.ndarray) -> Tuple[int, dict, np.ndarray]:
     return rid, kwargs, prompt
 
 
+@dataclasses.dataclass
+class _Route:
+    """Engine-side state of one transport client's request: where to send
+    frames, the full emitted-token history (resume source), and liveness."""
+
+    rank: int
+    rid: int
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+    done_at: float = 0.0
+    last_active: float = 0.0
+    reaping: bool = False  # cancel already issued for client silence
+
+
 class ServingFrontend:
     """Bridges one :class:`ServingEngine` to a rank-0 transport hub.
 
@@ -94,19 +129,33 @@ class ServingFrontend:
     the request. :meth:`serve_forever` runs the scheduling loop in the
     calling thread (the engine itself stays single-threaded on the data
     plane); :meth:`stop` unblocks it.
+
+    Reliability (ISSUE 2): each route keeps the request's emitted tokens so
+    ``ResumeStream`` can replay from any offset; any frame from a client
+    refreshes its requests' liveness, and a sweeper cancels + frees requests
+    whose client has been silent past ``client_deadline`` seconds (slot,
+    queue entry, route and history all released — the stream-state-leak
+    fix). Finished histories are kept ``done_ttl`` seconds for late resumes,
+    then dropped.
     """
 
-    def __init__(self, engine: ServingEngine, transport: Transport):
+    def __init__(self, engine: ServingEngine, transport: Transport, *,
+                 client_deadline: float = 30.0, done_ttl: float = 60.0):
         if engine.on_tokens is not None:
             raise ValueError("engine already has an on_tokens consumer")
         self.engine = engine
         self.transport = transport
+        self.client_deadline = float(client_deadline)
+        self.done_ttl = float(done_ttl)
         engine.on_tokens = self._on_tokens
-        #: engine-side request key -> (client rank, client request id).
-        #: Keys start far above the engine's own id counter so locally
-        #: submitted requests can never alias a transport route.
-        self._routes: Dict[int, Tuple[int, int]] = {}
+        #: engine-side request key -> live route state. Keys start far above
+        #: the engine's own id counter so locally submitted requests can
+        #: never alias a transport route.
+        self._routes: Dict[int, _Route] = {}
+        self._by_client: Dict[Tuple[int, int], int] = {}
+        self._routes_lock = threading.Lock()
         self._route_ids = itertools.count(1 << 32)
+        self.reaped = 0  # requests cancelled for client silence
         self._stop = threading.Event()
         self._listener = threading.Thread(target=self._pump, daemon=True)
         self._listener.start()
@@ -125,8 +174,20 @@ class ServingFrontend:
                 # does — the pump thread must never die on client garbage
                 continue
 
+    def _route_of(self, sender: int, rid: int) -> Optional[_Route]:
+        with self._routes_lock:
+            key = self._by_client.get((sender, rid))
+            return None if key is None else self._routes.get(key)
+
+    def _drop_route(self, key: int) -> None:
+        with self._routes_lock:
+            route = self._routes.pop(key, None)
+            if route is not None:
+                self._by_client.pop((route.rank, route.rid), None)
+
     def _handle(self, sender: int, code: MessageCode,
                 payload: np.ndarray) -> None:
+        now = time.monotonic()
         if code == MessageCode.SubmitRequest:
             try:
                 rid, kwargs, prompt = decode_submit(payload)
@@ -135,41 +196,113 @@ class ServingFrontend:
                 # carries an id — silently dropping it would leave the
                 # client blocked until its stream timeout
                 if payload.size >= 1:
-                    self.transport.send(
-                        MessageCode.ServeReject,
-                        np.asarray([payload[0]], np.float32), dst=sender)
+                    self._send_to(
+                        sender, MessageCode.ServeReject,
+                        np.asarray([payload[0]], np.float32))
+                return
+            live = self._route_of(sender, rid)
+            if live is not None:
+                # duplicate submit (wire-level retry, or a reconnected
+                # client re-driving the same id): never double-submit —
+                # replay the stream from the top instead
+                live.last_active = now
+                self._send_frame(live, start=0, tokens=live.tokens,
+                                 done=live.done)
                 return
             key = next(self._route_ids)
-            self._routes[key] = (sender, rid)
+            route = _Route(rank=sender, rid=rid, last_active=now)
+            with self._routes_lock:
+                self._routes[key] = route
+                self._by_client[(sender, rid)] = key
             try:
                 self.engine.submit(prompt, request_id=key, **kwargs)
             except (QueueFullError, ValueError):
-                del self._routes[key]
-                self.transport.send(
-                    MessageCode.ServeReject,
-                    np.asarray([rid], np.float32), dst=sender)
+                self._drop_route(key)
+                self._send_to(sender, MessageCode.ServeReject,
+                              np.asarray([rid], np.float32))
         elif code == MessageCode.CancelRequest and payload.size >= 1:
             rid = int(payload[0])
-            for key, (rank, cid) in list(self._routes.items()):
-                if rank == sender and cid == rid:
-                    self.engine.cancel(key)
-                    break
+            with self._routes_lock:
+                key = self._by_client.get((sender, rid))
+                route = self._routes.get(key) if key is not None else None
+            if route is not None:
+                route.last_active = now
+                self.engine.cancel(key)
+        elif code in (MessageCode.StreamAck, MessageCode.ResumeStream) \
+                and payload.size >= 2:
+            rid, n_have = int(payload[0]), max(0, int(payload[1]))
+            route = self._route_of(sender, rid)
+            if route is None:
+                if code == MessageCode.ResumeStream:
+                    # resume for a request the engine no longer knows
+                    # (history expired, or never submitted): tell the
+                    # client instead of letting it poll forever
+                    self._send_to(sender, MessageCode.ServeReject,
+                                  np.asarray([rid], np.float32))
+                return
+            route.last_active = now
+            if code == MessageCode.ResumeStream and (
+                    len(route.tokens) > n_have or route.done):
+                self._send_frame(route, start=n_have,
+                                 tokens=route.tokens[n_have:],
+                                 done=route.done)
+
+    def _send_to(self, rank: int, code: MessageCode,
+                 payload: np.ndarray) -> bool:
+        """Send toward one client; a dead transport peer must never take
+        down the pump or scheduling thread."""
+        try:
+            self.transport.send(code, payload, dst=rank)
+            return True
+        except (OSError, ConnectionError, KeyError):
+            return False
+
+    def _send_frame(self, route: _Route, start: int, tokens: List[int],
+                    done: bool) -> bool:
+        frame = np.concatenate(
+            [np.asarray([route.rid, 1.0 if done else 0.0, float(start)],
+                        np.float32),
+             np.asarray(tokens, np.float32)])
+        return self._send_to(route.rank, MessageCode.StreamTokens, frame)
 
     def _on_tokens(self, req, new_tokens: List[int], done: bool) -> None:
         route = self._routes.get(req.request_id)
         if route is None:
             return  # locally-submitted request (no transport client)
-        rank, rid = route
-        frame = np.concatenate(
-            [np.asarray([rid, 1.0 if done else 0.0], np.float32),
-             np.asarray(new_tokens, np.float32)])
-        self.transport.send(MessageCode.StreamTokens, frame, dst=rank)
+        start = len(route.tokens)
+        route.tokens.extend(int(t) for t in new_tokens)
         if done:
-            self._routes.pop(req.request_id, None)
+            route.done = True
+            route.done_at = time.monotonic()
+        self._send_frame(route, start=start, tokens=new_tokens, done=done)
 
-    def serve_forever(self, idle_sleep: float = 0.002) -> None:
+    def _sweep(self, now: float) -> None:
+        """Free state for silent clients (cancel live requests; forget
+        finished histories past their resume TTL)."""
+        with self._routes_lock:
+            items = list(self._routes.items())
+        for key, route in items:
+            if route.done:
+                if now - route.done_at > self.done_ttl:
+                    self._drop_route(key)
+            elif not route.reaping and (
+                    now - route.last_active > self.client_deadline):
+                route.reaping = True  # count + cancel once per request
+                self.reaped += 1
+                self.engine.cancel(key)  # eviction frees the slot/queue row;
+                # the resulting done callback marks the route finished and
+                # the TTL pass above forgets it
+
+    def serve_forever(self, idle_sleep: float = 0.002,
+                      sweep_every: float = 0.25) -> None:
+        next_sweep = time.monotonic() + sweep_every
         while not self._stop.is_set():
-            if not self.engine.step():
+            worked = self.engine.step()
+            now = time.monotonic()
+            if now >= next_sweep:
+                self._sweep(now)
+                next_sweep = now + sweep_every
+            if not worked:
                 time.sleep(idle_sleep)
 
     def stop(self) -> None:
@@ -182,13 +315,24 @@ class ServingClient:
     Single-threaded: frames are drained on demand by the stream/generate
     calls and demultiplexed by request id, so one client can hold several
     streams open at once.
+
+    Reliability (ISSUE 2): frames carry ``start_index``, so the client
+    reassembles exactly the emitted sequence — duplicates are arithmetic
+    no-ops, a gap (or ``resume_after`` seconds of silence) triggers a
+    ``ResumeStream`` retransmit request, and every processed frame is
+    acknowledged with ``StreamAck`` (which doubles as liveness, keeping the
+    engine's silent-client reaper away). ``resume_from`` reattaches to a
+    request a previous client (same transport rank) left behind — the
+    reconnect-and-resume path.
     """
 
-    def __init__(self, transport: Transport, server_rank: int = SERVER_RANK):
+    def __init__(self, transport: Transport, server_rank: int = SERVER_RANK,
+                 resume_after: float = 1.0):
         self.transport = transport
         self.server_rank = server_rank
+        self.resume_after = float(resume_after)
         self._ids = itertools.count(1)
-        self._buffers: Dict[int, "queue.Queue[Tuple[List[int], bool]]"] = {}
+        self._buffers: Dict[int, "queue.Queue[Tuple[int, List[int], bool]]"] = {}
         self._rejected: set = set()
 
     def submit(self, prompt, max_new_tokens: int, **kwargs) -> int:
@@ -205,45 +349,88 @@ class ServingClient:
             MessageCode.CancelRequest,
             np.asarray([request_id], np.float32), dst=self.server_rank)
 
+    def resume_from(self, request_id: int, n_have: int = 0) -> int:
+        """Reattach to an in-flight (or recently finished) request by its
+        id — e.g. after this process reconnected — and stream the tokens
+        from ``n_have`` on via the normal :meth:`stream` call."""
+        self._buffers.setdefault(request_id, queue.Queue())
+        self._send_resume(request_id, n_have)
+        return request_id
+
+    def _send_resume(self, request_id: int, n_have: int) -> None:
+        self.transport.send(
+            MessageCode.ResumeStream,
+            np.asarray([request_id, n_have], np.float32),
+            dst=self.server_rank)
+
     def _drain_one(self, timeout: float) -> bool:
         msg = self.transport.recv(timeout=timeout)
         if msg is None:
             return False
         _sender, code, payload = msg
+        if payload.size < 1:
+            return True
         rid = int(payload[0])
         if code == MessageCode.ServeReject:
             self._rejected.add(rid)
-        elif code == MessageCode.StreamTokens:
+        elif code == MessageCode.StreamTokens and payload.size >= 3:
             buf = self._buffers.get(rid)
             if buf is not None:
-                buf.put((payload[2:].astype(np.int32).tolist(),
+                buf.put((int(payload[2]),
+                         payload[3:].astype(np.int32).tolist(),
                          bool(payload[1])))
         return True
 
-    def stream(self, request_id: int,
-               timeout: float = 60.0) -> Iterator[int]:
-        """Yield the request's tokens as frames arrive; raises
-        :class:`RequestRejected` on backpressure, ``TimeoutError`` when the
-        engine goes silent for ``timeout`` seconds."""
+    def stream(self, request_id: int, timeout: float = 60.0,
+               n_have: int = 0) -> Iterator[int]:
+        """Yield the request's tokens (from ``n_have`` on) as frames
+        arrive; raises :class:`RequestRejected` on backpressure or a
+        resume the engine cannot serve, ``TimeoutError`` when the engine
+        stays silent for ``timeout`` seconds despite retransmit requests."""
         buf = self._buffers[request_id]
         deadline = time.monotonic() + timeout
+        n = int(n_have)  # tokens of this request fully consumed so far
+        next_poke = time.monotonic() + self.resume_after
         done = False
         try:
             while not done:
                 if request_id in self._rejected:
                     self._rejected.discard(request_id)
                     raise RequestRejected(
-                        f"request {request_id} rejected (queue full)")
+                        f"request {request_id} rejected (queue full or "
+                        "unknown to the engine)")
+                now = time.monotonic()
                 try:
-                    tokens, done = buf.get_nowait()
+                    start, tokens, fdone = buf.get_nowait()
                 except queue.Empty:
-                    if time.monotonic() >= deadline:
+                    if now >= deadline:
                         raise TimeoutError(
                             f"no frames for request {request_id} in {timeout}s")
+                    if now >= next_poke:
+                        # silence: the engine may have streamed into a lossy
+                        # wire (even the done frame can drop) — ask for a
+                        # retransmit from where we stand
+                        self._send_resume(request_id, n)
+                        next_poke = now + self.resume_after
                     self._drain_one(timeout=0.05)
                     continue
-                deadline = time.monotonic() + timeout
-                for t in tokens:
+                deadline = now + timeout
+                if start > n:
+                    # gap: a frame was lost ahead of us; drop this one and
+                    # request the missing range (the retransmit covers both)
+                    self._send_resume(request_id, n)
+                    next_poke = now + self.resume_after
+                    continue
+                fresh = tokens[n - start:]  # dedup any overlap
+                if fresh:
+                    n += len(fresh)
+                    self.transport.send(
+                        MessageCode.StreamAck,
+                        np.asarray([request_id, n], np.float32),
+                        dst=self.server_rank)
+                if fdone and start + len(tokens) <= n:
+                    done = True
+                for t in fresh:
                     yield int(t)
         finally:
             # every exit path — completion, reject, timeout, an abandoned

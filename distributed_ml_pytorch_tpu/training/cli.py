@@ -96,6 +96,12 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["auto", "native", "python"],
                    help="PS control-plane transport: C++ library "
                         "(native/transport.cpp), pure Python, or auto-detect")
+    p.add_argument("--reliable", action="store_true", default=False,
+                   help="wrap the PS control plane in the reliability layer "
+                        "(per-peer sequence numbers, frame CRC, ack+retry "
+                        "with capped backoff, receiver dedup — gradient "
+                        "pushes apply exactly once under duplicates/loss); "
+                        "set it on EVERY rank of the world")
     p.add_argument("--sync-every", type=int, default=0, metavar="K",
                    help="local-sgd mode: average params every K steps "
                         "(default 0 = use --num-push)")

@@ -6,7 +6,14 @@ from distributed_ml_pytorch_tpu.utils.serialization import (
 from distributed_ml_pytorch_tpu.utils.messaging import (
     MessageCode,
     MessageListener,
+    ReliableTransport,
     send_message,
+)
+from distributed_ml_pytorch_tpu.utils.chaos import (
+    ChaosLog,
+    ChaosPlan,
+    FaultRule,
+    FaultyTransport,
 )
 from distributed_ml_pytorch_tpu.utils.checkpoint import (
     Checkpointer,
@@ -23,5 +30,10 @@ __all__ = [
     "make_unraveler",
     "MessageCode",
     "MessageListener",
+    "ReliableTransport",
     "send_message",
+    "ChaosLog",
+    "ChaosPlan",
+    "FaultRule",
+    "FaultyTransport",
 ]

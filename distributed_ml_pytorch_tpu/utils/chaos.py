@@ -1,0 +1,369 @@
+"""Deterministic fault injection for the PS and serving control planes
+(ISSUE 2 tentpole).
+
+DistBelief's defining claim is that DownPour-SGD *tolerates* an unreliable
+fleet, yet the reference has no failure handling at all (SURVEY.md §5.3) and
+nothing in this repo ever exercised the gap-closing primitives
+(``utils/failure.py``, ``utils/checkpoint.py``, worker degrade-to-local)
+under real faults. This module makes faults injectable **and reproducible**:
+
+- :class:`FaultRule` / :class:`ChaosPlan` — a schedulable fault plan matched
+  per ``(src, dst, MessageCode)`` channel: drop, delay, duplicate, reorder,
+  corrupt-payload, each with its own probability, optionally windowed to a
+  range of that channel's send indices (``after``/``until``).
+- :class:`FaultyTransport` — wraps any :class:`~.messaging.Transport` and
+  applies the plan on the send path. Every channel owns an independent
+  seeded RNG stream (``SeedSequence([seed, src, dst, code])``), so the
+  fault decisions for channel send #i are a pure function of the plan —
+  independent of thread interleaving across channels. One-way partitions
+  (:meth:`FaultyTransport.partition`) and scripted peer crash/restart
+  (:meth:`ChaosWorld.crash` / :meth:`ChaosWorld.restart`) are imperative
+  chaos-script hooks on top.
+- :class:`ChaosLog` — records exactly which faults fired, as
+  ``(src, dst, code, channel_index, kind)`` events. :meth:`ChaosLog.lines`
+  renders them canonically sorted by channel and index, so two runs of the
+  same seeded scenario produce **byte-identical** logs even though wall-
+  clock interleaving differs (tests assert this; see tests/test_chaos.py).
+
+Determinism contract: per channel, the decision for send #i depends only on
+``(plan.seed, src, dst, code, i)``. A scenario whose per-channel send
+sequences are deterministic (fixed step counts, fixed cadences) therefore
+produces a deterministic fault log and deterministic delivery outcomes —
+chaos in CI, not flakes in CI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    SERVER_RANK,
+    MessageCode,
+    Transport,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultRule:
+    """One matcher + fault mix. ``None`` fields are wildcards; ``after`` /
+    ``until`` window the rule to that channel's send indices [after, until).
+    The first matching rule of a plan wins (rules are an ordered script)."""
+
+    src: Optional[int] = None
+    dst: Optional[int] = None
+    code: Optional[int] = None          # MessageCode value, or None = any
+    drop: float = 0.0                   # P(frame never forwarded)
+    dup: float = 0.0                    # P(frame forwarded twice)
+    reorder: float = 0.0                # P(frame held until the channel's next send)
+    corrupt: float = 0.0                # P(payload bytes corrupted in flight)
+    delay: float = 0.0                  # seconds each delayed frame is held
+    delay_p: float = 0.0                # P(frame delayed by `delay`)
+    after: int = 0
+    until: Optional[int] = None
+
+    def matches(self, src: int, dst: int, code: int, index: int) -> bool:
+        if self.src is not None and src != self.src:
+            return False
+        if self.dst is not None and dst != self.dst:
+            return False
+        if self.code is not None and code != int(self.code):
+            return False
+        if index < self.after:
+            return False
+        if self.until is not None and index >= self.until:
+            return False
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosPlan:
+    """An ordered fault script plus the seed every channel RNG derives from."""
+
+    rules: Tuple[FaultRule, ...] = ()
+    seed: int = 0
+
+    def __init__(self, rules: Sequence[FaultRule] = (), seed: int = 0):
+        object.__setattr__(self, "rules", tuple(rules))
+        object.__setattr__(self, "seed", int(seed))
+
+    def rule_for(self, src: int, dst: int, code: int, index: int) -> Optional[FaultRule]:
+        for rule in self.rules:
+            if rule.matches(src, dst, code, index):
+                return rule
+        return None
+
+
+class ChaosLog:
+    """Thread-safe record of every fault that fired.
+
+    Events are ``(src, dst, code, channel_index, kind)``. :meth:`lines`
+    sorts them canonically — by channel then index — so the rendering is a
+    pure function of WHICH faults fired, not of when threads ran; the
+    acceptance test asserts byte-identical renderings across runs.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: List[Tuple[int, int, int, int, str]] = []
+
+    def record(self, src: int, dst: int, code: int, index: int, kind: str) -> None:
+        with self._lock:
+            self._events.append((src, dst, int(code), index, kind))
+
+    def events(self) -> List[Tuple[int, int, int, int, str]]:
+        with self._lock:
+            return list(self._events)
+
+    def lines(self) -> str:
+        rows = sorted(self.events())
+        out = []
+        for src, dst, code, index, kind in rows:
+            try:
+                name = MessageCode(code).name
+            except ValueError:
+                name = str(code)
+            out.append(f"{src}->{dst} {name} #{index} {kind}")
+        return "\n".join(out) + ("\n" if out else "")
+
+    def counts(self) -> Dict[str, int]:
+        c: Dict[str, int] = {}
+        for *_chan, kind in self.events():
+            c[kind] = c.get(kind, 0) + 1
+        return c
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+class _WorldState:
+    """Shared across one world's wrappers: which ranks are scripted dead."""
+
+    def __init__(self):
+        self.crashed: set = set()
+        self.lock = threading.Lock()
+
+
+class _Channel:
+    __slots__ = ("index", "rng", "held")
+
+    def __init__(self, seed: int, src: int, dst: int, code: int):
+        self.index = 0
+        self.rng = np.random.default_rng(
+            np.random.SeedSequence([seed & 0xFFFFFFFF, src, dst, code]))
+        self.held: Optional[np.ndarray] = None  # reorder buffer (code is fixed)
+
+
+class FaultyTransport(Transport):
+    """A :class:`Transport` that injects the plan's faults on ``send``.
+
+    Faults apply on the SEND side, which makes a one-way partition natural
+    (each endpoint owns its outbound direction) and keeps the receive path
+    byte-honest — what arrives is exactly what the faulted wire delivered.
+    """
+
+    def __init__(
+        self,
+        inner: Transport,
+        plan: ChaosPlan,
+        log: Optional[ChaosLog] = None,
+        world: Optional[_WorldState] = None,
+    ):
+        self.inner = inner
+        self.rank = inner.rank
+        self.plan = plan
+        self.log = log if log is not None else ChaosLog()
+        self._world = world if world is not None else _WorldState()
+        self._channels: Dict[Tuple[int, int, int], _Channel] = {}
+        self._lock = threading.Lock()
+        self._partitioned: set = set()  # dsts this endpoint cannot reach
+        self._delayed: list = []        # heap of (deliver_at, tiebreak, code, frame, dst)
+        self._delay_seq = 0
+        self._delay_wake = threading.Event()
+        self._closed = False
+        self._delay_thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def wrap_world(
+        cls,
+        world: Dict[int, Transport],
+        plan: ChaosPlan,
+        log: Optional[ChaosLog] = None,
+    ) -> Tuple[Dict[int, "FaultyTransport"], ChaosLog]:
+        """Wrap every rank of an in-process world with one shared log and
+        one shared crash-script state; returns ``(wrapped_world, log)``."""
+        log = log if log is not None else ChaosLog()
+        state = _WorldState()
+        return (
+            {r: cls(t, plan, log=log, world=state) for r, t in world.items()},
+            log,
+        )
+
+    # ------------------------------------------------------ chaos scripting
+    def partition(self, dst: int) -> None:
+        """One-way partition: this endpoint's frames toward ``dst`` vanish
+        (logged); the reverse direction is untouched."""
+        self._partitioned.add(dst)
+
+    def heal(self, dst: int) -> None:
+        self._partitioned.discard(dst)
+
+    def crash(self) -> None:
+        """Scripted crash of THIS endpoint: its sends raise
+        ``ConnectionError`` (like a dead TCP socket), peers' sends to it
+        raise too, and its ``recv`` returns ``None``."""
+        with self._world.lock:
+            self._world.crashed.add(self.rank)
+
+    def restart(self) -> None:
+        """Scripted restart: the endpoint serves again (rejoin flows —
+        worker ``rejoin=True`` pulls, server ``maybe_restore`` — are the
+        caller's script)."""
+        with self._world.lock:
+            self._world.crashed.discard(self.rank)
+
+    def _is_crashed(self, rank: int) -> bool:
+        with self._world.lock:
+            return rank in self._world.crashed
+
+    # --------------------------------------------------------------- faults
+    def _channel(self, dst: int, code: int) -> _Channel:
+        key = (self.rank, dst, code)
+        with self._lock:
+            chan = self._channels.get(key)
+            if chan is None:
+                chan = self._channels[key] = _Channel(
+                    self.plan.seed, self.rank, dst, code)
+            return chan
+
+    def _corrupted(self, payload: np.ndarray, chan: _Channel) -> np.ndarray:
+        arr = np.array(payload, dtype=np.float32, copy=True).ravel()
+        if arr.size == 0:
+            # an empty frame corrupts into one garbage element — detectable
+            # (CRC) and harmful (a parser expecting emptiness sees bytes)
+            return np.asarray([np.float32(np.nan)], np.float32)
+        k = chan.index % arr.size
+        bits = arr.view(np.uint32).copy()
+        bits[k] ^= np.uint32(0x5A5A5A5A)
+        return bits.view(np.float32)
+
+    def send(self, code: MessageCode, payload: np.ndarray, dst: int = SERVER_RANK) -> None:
+        if self._is_crashed(self.rank):
+            raise ConnectionError(f"chaos: rank {self.rank} is crashed")
+        if self._is_crashed(dst):
+            raise ConnectionError(f"chaos: peer {dst} is crashed")
+        code = MessageCode(code)
+        chan = self._channel(dst, int(code))
+        with self._lock:
+            i = chan.index
+            chan.index += 1
+            # fixed draw schedule: every send consumes the same number of
+            # uniforms, so decision i is independent of earlier outcomes
+            u = chan.rng.uniform(size=5)
+        if dst in self._partitioned:
+            self.log.record(self.rank, dst, int(code), i, "partition-drop")
+            return
+        rule = self.plan.rule_for(self.rank, dst, int(code), i)
+        if rule is None:
+            self._forward(code, payload, dst, chan)
+            return
+        if u[0] < rule.drop:
+            self.log.record(self.rank, dst, int(code), i, "drop")
+            return
+        if u[3] < rule.corrupt:
+            self.log.record(self.rank, dst, int(code), i, "corrupt")
+            payload = self._corrupted(payload, chan)
+        if u[4] < rule.delay_p and rule.delay > 0:
+            self.log.record(self.rank, dst, int(code), i, "delay")
+            self._schedule_delayed(code, payload, dst, rule.delay)
+            return
+        if u[2] < rule.reorder:
+            # hold this frame; it rides out right after the channel's next
+            # send (an adjacent swap — the minimal, deterministic reorder)
+            self.log.record(self.rank, dst, int(code), i, "reorder-hold")
+            with self._lock:
+                prev, chan.held = chan.held, np.array(
+                    payload, dtype=np.float32, copy=True).ravel()
+            if prev is not None:
+                self.inner.send(code, prev, dst=dst)
+            return
+        self._forward(code, payload, dst, chan)
+        if u[1] < rule.dup:
+            self.log.record(self.rank, dst, int(code), i, "dup")
+            self.inner.send(code, payload, dst=dst)
+
+    def _forward(self, code: MessageCode, payload, dst: int, chan: _Channel) -> None:
+        self.inner.send(code, payload, dst=dst)
+        with self._lock:
+            held, chan.held = chan.held, None
+        if held is not None:
+            self.inner.send(code, held, dst=dst)
+
+    # --------------------------------------------------------------- delay
+    def _schedule_delayed(self, code, payload, dst: int, delay: float) -> None:
+        frame = np.array(payload, dtype=np.float32, copy=True).ravel()
+        with self._lock:
+            self._delay_seq += 1
+            heapq.heappush(
+                self._delayed,
+                (time.monotonic() + delay, self._delay_seq, int(code), frame, dst),
+            )
+            if self._delay_thread is None:
+                self._delay_thread = threading.Thread(
+                    target=self._delay_loop, name="chaos-delay", daemon=True)
+                self._delay_thread.start()
+        self._delay_wake.set()
+
+    def _delay_loop(self) -> None:
+        while not self._closed:
+            with self._lock:
+                head = self._delayed[0] if self._delayed else None
+            now = time.monotonic()
+            if head is None:
+                self._delay_wake.wait(0.05)
+                self._delay_wake.clear()
+                continue
+            if head[0] > now:
+                self._delay_wake.wait(min(0.05, head[0] - now))
+                self._delay_wake.clear()
+                continue
+            with self._lock:
+                _at, _seq, code, frame, dst = heapq.heappop(self._delayed)
+            try:
+                self.inner.send(MessageCode(code), frame, dst=dst)
+            except (OSError, ConnectionError, KeyError):
+                pass  # the peer died while the frame was in flight
+
+    # ---------------------------------------------------------------- recv
+    def recv(self, timeout: Optional[float] = None):
+        if self._is_crashed(self.rank):
+            # a crashed endpoint hears nothing (bounded: honor the timeout)
+            if timeout:
+                time.sleep(min(timeout, 0.05))
+            return None
+        return self.inner.recv(timeout=timeout)
+
+    def close(self) -> None:
+        self._closed = True
+        self._delay_wake.set()
+        # a reorder-held frame whose channel never sent again would turn
+        # the logged "reorder-hold" into a silent drop — flush it now so
+        # the log's accounting matches what was actually delivered
+        with self._lock:
+            held = [((src, dst, code), chan.held)
+                    for (src, dst, code), chan in self._channels.items()
+                    if chan.held is not None]
+            for (_src, _dst, _code), _frame in held:
+                self._channels[(_src, _dst, _code)].held = None
+        for (_src, dst, code), frame in held:
+            try:
+                self.inner.send(MessageCode(code), frame, dst=dst)
+            except (OSError, ConnectionError, KeyError):
+                pass  # the peer is already gone; nothing left to reorder to
+        self.inner.close()

@@ -23,21 +23,39 @@ Here the same API sits on a pluggable :class:`Transport`:
 Wire format (TCP): little-endian header ``(sender:i32, code:i32, nbytes:i64)``
 followed by a float32 payload — the flat raveled model vector, fixed size per
 model, exactly the implied reference format (SURVEY.md §2.3 M2).
+
+Reliability (codes 9-10): :class:`ReliableTransport` wraps any transport with
+per-peer sequence numbers, a frame CRC, ack + capped-exponential-backoff
+retry, and receiver-side dedup — at-least-once delivery on the wire,
+exactly-once application at the receiver. The envelope rides the existing
+float32 wire (every header field < 2^16, exact in float32), so Python, TCP
+and native C++ endpoints all carry it; plain frames from a peer that did not
+negotiate reliability pass through untouched.
 """
 
 from __future__ import annotations
 
+import collections
 import enum
+import logging
 import queue
 import socket
 import struct
 import threading
 import time
+import zlib
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+_LOGGER = logging.getLogger(__name__)
+
 _HEADER = struct.Struct("<iiq")
+
+#: Upper bound on a declared frame payload (satellite hardening): a malformed
+#: or hostile header must not make the reader allocate unbounded memory. The
+#: largest legitimate frame is a raveled model vector — 256M f32 params.
+MAX_FRAME_BYTES = 1 << 30
 
 SERVER_RANK = 0  # reference convention: rank 0 is the parameter server
 
@@ -64,9 +82,13 @@ class MessageCode(enum.IntEnum):
     WorkerDone = 3
     Heartbeat = 4
     SubmitRequest = 5   # client → engine: [id, max_new, temp, top_k, top_p, seed, eos, *prompt]
-    StreamTokens = 6    # engine → client: [id, done_flag, *tokens]
-    ServeReject = 7     # engine → client: [id] — queue full (backpressure)
+    StreamTokens = 6    # engine → client: [id, done_flag, start_index, *tokens]
+    ServeReject = 7     # engine → client: [id] — queue full / unknown resume
     CancelRequest = 8   # client → engine: [id]
+    ReliableFrame = 9   # envelope: [inc_lo, inc_hi, seq_lo, seq_hi, crc_lo, crc_hi, code, *payload]
+    ReliableAck = 10    # receiver → sender: [seq_lo, seq_hi, inc_lo, inc_hi]
+    StreamAck = 11      # client → engine: [id, n_received] — progress + liveness
+    ResumeStream = 12   # client → engine: [id, n_received] — re-send from offset
 
 
 Message = Tuple[int, MessageCode, np.ndarray]
@@ -140,15 +162,52 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return b"".join(chunks)
 
 
-def _recv_frame(sock: socket.socket) -> Optional[Message]:
+#: Sentinel for "this frame was malformed but the stream is still framed" —
+#: the reader logs, skips it, and keeps serving (``None`` still means the
+#: connection is closed/unframeable and the reader should exit).
+_MALFORMED = object()
+
+
+def _recv_frame(sock: socket.socket):
+    """One wire frame: a ``Message``, ``None`` (closed / unrecoverable), or
+    :data:`_MALFORMED` (bad frame consumed; keep reading).
+
+    Hardened (ISSUE 2 satellite): the declared payload length is bounded
+    BEFORE any allocation, the MessageCode is validated before construction,
+    and a malformed-but-framed frame is dropped with a log line instead of
+    raising out of the reader thread. A length the framing cannot trust
+    (negative, non-float32-aligned, or over :data:`MAX_FRAME_BYTES`) means
+    the byte stream itself is garbage — there is no resync point — so the
+    connection is dropped, loudly.
+    """
     hdr = _recv_exact(sock, _HEADER.size)
     if hdr is None:
         return None
     sender, code, nbytes = _HEADER.unpack(hdr)
+    if nbytes < 0 or nbytes > MAX_FRAME_BYTES:
+        _LOGGER.warning(
+            "dropping connection: unframeable payload length %d (sender=%d "
+            "code=%d) — stream cannot be resynced", nbytes, sender, code,
+        )
+        return None
     body = _recv_exact(sock, nbytes)
     if body is None:
         return None
-    return sender, MessageCode(code), np.frombuffer(body, dtype=np.float32).copy()
+    try:
+        mcode = MessageCode(code)
+    except ValueError:
+        _LOGGER.warning(
+            "dropping malformed frame: unknown MessageCode %d from sender %d "
+            "(%d bytes)", code, sender, nbytes,
+        )
+        return _MALFORMED
+    if nbytes % 4:
+        _LOGGER.warning(
+            "dropping malformed frame: %d-byte payload is not float32-"
+            "aligned (sender=%d code=%d)", nbytes, sender, code,
+        )
+        return _MALFORMED
+    return sender, mcode, np.frombuffer(body, dtype=np.float32).copy()
 
 
 class TCPTransport(Transport):
@@ -237,7 +296,7 @@ class TCPTransport(Transport):
         conn.settimeout(5.0)
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         hello = _recv_frame(conn)
-        if hello is None:
+        if hello is None or hello is _MALFORMED:
             raise ConnectionError("worker handshake failed")
         conn.settimeout(None)  # handshake done: reads must block indefinitely
         peer_rank = hello[0]
@@ -279,6 +338,8 @@ class TCPTransport(Transport):
                 msg = _recv_frame(sock)
                 if msg is None:
                     break
+                if msg is _MALFORMED:
+                    continue  # logged in _recv_frame; the stream is intact
                 self._inbox.put(msg)
 
         t = threading.Thread(target=pump, daemon=True)
@@ -316,6 +377,321 @@ class TCPTransport(Transport):
             self._server_sock.close()
 
 
+def _split16(value: int) -> Tuple[float, float]:
+    """A uint32 as two float32-exact uint16 halves (the float32 wire carries
+    integers exactly only below 2^24)."""
+    return float(value & 0xFFFF), float((value >> 16) & 0xFFFF)
+
+
+def _join16(lo: float, hi: float) -> int:
+    return (int(lo) & 0xFFFF) | ((int(hi) & 0xFFFF) << 16)
+
+
+_INC_LOCK = threading.Lock()
+_LAST_INC = 0
+
+
+def _frame_crc(inc: int, seq: int, code: int, body_bytes: bytes) -> int:
+    """CRC over the WHOLE envelope (incarnation, seq, code, body): a wire
+    flip in any header field must fail the check, or e.g. a corrupted
+    incarnation would be adopted as a 'newer life' and blackhole every
+    subsequent legitimate frame as stale."""
+    head = struct.pack("<III", inc & 0xFFFFFFFF, seq & 0xFFFFFFFF,
+                       code & 0xFFFFFFFF)
+    return zlib.crc32(body_bytes, zlib.crc32(head)) & 0xFFFFFFFF
+
+
+def _next_incarnation() -> int:
+    """Second-stamped (32 bits of epoch seconds wrap in 2106 — a
+    millisecond stamp would wrap every ~50 days and make a post-wrap
+    restart read as an OLDER life), strictly increasing within this
+    process so transports created in the same second still read as
+    distinct lives."""
+    global _LAST_INC
+    with _INC_LOCK:
+        _LAST_INC = max(_LAST_INC + 1, int(time.time()) & 0xFFFFFFFF)
+        return _LAST_INC
+
+
+class _Pending:
+    __slots__ = ("frame", "dst", "deadline", "attempt")
+
+    def __init__(self, frame: np.ndarray, dst: int, deadline: float):
+        self.frame = frame
+        self.dst = dst
+        self.deadline = deadline
+        self.attempt = 1
+
+
+class ReliableTransport(Transport):
+    """Reliable delivery over any :class:`Transport` (the ISSUE 2 tentpole's
+    reliability layer).
+
+    Sender side: every frame is wrapped in a ``ReliableFrame`` envelope
+    carrying a per-peer sequence number and a CRC-32 of the payload bytes; a
+    background thread retries unacked frames with capped exponential backoff
+    (``ack_timeout · 2^attempt``, capped at ``max_backoff``) until an
+    ``ReliableAck`` arrives or ``max_retries`` is exhausted — at which point
+    the peer is declared dead and subsequent sends to it raise
+    ``ConnectionError``, feeding the existing degrade-to-local path
+    (``parallel/async_ps.Asynchronous._send``).
+
+    Receiver side: a corrupt frame (CRC mismatch) is dropped unacked — the
+    sender retries; a duplicate (retry of an acked frame, or a wire-level
+    dup) is re-acked but NOT redelivered, so e.g. the parameter server
+    applies each ``GradientUpdate`` exactly once under duplicates/retries.
+
+    Peer lifecycle: the envelope carries a per-instance *incarnation*
+    (millisecond construction stamp), so a restarted peer's fresh sequence
+    space is not mistaken for duplicates of its previous life — a NEWER
+    incarnation resets that sender's dedup state, an older one (a straggler
+    retry from the dead process) is acked-and-dropped. Symmetrically, any
+    frame received from a rank previously declared dead revives it for
+    sending (the rejoin path).
+
+    Negotiation is per transport and symmetric-but-tolerant: both ends of a
+    link should wrap (``--reliable``), yet plain frames from an unwrapped
+    peer pass straight through, and :attr:`unreliable_codes` (heartbeats by
+    default — periodic and self-healing) skip the envelope entirely so a
+    dead peer cannot trigger a heartbeat retry storm.
+    """
+
+    def __init__(
+        self,
+        inner: Transport,
+        *,
+        ack_timeout: float = 0.1,
+        max_backoff: float = 2.0,
+        max_retries: int = 10,
+        dedup_window: int = 4096,
+        unreliable_codes: Tuple[MessageCode, ...] = (MessageCode.Heartbeat,),
+    ):
+        self.inner = inner
+        self.rank = inner.rank
+        self.ack_timeout = float(ack_timeout)
+        self.max_backoff = float(max_backoff)
+        self.max_retries = int(max_retries)
+        self.dedup_window = int(dedup_window)
+        self.unreliable_codes = frozenset(
+            int(c) for c in unreliable_codes
+        ) | {int(MessageCode.ReliableFrame), int(MessageCode.ReliableAck)}
+        self._lock = threading.Lock()
+        #: this sender instance's incarnation: restarted processes stamp a
+        #: LATER value, which tells receivers to reset dedup state for the
+        #: rank instead of blackholing the fresh seq space
+        self.incarnation = _next_incarnation()
+        self._next_seq: Dict[int, int] = {}
+        self._pending: Dict[Tuple[int, int], _Pending] = {}
+        self._requeue: "collections.deque[Message]" = collections.deque()
+        self._seen: Dict[int, "collections.OrderedDict"] = {}
+        self._peer_inc: Dict[int, int] = {}
+        self._dead_peers: set = set()
+        self._closed = False
+        self.stats = {
+            "sent": 0, "retries": 0, "acked": 0, "gave_up": 0,
+            "crc_dropped": 0, "dup_dropped": 0, "delivered": 0,
+            "passthrough": 0,
+        }
+        self._retry_thread = threading.Thread(
+            target=self._retry_loop, name="reliable-retry", daemon=True)
+        self._retry_thread.start()
+
+    # ---------------------------------------------------------------- send
+    def send(self, code: MessageCode, payload: np.ndarray, dst: int = SERVER_RANK) -> None:
+        if int(code) in self.unreliable_codes:
+            self.inner.send(code, payload, dst=dst)
+            return
+        if dst in self._dead_peers:
+            raise ConnectionError(
+                f"peer {dst} declared dead after {self.max_retries} "
+                "unacked retries")
+        arr = np.asarray(payload, dtype=np.float32).ravel()
+        with self._lock:
+            seq = self._next_seq.get(dst, 0)
+            self._next_seq[dst] = seq + 1
+        crc = _frame_crc(self.incarnation, seq, int(code), arr.tobytes())
+        header = np.asarray(
+            [*_split16(self.incarnation), *_split16(seq), *_split16(crc),
+             float(int(code))], np.float32)
+        frame = np.concatenate([header, arr])
+        with self._lock:
+            self._pending[(dst, seq)] = _Pending(
+                frame, dst, time.monotonic() + self.ack_timeout)
+            self.stats["sent"] += 1
+        try:
+            self.inner.send(MessageCode.ReliableFrame, frame, dst=dst)
+        except (OSError, ConnectionError, KeyError):
+            # the retry loop owns recovery; a transient send failure is
+            # exactly what the pending buffer exists for
+            pass
+
+    def _retry_loop(self) -> None:
+        while not self._closed:
+            time.sleep(min(0.02, self.ack_timeout / 2))
+            now = time.monotonic()
+            with self._lock:
+                due = [
+                    (key, p) for key, p in self._pending.items()
+                    if p.deadline <= now and p.dst not in self._dead_peers
+                ]
+            for key, p in due:
+                if p.attempt > self.max_retries:
+                    with self._lock:
+                        self._pending.pop(key, None)
+                        self.stats["gave_up"] += 1
+                        self._dead_peers.add(p.dst)
+                        dropped = [
+                            k for k in self._pending if k[0] == p.dst
+                        ]
+                        for k in dropped:
+                            del self._pending[k]
+                    _LOGGER.warning(
+                        "reliable: peer %d unacked after %d retries — "
+                        "declaring it dead (%d queued frames dropped)",
+                        p.dst, self.max_retries, len(dropped))
+                    continue
+                backoff = min(
+                    self.ack_timeout * (2.0 ** p.attempt), self.max_backoff)
+                p.attempt += 1
+                p.deadline = now + backoff
+                with self._lock:
+                    self.stats["retries"] += 1
+                try:
+                    self.inner.send(MessageCode.ReliableFrame, p.frame, dst=p.dst)
+                except (OSError, ConnectionError, KeyError):
+                    pass  # next pass retries or gives up
+
+    # ---------------------------------------------------------------- recv
+    def _process(self, msg: Optional[Message]) -> Optional[Message]:
+        """Handle one inner frame: acks and envelope bookkeeping are
+        absorbed; returns a deliverable message or ``None``."""
+        if msg is None:
+            return None
+        sender, code, payload = msg
+        # ANY frame from a rank previously declared dead is evidence of
+        # life: a restarted peer on the same rank must be sendable again
+        # (the reconnect-and-resume / rejoin paths)
+        if sender in self._dead_peers:
+            with self._lock:
+                self._dead_peers.discard(sender)
+        if code == MessageCode.ReliableAck:
+            # the ack echoes the FRAME's incarnation: a straggler ack for a
+            # previous life's frame (same seq, old inc) must not clear the
+            # new life's pending entry — that frame still needs its retry
+            if payload.size >= 4:
+                try:
+                    seq = _join16(payload[0], payload[1])
+                    inc = _join16(payload[2], payload[3])
+                except (ValueError, OverflowError):
+                    return None
+                if inc != self.incarnation:
+                    return None
+                with self._lock:
+                    if self._pending.pop((sender, seq), None) is not None:
+                        self.stats["acked"] += 1
+            return None
+        if code != MessageCode.ReliableFrame:
+            with self._lock:
+                self.stats["passthrough"] += 1
+            return msg  # plain frame from an unwrapped peer
+        if payload.size < 7:
+            return None  # truncated envelope: unacked → sender retries
+        try:
+            inc = _join16(payload[0], payload[1])
+            seq = _join16(payload[2], payload[3])
+            crc = _join16(payload[4], payload[5])
+            inner_code = int(payload[6])
+        except (ValueError, OverflowError):
+            # corruption turned a header float non-finite: unparseable,
+            # unacked → the sender's retry delivers a clean copy
+            with self._lock:
+                self.stats["crc_dropped"] += 1
+            return None
+        body = payload[7:]
+        if _frame_crc(inc, seq, inner_code, body.tobytes()) != crc:
+            with self._lock:
+                self.stats["crc_dropped"] += 1
+            return None  # corrupt: no ack, the retry delivers a clean copy
+        with self._lock:
+            known = self._peer_inc.get(sender)
+            if known is None or inc > known:
+                # a newer incarnation of this rank: fresh process, fresh
+                # sequence space — the old dedup state would blackhole it
+                self._peer_inc[sender] = inc
+                self._seen.pop(sender, None)
+            # inc < known: straggler retry from the rank's previous life —
+            # ack it below so the dead process stops retrying, never deliver
+            stale = known is not None and inc < known
+        try:
+            self.inner.send(
+                MessageCode.ReliableAck,
+                np.asarray([*_split16(seq), *_split16(inc)], np.float32),
+                dst=sender)
+        except (OSError, ConnectionError, KeyError):
+            pass  # ack lost: the sender's retry re-triggers it
+        if stale:
+            return None
+        try:
+            mcode = MessageCode(inner_code)
+        except ValueError:
+            return None  # acked (don't retry garbage), never delivered
+        with self._lock:
+            seen = self._seen.setdefault(sender, collections.OrderedDict())
+            if seq in seen:
+                self.stats["dup_dropped"] += 1
+                return None
+            seen[seq] = True
+            while len(seen) > self.dedup_window:
+                seen.popitem(last=False)
+            self.stats["delivered"] += 1
+        return sender, mcode, body
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[Message]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._closed:
+                return None
+            try:
+                return self._requeue.popleft()  # frames surfaced by flush()
+            except IndexError:
+                pass
+            slice_t = 0.1
+            if deadline is not None:
+                slice_t = max(0.0, min(0.1, deadline - time.monotonic()))
+            delivered = self._process(self.inner.recv(timeout=slice_t))
+            if delivered is not None:
+                return delivered
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+
+    # --------------------------------------------------------------- admin
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Block until every sent frame is acked (or a peer dies / timeout).
+
+        Pumps the inner transport itself so acks clear even when no other
+        thread is in :meth:`recv` (a pure sender); data frames that arrive
+        meanwhile are requeued for the next ``recv``. Call before
+        ``close()`` when the last frames matter (``WorkerDone``)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                live = [
+                    k for k in self._pending if k[0] not in self._dead_peers
+                ]
+            if not live:
+                return True
+            delivered = self._process(self.inner.recv(timeout=0.02))
+            if delivered is not None:
+                self._requeue.append(delivered)
+        return False
+
+    def close(self) -> None:
+        if not self._closed:
+            self.flush(timeout=min(2.0, self.max_backoff))
+        self._closed = True
+        self.inner.close()
+
+
 def make_transport(
     rank: int,
     world_size: int,
@@ -323,6 +699,7 @@ def make_transport(
     port: int = 29500,
     kind: str = "auto",
     connect_timeout: float = 60.0,
+    reliable: bool = False,
 ) -> Transport:
     """Transport factory for the PS control plane.
 
@@ -331,21 +708,29 @@ def make_transport(
     native when the library builds/loads, Python otherwise. Both speak the
     same wire format, so mixed worlds (e.g. a native server with Python
     workers) interoperate.
+
+    ``reliable=True`` wraps the result in a :class:`ReliableTransport`
+    (seq + CRC + ack/retry + dedup). Negotiate it on every rank of a world
+    (the CLI's ``--reliable``); an unwrapped peer's frames still pass
+    through, it just gets no retransmit service.
     """
     if kind not in ("auto", "native", "python"):
         raise ValueError(f"unknown transport kind: {kind!r}")
+    t: Optional[Transport] = None
     if kind in ("auto", "native"):
         from distributed_ml_pytorch_tpu import native
 
         if native.native_available():
-            return native.NativeTCPTransport(
+            t = native.NativeTCPTransport(
                 rank, world_size, master, int(port), connect_timeout
             )
-        if kind == "native":
+        elif kind == "native":
             raise RuntimeError(
                 f"native transport requested but unavailable: {native.native_load_error()}"
             )
-    return TCPTransport(rank, world_size, master, int(port), connect_timeout)
+    if t is None:
+        t = TCPTransport(rank, world_size, master, int(port), connect_timeout)
+    return ReliableTransport(t) if reliable else t
 
 
 # --- module-level default transport -----------------------------------------

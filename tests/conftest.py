@@ -54,6 +54,12 @@ def pytest_configure(config):
         "1-core host); `make test` deselects these for a fast core signal, "
         "`make test-all` runs everything",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection tests (utils/chaos.py + the "
+        "reliability layer); `make chaos` selects exactly these — fast "
+        "seeded cases run in tier-1, soak variants are additionally slow",
+    )
 
 
 # Modules whose tests launch real subprocess worlds (interpreter start + jit

@@ -107,3 +107,196 @@ def test_tcp_transport_round_trip():
     assert reply is not None
     assert reply[0] == 0 and reply[1] == MessageCode.ParameterUpdate
     np.testing.assert_array_equal(reply[2], np.full(3, 7.0, np.float32))
+
+
+def test_reliable_round_trip_and_dedup():
+    """Reliability layer (ISSUE 2): seq+CRC envelope, ack clears pending,
+    and a wire-level duplicate is re-acked but delivered once."""
+    from distributed_ml_pytorch_tpu.utils.messaging import ReliableTransport
+
+    world = InProcessTransport.create_world(2)
+    a = ReliableTransport(world[0], ack_timeout=0.05)
+    b = ReliableTransport(world[1], ack_timeout=0.05)
+    try:
+        b.send(MessageCode.GradientUpdate, np.arange(4, dtype=np.float32))
+        msg = a.recv(timeout=5)
+        assert msg is not None and msg[1] == MessageCode.GradientUpdate
+        np.testing.assert_array_equal(msg[2], np.arange(4, dtype=np.float32))
+        assert b.flush(timeout=5) and b.stats["acked"] == 1
+
+        # replay the same envelope (a retry that crossed its ack): craft it
+        # byte-correct — same incarnation, same seq 0, REAL crc — so the
+        # drop can only come from the dedup path, not the CRC check
+        from distributed_ml_pytorch_tpu.utils.messaging import (
+            _frame_crc,
+            _split16,
+        )
+
+        body = np.arange(4, dtype=np.float32)
+        crc = _frame_crc(b.incarnation, 0, int(MessageCode.GradientUpdate),
+                         body.tobytes())
+        b.inner.send(
+            MessageCode.ReliableFrame,
+            np.concatenate([
+                np.asarray([*_split16(b.incarnation), *_split16(0),
+                            *_split16(crc),
+                            float(int(MessageCode.GradientUpdate))],
+                           np.float32),
+                body]))
+        assert a.recv(timeout=0.3) is None  # dropped as duplicate
+        assert a.stats["dup_dropped"] == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_tcp_reader_survives_unknown_code_and_misaligned_frame():
+    """Satellite hardening: a malformed frame (unknown MessageCode, or a
+    non-float32-aligned payload) is dropped and logged — the reader thread
+    keeps serving subsequent well-formed frames."""
+    import struct
+
+    from distributed_ml_pytorch_tpu.utils.messaging import _HEADER
+
+    port = _free_port()
+    holder = {}
+
+    def server():
+        holder["t"] = TCPTransport(0, 2, "localhost", port)
+
+    st = threading.Thread(target=server)
+    st.start()
+    w = None
+    for _ in range(100):
+        try:
+            w = TCPTransport(1, 2, "localhost", port)
+            break
+        except OSError:
+            time.sleep(0.05)
+    st.join(timeout=10)
+    assert w is not None
+    t = holder["t"]
+    try:
+        sock = w._peers[0]
+        # unknown code 99, sane length
+        sock.sendall(_HEADER.pack(1, 99, 8) + b"\x00" * 8)
+        # known code, misaligned 6-byte payload
+        sock.sendall(_HEADER.pack(1, int(MessageCode.GradientUpdate), 6)
+                     + b"\x00" * 6)
+        # a well-formed frame AFTER the garbage must still arrive
+        w.send(MessageCode.GradientUpdate, np.arange(3, dtype=np.float32))
+        msg = t.recv(timeout=10)
+        assert msg is not None and msg[1] == MessageCode.GradientUpdate
+        np.testing.assert_array_equal(msg[2], np.arange(3, dtype=np.float32))
+    finally:
+        w.close()
+        t.close()
+
+
+def test_tcp_reader_drops_connection_on_insane_length():
+    """A declared payload length over MAX_FRAME_BYTES cannot be resynced —
+    that connection is dropped (loudly), not the process."""
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        _HEADER,
+        MAX_FRAME_BYTES,
+    )
+
+    port = _free_port()
+    holder = {}
+
+    def server():
+        holder["t"] = TCPTransport(0, 2, "localhost", port)
+
+    st = threading.Thread(target=server)
+    st.start()
+    w = None
+    for _ in range(100):
+        try:
+            w = TCPTransport(1, 2, "localhost", port)
+            break
+        except OSError:
+            time.sleep(0.05)
+    st.join(timeout=10)
+    t = holder["t"]
+    try:
+        w._peers[0].sendall(
+            _HEADER.pack(1, int(MessageCode.GradientUpdate),
+                         MAX_FRAME_BYTES + 4))
+        # frames after the poisoned header are never parsed: that reader
+        # is gone, but the server process/transport itself stays up
+        assert t.recv(timeout=0.5) is None
+    finally:
+        w.close()
+        t.close()
+
+
+def test_reliable_restarted_peer_not_blackholed_and_dead_peer_heals():
+    """Peer lifecycle (ISSUE 2 review findings): (a) a restarted peer's
+    fresh seq space must not be deduped against its previous life — the
+    incarnation stamp resets the receiver's state; (b) a rank declared dead
+    after exhausted retries is revived by any frame it sends."""
+    from distributed_ml_pytorch_tpu.utils.messaging import ReliableTransport
+
+    world = InProcessTransport.create_world(2)
+    server = ReliableTransport(world[0], ack_timeout=0.02, max_backoff=0.05,
+                               max_retries=2)
+    # first life of rank 1: delivers seq 0
+    life1 = ReliableTransport(world[1], ack_timeout=0.05)
+    life1.send(MessageCode.GradientUpdate, np.full(2, 1.0, np.float32))
+    msg = server.recv(timeout=5)
+    assert msg is not None and int(msg[2][0]) == 1
+
+    # rank 1 "crashes"; the server's sends to it go unacked until it is
+    # declared dead
+    life1._closed = True  # stop life1's retry/ack machinery
+    server.send(MessageCode.ParameterUpdate, np.ones(1, np.float32), dst=1)
+    deadline = time.monotonic() + 5
+    while not server.stats["gave_up"] and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert server.stats["gave_up"] == 1
+
+    # second life on the same rank: its seq restarts at 0, but the newer
+    # incarnation resets dedup — the frame must be DELIVERED, and hearing
+    # from the rank revives it for sending
+    life2 = ReliableTransport(world[1], ack_timeout=0.05)
+    assert life2.incarnation > life1.incarnation
+    life2.send(MessageCode.GradientUpdate, np.full(2, 2.0, np.float32))
+    msg = server.recv(timeout=5)
+    assert msg is not None and int(msg[2][0]) == 2, (
+        "restarted peer's seq 0 was blackholed as a duplicate")
+    server.send(MessageCode.ParameterUpdate, np.ones(1, np.float32), dst=1)
+    msg = life2.recv(timeout=5)
+    assert msg is not None and msg[1] == MessageCode.ParameterUpdate
+    server.close()
+    life2.close()
+
+
+def test_reliable_stale_incarnation_ack_does_not_clear_pending():
+    """An ack echoing a PREVIOUS life's incarnation (a straggler for the
+    old process's frame with the same seq) must not clear the new life's
+    pending entry — that frame still needs its retransmit."""
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        ReliableTransport,
+        _split16,
+    )
+
+    world = InProcessTransport.create_world(2)
+    b = ReliableTransport(world[1], ack_timeout=0.05)
+    try:
+        b.send(MessageCode.GradientUpdate, np.ones(2, np.float32), dst=0)
+        world[0].send(
+            MessageCode.ReliableAck,
+            np.asarray([*_split16(0), *_split16(b.incarnation - 1)],
+                       np.float32), dst=1)
+        assert not b.flush(timeout=0.4)  # stale ack ignored: still pending
+        assert b.stats["acked"] == 0
+        world[0].send(
+            MessageCode.ReliableAck,
+            np.asarray([*_split16(0), *_split16(b.incarnation)],
+                       np.float32), dst=1)
+        assert b.flush(timeout=2)
+        assert b.stats["acked"] == 1
+    finally:
+        b.close()
+        for t in world.values():
+            t.close()
