@@ -69,6 +69,12 @@ bench-all:
 chaos:
 	$(PY) -m pytest tests/ -q -m chaos
 
+# elastic control-plane suite (coord/): membership + leases, coordinator-
+# driven shard rebalancing (the join/crash acceptance scenario), straggler
+# speculation with first-result-wins dedup, serving fleet hook
+coord:
+	$(PY) -m pytest tests/ -q -m coord
+
 # fast core signal: everything that runs in-process (no subprocess worlds,
 # no end-to-end example trainings) — a couple of minutes on one core
 test:
@@ -97,4 +103,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo bench bench-serving bench-all chaos test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo bench bench-serving bench-all chaos coord test test-all verify-real-data graph install dist

@@ -968,6 +968,66 @@ def sharded_ps_phase() -> None:
              "1/k substance")
 
 
+def elastic_phase() -> None:
+    """Config 3, elastic-control-plane leg (ISSUE 3): steady-state worker
+    throughput BEFORE / DURING / AFTER a coordinator-driven shard
+    rebalance. One in-process fleet (coordinator + 2 elastic shard servers
+    + 2 DownPour workers on LeNet); shard server 2 is silently crashed
+    mid-run, the coordinator detects it by lease expiry and pushes a new
+    map, workers drain + cut over + install the moved range. Windows are
+    split on worker 1's step timeline: [warmup, crash), [crash, cutover),
+    [cutover, end) — the DURING window prices what a rebalance costs the
+    data plane (stale-map drops + the cutover drain), and AFTER shows
+    throughput recovered with the fleet one server smaller."""
+    import time as _time
+
+    from distributed_ml_pytorch_tpu.coord.demo import elastic_scenario
+
+    batch = 16
+    crash_at = 24
+    times: dict = {}
+    cut: dict = {}
+
+    def hook(j, step, opt):
+        if j == 1:
+            times[step] = _time.perf_counter()
+            if opt.map_version >= 3 and "step" not in cut:
+                cut["step"] = step  # first step on the post-crash map
+
+    out = elastic_scenario(
+        steps=72, n_workers=2, n_shards=2, crash_shard_at=crash_at,
+        lease=0.4, step_hook=hook)
+    if not out["ok"] or "step" not in cut:
+        log(f"elastic_phase incomplete: ok={out['ok']} cutover={cut} "
+            f"events={out['events'][-5:]}")
+        return
+
+    def rate(a, b):
+        ts = [times[s] for s in range(a, b) if s in times]
+        if len(ts) < 3:
+            return None
+        return batch * (len(ts) - 1) / (ts[-1] - ts[0])
+
+    before = rate(4, crash_at)  # skip warmup/compile steps
+    during = rate(crash_at, cut["step"] + 1)
+    after = rate(cut["step"] + 1, 72)
+    for name, value, win in (
+        ("before", before, f"steps 4-{crash_at}"),
+        ("during", during, f"steps {crash_at}-{cut['step']} (crash -> "
+                           "lease expiry -> map adopted)"),
+        ("after", after, f"steps {cut['step'] + 1}-72, 1 shard left"),
+    ):
+        if value is None:
+            log(f"elastic_phase: window {name} too short to rate")
+            continue
+        emit(3, f"elastic_rebalance_throughput_{name}", value,
+             "images/sec/worker", "in-process fleet, 1 core",
+             f"worker-1 steady state {win}; coordinator lease 0.4s; "
+             "LeNet batch 16, cadence 2/2 (coord/demo.elastic_scenario)")
+    log(f"elastic_phase: map v{out['map_version']}, cutover at worker step "
+        f"{cut['step']}, server stats {out['stats']}")
+
+
 def _steady_rate_from_csv(path: str, batch: int):
     """Steady-state img/s from a trainer CSV's per-iteration timestamps:
     MEAN inter-step gap over the second half of the run (warmup/compile
@@ -1417,6 +1477,7 @@ def main() -> None:
     tpu_phase()
     ps_phase()
     sharded_ps_phase()
+    elastic_phase()
     ps_tpu_phase()
     transport_phase()
     reliability_phase()

@@ -21,7 +21,15 @@ Public API re-exports the contractual symbols recovered in SURVEY.md §2.3.
 
 import jax as _jax
 
-if not hasattr(_jax, "shard_map"):
+#: True when this runtime predates the graduated jax.shard_map (and its
+#: varying-manual-axes type system). Code whose GRADIENTS depend on
+#: transpose-time psum insertion for replicated operands (parallel/pipeline)
+#: consults this to pin check_rep=False and insert those psums explicitly —
+#: the old checker's false positives otherwise make strict-vs-loose (and so
+#: the gradient math) depend on which body happens to trace.
+LEGACY_SHARD_MAP = not hasattr(_jax, "shard_map")
+
+if LEGACY_SHARD_MAP:
     # jax-version compatibility: shard_map graduated out of jax.experimental
     # after this runtime's jax; the framework is written against the new
     # spelling, so install it where older runtimes lack it (keyword surface

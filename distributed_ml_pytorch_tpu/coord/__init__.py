@@ -1,0 +1,36 @@
+"""coord/ — the elastic control plane (ISSUE 3 tentpole).
+
+DistBelief runs on a fleet whose membership and speed vary: DownPour
+"tolerates variance in the processing speed of different model replicas, and
+even the wholesale failure of model replicas", and Sandblaster adds a
+coordinator that load-balances work and schedules backup replicas for
+stragglers (PAPER.md). The chaos layer (ISSUE 2) made individual failures
+survivable; this package makes the FLEET itself dynamic — every launch-time
+decision (ranks, shard map, fleet size) becomes a runtime-negotiated one:
+
+- :mod:`~.coordinator` — lease-based membership over the existing messaging
+  transports (codes 13-18), elastic shard-map recomputation, Sandblaster-
+  style straggler speculation, and a fleet-state export for the serving
+  plane.
+- :mod:`~.member` — :class:`CoordClient`, the member-side face: join/leave,
+  background lease renewal carrying progress reports, shard-map / fleet /
+  speculation delivery.
+- :mod:`~.shardmap` — the versioned :class:`ShardMap` and its float32 wire
+  encoding.
+- :mod:`~.elastic` — :class:`ElasticShardServer` (a ParameterServer whose
+  range is coordinator-assigned and resizable mid-run) and the elastic
+  worker loop used by the acceptance tests and ``coord/cli.py``.
+"""
+
+from distributed_ml_pytorch_tpu.coord.shardmap import ShardEntry, ShardMap
+from distributed_ml_pytorch_tpu.coord.coordinator import Coordinator, MemberInfo
+from distributed_ml_pytorch_tpu.coord.member import CoordClient, FleetView
+
+__all__ = [
+    "ShardEntry",
+    "ShardMap",
+    "Coordinator",
+    "MemberInfo",
+    "CoordClient",
+    "FleetView",
+]

@@ -1,0 +1,124 @@
+"""``coord`` — the coordinator's CLI (counterpart of ``training/cli.py`` and
+``serving/cli.py``).
+
+Run a coordinator process for an elastic PS fleet over TCP::
+
+    # the control-plane hub: members dial in whenever they start
+    python -m distributed_ml_pytorch_tpu.coord.cli --port 29700 --model alexnet
+
+    # training ranks attach with --coord (training/cli.py):
+    python -m distributed_ml_pytorch_tpu.training.cli --mode ps --rank 0 \
+        --n-servers 2 --coord localhost:29700 ...
+
+    # self-contained elastic demo: in-process coordinator + 2 shard servers
+    # + 2 workers; a 3rd worker joins mid-run, a shard server is crashed,
+    # the map rebalances, training completes — the acceptance scenario as a
+    # one-command script
+    python -m distributed_ml_pytorch_tpu.coord.cli --demo
+
+The coordinator's TCP hub is ELASTIC: it binds and serves immediately
+(``TCPTransport(wait_for=0)``) instead of blocking on a fixed rendezvous —
+members are whoever dials in, which is the whole point of the subsystem.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="Elastic control plane: membership, leases, shard "
+                    "rebalancing, straggler speculation")
+    p.add_argument("--port", type=str, default="29700",
+                   help="TCP port the coordination hub binds")
+    p.add_argument("--master", type=str, default="localhost")
+    p.add_argument("--max-members", type=int, default=64,
+                   help="upper bound on member ranks (sizes the hub's rank "
+                        "space; members may come and go freely below it)")
+    p.add_argument("--model", type=str, default="alexnet",
+                   choices=["alexnet", "lenet", "resnet18", "resnet50"],
+                   help="model whose raveled size defines the shard-map "
+                        "parameter space (must match the training ranks)")
+    p.add_argument("--n-params", type=int, default=0,
+                   help="override the parameter-space size directly "
+                        "(0 = derive from --model)")
+    p.add_argument("--lease", type=float, default=3.0,
+                   help="seconds of silence before a member is removed; "
+                        "members renew at lease/6 by default")
+    p.add_argument("--straggler-factor", type=float, default=3.0,
+                   help="speculate a worker whose step-latency EWMA exceeds "
+                        "this multiple of the fleet median")
+    p.add_argument("--no-speculation", action="store_true",
+                   help="disable Sandblaster-style backup tasks")
+    p.add_argument("--timeout", type=float, default=0.0,
+                   help="exit after this many seconds (0 = serve forever)")
+    p.add_argument("--demo", action="store_true",
+                   help="run the in-process elastic demo (join + shard "
+                        "crash + rebalance) and exit")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def _n_params(args) -> int:
+    if args.n_params:
+        return int(args.n_params)
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ml_pytorch_tpu.models import get_model
+    from distributed_ml_pytorch_tpu.utils.serialization import (
+        ravel_model_params,
+    )
+
+    model = get_model(args.model)
+    params = model.init(
+        jax.random.key(args.seed), jnp.zeros((1, 32, 32, 3)))["params"]
+    return int(ravel_model_params(params).shape[0])
+
+
+def run_demo(args) -> int:
+    """The acceptance scenario as a one-command in-process script: 2 shard
+    servers + 2 workers; a 3rd worker joins mid-run; shard server 1 is
+    crashed; the coordinator rebalances and training completes."""
+    from distributed_ml_pytorch_tpu.coord.demo import elastic_demo
+
+    summary = elastic_demo(seed=args.seed)
+    print("elastic demo:", summary)
+    return 0 if summary.get("ok") else 1
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    print(args)
+    if args.demo:
+        return run_demo(args)
+
+    from distributed_ml_pytorch_tpu.coord.coordinator import Coordinator
+    from distributed_ml_pytorch_tpu.utils.messaging import TCPTransport
+
+    n_params = _n_params(args)
+    transport = TCPTransport(
+        rank=0, world_size=int(args.max_members), master=args.master,
+        port=int(args.port), wait_for=0)
+    coord = Coordinator(
+        transport, n_params, lease=args.lease,
+        straggler_factor=args.straggler_factor,
+        speculation=not args.no_speculation)
+    print(f"coordinator on {args.master}:{args.port} "
+          f"({n_params} params, lease {args.lease:.1f}s)")
+    try:
+        coord.run(timeout=args.timeout or None)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        transport.close()
+        for line in coord.events[-20:]:
+            print("event:", line)
+        print("fleet at exit:", coord.fleet_state())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,369 @@
+"""The coordinator — lease-based membership, elastic shard rebalancing,
+straggler speculation, and the fleet-state export (ISSUE 3 tentpole).
+
+DistBelief's Sandblaster batch framework puts a coordinator above the
+parameter-server fleet: it owns no parameters, just the *assignment* of work
+and data to machines, load-balancing and scheduling "backup replicas" of
+straggling tasks (PAPER.md). This module is that role for this framework's
+PS and serving planes, over the same tagged-float32 transports everything
+else uses (``MessageCode`` 13-18):
+
+- **Membership**: members join with a kind (worker / shard server / serving
+  engine) and their :class:`~.messaging.ReliableTransport`-style incarnation
+  stamp; liveness is a *lease* renewed by ``LeaseRenew`` frames (any frame
+  from a member refreshes it). A member silent past its lease is removed —
+  the same timeout discipline as ``utils/failure.FailureDetector``, plus
+  explicit ``CoordJoin``/``CoordLeave`` so fleets grow and shrink mid-run.
+  Incarnations order lives of a rank: a stale life's ``CoordLeave`` or
+  ``LeaseRenew`` (e.g. a WorkerDone flush racing that rank's replacement)
+  cannot evict or refresh the newer life.
+- **Shard rebalancing**: when a shard server joins or dies, the coordinator
+  computes the next :class:`~.shardmap.ShardMap` version and pushes it to
+  every member; ``ShardedAsynchronous`` clients drain in-flight pushes and
+  cut over at a step boundary, installing values for moved ranges
+  (``coord/shardmap.py`` documents the handover).
+- **Straggler speculation**: workers report progress (push count, step,
+  step-latency EWMA) inside their lease renewals. A worker whose EWMA
+  exceeds ``straggler_factor`` x the fleet median gets its remaining work
+  replicated: the fastest live worker receives a ``SpeculateTask`` and
+  races the straggler; results dedup first-wins at the PS via
+  ``SpeculativeUpdate`` task ids (``coord/elastic.py``), so the epoch stops
+  being gated by its slowest machine — Sandblaster's backup-task trick.
+- **Fleet state**: a compact ``FleetState`` broadcast (worker/shard/engine
+  counts + done flag) that ``serving/frontend.py`` consumes to reject-or-
+  queue on engine loss and re-admit on recovery (:class:`~.member.FleetView`).
+
+Determinism note: the coordinator's DECISIONS are pure functions of the
+message/clock history (``handle``/``tick`` with an injectable clock; no
+hidden threads), so tests drive it synchronously; the production ``run``
+loop just feeds it a transport and wall time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from distributed_ml_pytorch_tpu.coord.shardmap import ShardMap, rebalance
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    MessageCode,
+    Transport,
+    _join16,
+    _split16,
+)
+
+_LOGGER = logging.getLogger(__name__)
+
+#: member kinds on the wire (CoordJoin payload[0])
+KIND_WORKER = 0
+KIND_SHARD = 1
+KIND_ENGINE = 2
+_KIND_NAMES = {KIND_WORKER: "worker", KIND_SHARD: "shard", KIND_ENGINE: "engine"}
+
+
+def encode_join(kind: int, incarnation: int) -> np.ndarray:
+    return np.asarray([float(kind), *_split16(incarnation)], np.float32)
+
+
+def encode_leave(incarnation: int) -> np.ndarray:
+    return np.asarray([*_split16(incarnation)], np.float32)
+
+
+def encode_renew(incarnation: int, push_count: int = 0, step: int = 0,
+                 ewma_ms: float = 0.0) -> np.ndarray:
+    return np.asarray(
+        [*_split16(incarnation), float(push_count), float(step),
+         float(ewma_ms)], np.float32)
+
+
+def encode_fleet(version: int, n_workers: int, n_shards: int, n_engines: int,
+                 workers_done: bool) -> np.ndarray:
+    return np.asarray(
+        [*_split16(version), float(n_workers), float(n_shards),
+         float(n_engines), 1.0 if workers_done else 0.0], np.float32)
+
+
+def decode_fleet(payload: np.ndarray) -> dict:
+    if payload.size < 6 or not np.isfinite(payload[:6]).all():
+        raise ValueError(f"malformed FleetState frame (size {payload.size})")
+    return {
+        "version": _join16(payload[0], payload[1]),
+        "n_workers": int(payload[2]),
+        "n_shards": int(payload[3]),
+        "n_engines": int(payload[4]),
+        "workers_done": bool(payload[5]),
+    }
+
+
+@dataclasses.dataclass
+class MemberInfo:
+    """One live member: identity, lease, and its latest progress report."""
+
+    rank: int
+    kind: int
+    incarnation: int
+    last_seen: float
+    push_count: int = 0
+    step: int = 0
+    ewma_ms: float = 0.0
+
+    @property
+    def kind_name(self) -> str:
+        return _KIND_NAMES.get(self.kind, str(self.kind))
+
+
+class Coordinator:
+    """Rank-0 hub of the coordination star (see module docstring)."""
+
+    def __init__(
+        self,
+        transport: Optional[Transport],
+        n_params: int,
+        *,
+        lease: float = 2.0,
+        straggler_factor: float = 3.0,
+        straggler_after_steps: int = 4,
+        speculation: bool = True,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.transport = transport
+        self.lease = float(lease)
+        self.straggler_factor = float(straggler_factor)
+        self.straggler_after_steps = int(straggler_after_steps)
+        self.speculation = bool(speculation)
+        self._clock = clock
+        self.members: Dict[int, MemberInfo] = {}
+        self.shard_map = ShardMap(0, int(n_params), ())
+        self.done_workers: set = set()
+        self.speculated: Dict[int, int] = {}  # victim rank -> task id
+        self._next_task = 1
+        self._stop = threading.Event()
+        self.events: List[str] = []  # human-readable decision log (tests/CLI)
+
+    # ------------------------------------------------------------ bookkeeping
+    def _log(self, msg: str) -> None:
+        self.events.append(msg)
+        _LOGGER.info("coordinator: %s", msg)
+
+    def _live(self, kind: Optional[int] = None) -> List[MemberInfo]:
+        out = [m for m in self.members.values()
+               if kind is None or m.kind == kind]
+        return sorted(out, key=lambda m: m.rank)
+
+    def fleet_state(self) -> dict:
+        workers = self._live(KIND_WORKER)
+        return {
+            "version": self.shard_map.version,
+            "n_workers": len(workers),
+            "n_shards": len(self._live(KIND_SHARD)),
+            "n_engines": len(self._live(KIND_ENGINE)),
+            # done requires at least one CLEAN leave, not just an empty
+            # set: every worker lease-expiring at once (a transient stall)
+            # must read as an outage, or the shard servers would all exit
+            # under a fleet that is still training
+            "workers_done": bool(self.done_workers) and not workers,
+            "members": {
+                m.rank: {"kind": m.kind_name, "incarnation": m.incarnation,
+                         "step": m.step, "push_count": m.push_count,
+                         "ewma_ms": m.ewma_ms}
+                for m in self._live()
+            },
+        }
+
+    def engine_up(self) -> bool:
+        return bool(self._live(KIND_ENGINE))
+
+    # --------------------------------------------------------------- sends
+    def _send(self, rank: int, code: MessageCode, payload: np.ndarray) -> None:
+        """One guarded send: a dead member must never take the hub down."""
+        if self.transport is None:
+            return
+        try:
+            self.transport.send(code, payload, dst=rank)
+        except (OSError, ConnectionError, KeyError):
+            pass  # its lease will expire; the tick path owns the cleanup
+
+    def _broadcast(self, code: MessageCode, payload: np.ndarray) -> None:
+        for m in self._live():
+            self._send(m.rank, code, payload)
+
+    def _announce(self) -> None:
+        """Push the current map + fleet state to everyone."""
+        self._broadcast(MessageCode.ShardMapUpdate, self.shard_map.encode())
+        fs = self.fleet_state()
+        self._broadcast(MessageCode.FleetState, encode_fleet(
+            fs["version"], fs["n_workers"], fs["n_shards"], fs["n_engines"],
+            fs["workers_done"]))
+
+    # -------------------------------------------------------------- handle
+    def handle(self, sender: int, code: MessageCode,
+               payload: np.ndarray) -> None:
+        """Process one member frame (the run loop's dispatch; synchronous
+        and side-effect-complete, so tests call it directly)."""
+        now = self._clock()
+        member = self.members.get(sender)
+        if code == MessageCode.CoordJoin and payload.size >= 3:
+            if not np.isfinite(payload[:3]).all():
+                return
+            kind = int(payload[0])
+            inc = _join16(payload[1], payload[2])
+            if member is not None and inc < member.incarnation:
+                # a delayed join from a PREVIOUS life of this rank must not
+                # demote the membership the newer life established
+                self._log(f"ignored stale join of rank {sender} "
+                          f"(inc {inc} < {member.incarnation})")
+                return
+            is_new = member is None or member.incarnation != inc
+            rebirth = member is not None and inc > member.incarnation
+            self.members[sender] = MemberInfo(sender, kind, inc, now)
+            if kind == KIND_WORKER:
+                self.done_workers.discard(sender)
+            if is_new:
+                self._log(f"{_KIND_NAMES.get(kind, kind)} {sender} "
+                          f"{'rejoined' if rebirth else 'joined'} (inc {inc})")
+                if kind == KIND_SHARD:
+                    self._rebalance("join of shard server %d" % sender)
+                else:
+                    self._announce()
+            else:
+                # idempotent re-join (the client retries until answered):
+                # answer the joiner alone, no fleet-wide rebroadcast
+                self._send(sender, MessageCode.ShardMapUpdate,
+                           self.shard_map.encode())
+                fs = self.fleet_state()
+                self._send(sender, MessageCode.FleetState, encode_fleet(
+                    fs["version"], fs["n_workers"], fs["n_shards"],
+                    fs["n_engines"], fs["workers_done"]))
+            return
+        if member is None:
+            return  # pre-join (or post-expiry) chatter: the join retry fixes it
+        if code == MessageCode.CoordLeave and payload.size >= 2:
+            inc = _join16(payload[0], payload[1])
+            if inc != member.incarnation:
+                # THE WorkerDone-vs-concurrent-join race: the old life's
+                # parting leave must not evict the rank's new life
+                self._log(f"ignored stale leave of rank {sender} "
+                          f"(inc {inc} != {member.incarnation})")
+                return
+            del self.members[sender]
+            if member.kind == KIND_WORKER:
+                self.done_workers.add(sender)
+            self.speculated.pop(sender, None)
+            self._log(f"{member.kind_name} {sender} left")
+            if member.kind == KIND_SHARD:
+                self._rebalance("leave of shard server %d" % sender)
+            else:
+                self._announce()
+            return
+        if code == MessageCode.LeaseRenew and payload.size >= 5:
+            if not np.isfinite(payload[:5]).all():
+                return
+            inc = _join16(payload[0], payload[1])
+            if inc < member.incarnation:
+                return  # stale life's heartbeat
+            member.incarnation = max(member.incarnation, inc)
+            member.last_seen = now
+            member.push_count = int(payload[2])
+            member.step = int(payload[3])
+            member.ewma_ms = float(payload[4])
+            return
+        # any other frame from a known member is evidence of life
+        member.last_seen = now
+
+    # ---------------------------------------------------------------- tick
+    def tick(self) -> bool:
+        """Expire leases, rebalance, and (maybe) speculate; returns True if
+        membership changed. Call at ~lease/4 cadence (the run loop does)."""
+        now = self._clock()
+        expired = [m for m in self.members.values()
+                   if now - m.last_seen > self.lease]
+        shard_died = False
+        for m in expired:
+            del self.members[m.rank]
+            self.speculated.pop(m.rank, None)
+            self._log(f"{m.kind_name} {m.rank} lease expired "
+                      f"({now - m.last_seen:.1f}s silent)")
+            shard_died |= m.kind == KIND_SHARD
+        if shard_died:
+            self._rebalance("lease expiry")
+        elif expired:
+            self._announce()
+        if self.speculation:
+            self.check_stragglers()
+        return bool(expired)
+
+    def _rebalance(self, why: str) -> None:
+        live = [m.rank for m in self._live(KIND_SHARD)]
+        self.shard_map = rebalance(self.shard_map, live)
+        self._log(
+            f"shard map v{self.shard_map.version} on {why}: "
+            + (", ".join(f"s{e.server_id}=[{e.lo},{e.hi})"
+                         for e in self.shard_map.entries) or "EMPTY"))
+        self._announce()
+
+    # ---------------------------------------------------------- speculation
+    def check_stragglers(self) -> Optional[int]:
+        """Sandblaster backup tasks: when the slowest reporting worker's
+        step-latency EWMA exceeds ``straggler_factor`` x the fleet median,
+        replicate its remaining work to the fastest worker. Returns the
+        task id when a speculation fired."""
+        workers = [m for m in self._live(KIND_WORKER)
+                   if m.ewma_ms > 0 and m.step >= self.straggler_after_steps
+                   and m.rank not in self.speculated]
+        if len(workers) < 2:
+            return None
+        by_speed = sorted(workers, key=lambda m: m.ewma_ms)
+        victim = by_speed[-1]
+        # lower median: at 2 workers this compares the slow one to the
+        # OTHER worker (len//2 would pick the victim itself and the
+        # detector could never fire on the smallest fleet)
+        median = by_speed[(len(by_speed) - 1) // 2].ewma_ms
+        if median <= 0 or victim.ewma_ms < self.straggler_factor * median:
+            return None
+        backup = by_speed[0]
+        task_id = self._next_task
+        self._next_task += 1
+        self.speculated[victim.rank] = task_id
+        self._log(
+            f"straggler: worker {victim.rank} at {victim.ewma_ms:.1f} ms/step "
+            f"(median {median:.1f}) — speculating its tail on worker "
+            f"{backup.rank} as task {task_id}")
+        frame = np.asarray(
+            [float(task_id), float(victim.rank), float(victim.step)],
+            np.float32)
+        # BOTH parties get the task: the backup so it races the tail, the
+        # victim so it tags its own late result with the same id — the PS
+        # dedup (first task result wins) is what makes the duplication safe
+        self._send(backup.rank, MessageCode.SpeculateTask, frame)
+        self._send(victim.rank, MessageCode.SpeculateTask, frame)
+        return task_id
+
+    # ----------------------------------------------------------------- run
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self, timeout: Optional[float] = None) -> None:
+        """Serve until ``stop()`` (or ``timeout``): pump frames + tick."""
+        if self.transport is None:
+            raise ValueError("Coordinator.run needs a transport")
+        deadline = None if timeout is None else self._clock() + timeout
+        next_tick = self._clock()
+        while not self._stop.is_set():
+            now = self._clock()
+            if deadline is not None and now >= deadline:
+                break
+            if now >= next_tick:
+                self.tick()
+                next_tick = now + max(0.05, self.lease / 4)
+            msg = self.transport.recv(timeout=0.1)
+            if msg is None:
+                continue
+            try:
+                self.handle(*msg)
+            except (ValueError, IndexError, OverflowError):
+                continue  # malformed member frame: drop, never die

@@ -1,0 +1,238 @@
+"""Elastic PS-plane members: the resizable shard server and the worker-side
+speculation helpers (ISSUE 3 tentpole).
+
+:class:`ElasticShardServer` wraps a plain
+:class:`~distributed_ml_pytorch_tpu.parallel.async_ps.ParameterServer` so
+the range it owns is COORDINATOR-ASSIGNED instead of launch-time fixed:
+
+- it joins the coordination star as a ``shard`` member (which itself
+  triggers the rebalance that assigns it a range) and renews its lease with
+  its push count;
+- on a newer shard map it resizes: the overlap of old and new range keeps
+  its authoritative server-side values, and the freshly-acquired subrange
+  waits for a worker's ``RangeInstall`` (first install wins; pulls are
+  parked until the range is whole, so a worker can never adopt
+  uninitialized zeros as central params);
+- stale-map traffic — a push or install sized for another map version — is
+  dropped and counted, never applied (the worker's next cadence under the
+  agreed map is correct);
+- ``SpeculativeUpdate`` frames (Sandblaster backup-task results) apply
+  exactly once per task id: the victim's late result and the backup's fast
+  one race, first wins, the duplicate is counted and dropped — this is what
+  makes replicating a straggler's work SAFE under DownPour (the duplicate
+  would otherwise double-apply a whole tail of lr-scaled deltas).
+
+The worker half of speculation lives in
+:meth:`~distributed_ml_pytorch_tpu.parallel.sharded_ps.ShardedAsynchronous.push_speculative`
+plus the harness in ``coord/cli.py`` / ``tests/test_coord.py``: the
+coordinator names a (task id, victim, from_step); BOTH the victim and the
+backup compute the victim's remaining batches and push the resulting
+accumulated update under that task id.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from distributed_ml_pytorch_tpu.coord.member import CoordClient
+from distributed_ml_pytorch_tpu.coord.shardmap import ShardMap
+from distributed_ml_pytorch_tpu.parallel.async_ps import ParameterServer
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    MessageCode,
+    Transport,
+    _join16,
+)
+
+
+class ElasticShardServer:
+    """A ParameterServer whose range follows the coordinator's shard map."""
+
+    def __init__(
+        self,
+        server_id: int,
+        n_params: int,
+        transport: Transport,
+        coord: CoordClient,
+        *,
+        init_params: Optional[np.ndarray] = None,
+        staleness_damping: float = 0.0,
+        ckpt_dir: Optional[str] = None,
+        ckpt_every: int = 500,
+    ):
+        self.server_id = int(server_id)
+        self.n_params = int(n_params)
+        self.transport = transport
+        self.coord = coord
+        self._init_flat = (
+            np.asarray(init_params, np.float32)
+            if init_params is not None else None)
+        if self._init_flat is not None and self._init_flat.shape[0] != n_params:
+            raise ValueError(
+                f"init_params has {self._init_flat.shape[0]} params, "
+                f"expected {n_params}")
+        self.lo = self.hi = 0
+        self.map_version = -1
+        #: absolute [lo, hi) subrange awaiting a worker RangeInstall; pulls
+        #: are parked while it is non-empty
+        self.pending_install: Optional[tuple] = None
+        self.ps = ParameterServer(
+            params=np.zeros(1, np.float32), transport=transport,
+            ckpt_dir=ckpt_dir, ckpt_every=ckpt_every,
+            staleness_damping=staleness_damping)
+        self._seen_tasks: set = set()
+        self.stats = {
+            "stale_dropped": 0, "parked_pulls": 0, "installs": 0,
+            "dup_installs": 0, "spec_applied": 0, "spec_dropped": 0,
+            "resizes": 0,
+        }
+        self._stop = threading.Event()
+        self._crashed = False
+
+    def crash(self) -> None:
+        """Chaos-script hook: die SILENTLY — the serve loop exits, lease
+        renewals stop, and NO CoordLeave is sent, so the coordinator must
+        *detect* the death by lease expiry (the path the acceptance
+        scenario exercises). A clean shutdown is ``stop()``."""
+        self._crashed = True
+        self.coord.stop()
+        self._stop.set()
+
+    # ------------------------------------------------------------------ map
+    def _apply_map(self, m: ShardMap) -> None:
+        if m.version <= self.map_version:
+            return
+        self.map_version = m.version
+        e = m.entry_for(self.server_id)
+        if e is None:
+            # dropped from the map while alive (e.g. coordinator restarted
+            # without us): keep serving the old range; our join retry or
+            # lease renewal re-adds us
+            print(f"shard {self.server_id}: not in map v{m.version} — "
+                  "keeping current range", file=sys.stderr)
+            return
+        if (e.lo, e.hi) == (self.lo, self.hi):
+            return
+        new_central = np.zeros(e.size, np.float32)
+        if self._init_flat is not None:
+            # a known init seeds the whole range; worker installs refine it
+            new_central[:] = self._init_flat[e.lo:e.hi]
+        o_lo, o_hi = max(self.lo, e.lo), min(self.hi, e.hi)
+        if o_lo < o_hi and self.hi > self.lo:
+            new_central[o_lo - e.lo:o_hi - e.lo] = (
+                self.ps.central[o_lo - self.lo:o_hi - self.lo])
+        fresh = (e.fresh_lo, e.fresh_hi) if e.needs_install else None
+        if fresh is not None and self._init_flat is not None and self.lo == self.hi:
+            # first assignment of a seeded server: the init IS the value set
+            # the construction-install flow will refine — no need to park
+            fresh = None
+        self.pending_install = fresh
+        print(
+            f"shard {self.server_id}: map v{m.version} resize "
+            f"[{self.lo},{self.hi}) -> [{e.lo},{e.hi})"
+            + (f", awaiting install of [{fresh[0]},{fresh[1]})"
+               if fresh else ""),
+            file=sys.stderr,
+        )
+        self.lo, self.hi = e.lo, e.hi
+        self.ps.central = new_central
+        self.stats["resizes"] += 1
+
+    # --------------------------------------------------------------- handle
+    def handle(self, sender: int, code: MessageCode,
+               payload: np.ndarray) -> None:
+        size = self.hi - self.lo
+        if code == MessageCode.GradientUpdate:
+            if payload.shape[0] != size:
+                self.stats["stale_dropped"] += 1
+                return
+            self.ps.handle(sender, code, payload)
+            self.coord.report(self.ps._push_count, 0, 0.0)
+        elif code == MessageCode.ParameterRequest:
+            if self.pending_install is not None:
+                # parking, not answering: a reply now would hand the worker
+                # zeros for the uninstalled subrange; its next cadence pull
+                # after the install answers correctly
+                self.stats["parked_pulls"] += 1
+                return
+            self.ps.handle(sender, code, payload)
+        elif code == MessageCode.ParameterUpdate:
+            if payload.shape[0] != size:
+                self.stats["stale_dropped"] += 1
+                return
+            self.ps.handle(sender, code, payload)
+            if self.pending_install is not None:
+                # a full-range construction install covers any pending
+                # subrange by definition
+                self.pending_install = None
+                self.stats["installs"] += 1
+        elif code == MessageCode.RangeInstall and payload.size >= 4:
+            lo = _join16(payload[0], payload[1])
+            hi = _join16(payload[2], payload[3])
+            values = payload[4:]
+            if values.shape[0] != hi - lo:
+                self.stats["stale_dropped"] += 1
+                return
+            if self.pending_install is None or (lo, hi) != self.pending_install:
+                self.stats["dup_installs"] += 1  # first install won already
+                return
+            self.ps.central[lo - self.lo:hi - self.lo] = values
+            self.pending_install = None
+            self.stats["installs"] += 1
+            print(f"shard {self.server_id}: range [{lo},{hi}) installed by "
+                  f"worker {sender}", file=sys.stderr)
+        elif code == MessageCode.SpeculativeUpdate and payload.size >= 2:
+            task_id = _join16(payload[0], payload[1])
+            values = payload[2:]
+            if values.shape[0] != size:
+                self.stats["stale_dropped"] += 1
+                return
+            if task_id in self._seen_tasks:
+                # the race's loser (victim's late tail, or a wire dup): the
+                # dedup that makes Sandblaster-style duplication safe
+                self.stats["spec_dropped"] += 1
+                return
+            self._seen_tasks.add(task_id)
+            self.ps.handle(sender, MessageCode.GradientUpdate, values)
+            self.stats["spec_applied"] += 1
+
+    # ------------------------------------------------------------------ run
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self, timeout: Optional[float] = None) -> None:
+        """Join, then serve until ``stop()``, fleet-done, or ``timeout``."""
+        m = self.coord.join()
+        if m is not None:
+            self._apply_map(m)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._stop.is_set():
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            m = self.coord.take_shard_map()
+            if m is not None:
+                self._apply_map(m)
+            if self.coord.fleet.workers_done():
+                break
+            msg = self.transport.recv(timeout=0.1)
+            if msg is None:
+                continue
+            sender, code, payload = msg
+            if code in (MessageCode.Heartbeat, MessageCode.WorkerDone):
+                continue  # worker lifecycle is the coordinator's job here
+            try:
+                self.handle(sender, code, payload)
+            except (ValueError, IndexError, OverflowError):
+                continue  # malformed frame: drop, never die
+        if self._crashed:
+            return  # scripted silent death: no checkpoint, no leave
+        self.ps.save_checkpoint()
+        self.coord.close()
+
+    @property
+    def central(self) -> np.ndarray:
+        return self.ps.central

@@ -1,0 +1,215 @@
+"""Member-side face of the control plane: :class:`CoordClient`.
+
+A member (training worker, PS shard server, or serving engine) owns one
+transport into the coordination star and a :class:`CoordClient` over it.
+The client:
+
+- **joins** with its kind and an incarnation stamp (the same second-stamped
+  monotonic counter the reliability layer uses, so a restarted member on the
+  same rank reads as a NEWER life), retrying the join frame until the
+  coordinator answers with a shard map — join is idempotent on the
+  coordinator, so chaos-dropped joins self-heal;
+- **renews its lease** from a background thread every ``renew_interval``
+  seconds, piggybacking the member's latest progress report (push count,
+  step, step-latency EWMA) — the coordinator's straggler detector runs on
+  exactly these numbers;
+- **receives** ``ShardMapUpdate`` / ``FleetState`` / ``SpeculateTask``
+  frames on a listener thread, depositing the newest map in a mailbox
+  (consumers cut over at their own step boundaries — the async-PS
+  between-steps-swap discipline) and invoking optional callbacks;
+- **leaves** explicitly on ``finish()``, carrying its incarnation so a
+  parting WorkerDone/leave racing a replacement's join on the same rank can
+  never evict the newer life (the coordinator compares stamps).
+
+:class:`FleetView` is the consumable snapshot of the latest fleet state —
+``serving/frontend.py`` polls ``engine_up`` to reject-or-queue on engine
+loss and re-admit on recovery.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from distributed_ml_pytorch_tpu.coord.coordinator import (
+    KIND_ENGINE,
+    KIND_SHARD,
+    KIND_WORKER,
+    decode_fleet,
+    encode_join,
+    encode_leave,
+    encode_renew,
+)
+from distributed_ml_pytorch_tpu.coord.shardmap import ShardMap
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    MessageCode,
+    Transport,
+    _next_incarnation,
+)
+
+_KINDS = {"worker": KIND_WORKER, "shard": KIND_SHARD, "engine": KIND_ENGINE}
+
+
+class FleetView:
+    """Thread-safe snapshot of the coordinator's latest fleet broadcast."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state: Optional[dict] = None
+
+    def update(self, state: dict) -> None:
+        with self._lock:
+            self._state = dict(state)
+
+    @property
+    def state(self) -> Optional[dict]:
+        with self._lock:
+            return None if self._state is None else dict(self._state)
+
+    def engine_up(self) -> bool:
+        """False only when a fleet report EXISTS and shows no live engine —
+        with no coordinator (or before the first report) the serving plane
+        must keep admitting, not fail closed."""
+        s = self.state
+        return s is None or s["n_engines"] > 0
+
+    def workers_done(self) -> bool:
+        s = self.state
+        return s is not None and s["workers_done"]
+
+
+class CoordClient:
+    """One member's connection to the coordinator (see module docstring)."""
+
+    def __init__(
+        self,
+        transport: Transport,
+        kind: str,
+        *,
+        renew_interval: float = 0.5,
+        incarnation: Optional[int] = None,
+        on_shard_map: Optional[Callable[[ShardMap], None]] = None,
+        on_speculate: Optional[Callable[[int, int, int], None]] = None,
+    ):
+        if kind not in _KINDS:
+            raise ValueError(f"kind must be one of {sorted(_KINDS)}, got {kind!r}")
+        self.transport = transport
+        self.kind = kind
+        self.renew_interval = float(renew_interval)
+        #: reuse the reliability layer's stamp discipline: strictly
+        #: increasing in-process, so a replacement client on the same rank
+        #: always reads as the newer life
+        self.incarnation = (
+            int(incarnation) if incarnation is not None else _next_incarnation())
+        self.fleet = FleetView()
+        self.coord_down = False
+        self._on_shard_map = on_shard_map
+        self._on_speculate = on_speculate
+        self._lock = threading.Lock()
+        self._latest_map: Optional[ShardMap] = None
+        self._current_version = -1
+        self._got_map = threading.Event()
+        self._progress = (0, 0, 0.0)  # (push_count, step, ewma_ms)
+        self._stop = threading.Event()
+        self._listener = threading.Thread(
+            target=self._pump, name="coord-listener", daemon=True)
+        self._listener.start()
+        self._renewer = threading.Thread(
+            target=self._renew_loop, name="coord-renew", daemon=True)
+        self._renewer.start()
+
+    # ----------------------------------------------------------------- wire
+    def _send(self, code: MessageCode, payload: np.ndarray) -> None:
+        try:
+            self.transport.send(code, payload)
+            self.coord_down = False
+        except (OSError, ConnectionError, KeyError):
+            # a dead coordinator must never take the member down: training
+            # continues on the last map it negotiated (static-fleet mode)
+            self.coord_down = True
+
+    def _pump(self) -> None:
+        while not self._stop.is_set():
+            msg = self.transport.recv(timeout=0.1)
+            if msg is None:
+                continue
+            _sender, code, payload = msg
+            try:
+                self._handle(code, payload)
+            except (ValueError, IndexError, OverflowError):
+                continue  # malformed frame: drop, never die
+
+    def _handle(self, code: MessageCode, payload: np.ndarray) -> None:
+        if code == MessageCode.ShardMapUpdate:
+            m = ShardMap.decode(payload)
+            with self._lock:
+                if m.version > self._current_version:
+                    self._current_version = m.version
+                    self._latest_map = m
+                else:
+                    return  # stale rebroadcast: never roll a consumer back
+            self._got_map.set()
+            if self._on_shard_map is not None:
+                self._on_shard_map(m)
+        elif code == MessageCode.FleetState:
+            self.fleet.update(decode_fleet(payload))
+        elif code == MessageCode.SpeculateTask and payload.size >= 3:
+            if self._on_speculate is not None and np.isfinite(payload[:3]).all():
+                self._on_speculate(
+                    int(payload[0]), int(payload[1]), int(payload[2]))
+
+    def _renew_loop(self) -> None:
+        tick = 0
+        while not self._stop.wait(self.renew_interval):
+            push_count, step, ewma_ms = self._progress
+            self._send(MessageCode.LeaseRenew, encode_renew(
+                self.incarnation, push_count, step, ewma_ms))
+            tick += 1
+            if tick % 4 == 0:
+                # periodic re-JOIN: the coordinator ignores frames from
+                # unknown ranks, so a member whose lease expired during a
+                # transient stall would otherwise renew into a void forever
+                # — the idempotent join re-admits it (and, for a shard,
+                # re-triggers the rebalance that restores its range)
+                self._send(MessageCode.CoordJoin, encode_join(
+                    _KINDS[self.kind], self.incarnation))
+
+    # ------------------------------------------------------------------ api
+    def join(self, timeout: float = 10.0) -> Optional[ShardMap]:
+        """Announce membership; block until the coordinator's map arrives
+        (retrying the join — it may be chaos-dropped). Returns the map, or
+        ``None`` on timeout (the caller decides whether that is fatal)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self._send(MessageCode.CoordJoin, encode_join(
+                _KINDS[self.kind], self.incarnation))
+            if self._got_map.wait(min(0.25, self.renew_interval)):
+                return self.current_map()
+        return self.current_map()
+
+    def report(self, push_count: int, step: int, ewma_ms: float) -> None:
+        """Stash this member's latest progress; the renew thread ships it."""
+        self._progress = (int(push_count), int(step), float(ewma_ms))
+
+    def current_map(self) -> Optional[ShardMap]:
+        with self._lock:
+            return self._latest_map
+
+    def take_shard_map(self) -> Optional[ShardMap]:
+        """The newest unconsumed map, once (None until a newer one lands)."""
+        with self._lock:
+            m, self._latest_map = self._latest_map, None
+            return m
+
+    def leave(self) -> None:
+        self._send(MessageCode.CoordLeave, encode_leave(self.incarnation))
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def close(self) -> None:
+        self.leave()
+        self.stop()

@@ -1,0 +1,160 @@
+"""Versioned shard maps — WHAT the coordinator renegotiates at runtime.
+
+A :class:`ShardMap` is the single source of truth for how the central flat
+parameter vector splits across the live shard servers. It is immutable and
+versioned: every membership change that affects shard servers produces a new
+map with ``version + 1``, and every consumer (workers' ``ShardedAsynchronous``
+clients, the shard servers themselves) cuts over atomically at a step
+boundary when it sees a newer version. Cross-version traffic in the cutover
+window is detected by SLICE LENGTH (the wire carries no version field — the
+DownPour frames are unchanged) and dropped. That bound is honest but not
+airtight: two versions can assign a server equal-sized ranges at different
+offsets (same shard count, moved boundaries — a join and a death landing in
+one rebalance), and such traffic applies against the wrong offsets for up
+to one pull cadence until both sides sit on the agreed map. That is a
+bounded, self-healing staleness error of the kind DownPour tolerates by
+design; a version-tagged push frame would close it at the cost of a wire
+format change, and is the noted upgrade path if rebalances ever become
+frequent relative to the cadence.
+
+Each entry also carries the subrange its owner NEWLY acquired in this
+version (``fresh_lo``/``fresh_hi``): the handover protocol. A server that
+gains parameter range it never held has no authoritative values for it;
+whichever worker cuts over first installs its local values for exactly that
+subrange (``MessageCode.RangeInstall``, first install wins), and the world
+continues from there — the same single-install bootstrap the DownPour
+construction path uses, scoped to the moved range.
+
+The map rides the tagged-float32 wire (``MessageCode.ShardMapUpdate``):
+every field is split into float32-exact uint16 halves, so Python, TCP and
+native endpoints all carry it unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from distributed_ml_pytorch_tpu.parallel.sharded_ps import shard_ranges
+from distributed_ml_pytorch_tpu.utils.messaging import _join16, _split16
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardEntry:
+    """One shard server's assignment in a map version.
+
+    ``server_id`` is the member's stable coordinator-world rank — the handle
+    transport factories resolve to a concrete endpoint (in-process: the
+    shard's world; TCP: ``base_port + server_id``).
+    """
+
+    server_id: int
+    lo: int
+    hi: int
+    fresh_lo: int = 0   # subrange newly acquired in this version ([fresh_lo,
+    fresh_hi: int = 0   # fresh_hi) empty when the owner already held it all)
+
+    @property
+    def size(self) -> int:
+        return self.hi - self.lo
+
+    @property
+    def needs_install(self) -> bool:
+        return self.fresh_hi > self.fresh_lo
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardMap:
+    """An immutable, versioned assignment of the flat vector to servers."""
+
+    version: int
+    n_params: int
+    entries: Tuple[ShardEntry, ...] = ()
+
+    def __init__(self, version: int, n_params: int,
+                 entries: Sequence[ShardEntry] = ()):
+        object.__setattr__(self, "version", int(version))
+        object.__setattr__(self, "n_params", int(n_params))
+        object.__setattr__(self, "entries", tuple(entries))
+
+    @property
+    def ranges(self) -> List[Tuple[int, int]]:
+        return [(e.lo, e.hi) for e in self.entries]
+
+    def entry_for(self, server_id: int) -> ShardEntry | None:
+        for e in self.entries:
+            if e.server_id == server_id:
+                return e
+        return None
+
+    # ------------------------------------------------------------- encoding
+    def encode(self) -> np.ndarray:
+        head = [float(len(self.entries)), *_split16(self.version),
+                *_split16(self.n_params)]
+        body: List[float] = []
+        for e in self.entries:
+            body += [float(e.server_id), *_split16(e.lo), *_split16(e.hi),
+                     *_split16(e.fresh_lo), *_split16(e.fresh_hi)]
+        return np.asarray(head + body, np.float32)
+
+    @classmethod
+    def decode(cls, payload: np.ndarray) -> "ShardMap":
+        if payload.size < 5 or not np.isfinite(payload[:5]).all():
+            raise ValueError(f"malformed ShardMap frame (size {payload.size})")
+        k = int(payload[0])
+        version = _join16(payload[1], payload[2])
+        n_params = _join16(payload[3], payload[4])
+        if k < 0 or payload.size < 5 + 9 * k:
+            raise ValueError(
+                f"ShardMap frame declares {k} entries but carries "
+                f"{payload.size} floats")
+        entries = []
+        for i in range(k):
+            f = payload[5 + 9 * i: 5 + 9 * (i + 1)]
+            if not np.isfinite(f).all():
+                raise ValueError("non-finite ShardMap entry")
+            entries.append(ShardEntry(
+                server_id=int(f[0]),
+                lo=_join16(f[1], f[2]), hi=_join16(f[3], f[4]),
+                fresh_lo=_join16(f[5], f[6]), fresh_hi=_join16(f[7], f[8]),
+            ))
+        return cls(version, n_params, entries)
+
+
+def rebalance(prev: ShardMap, live_server_ids: Sequence[int]) -> ShardMap:
+    """The next map version: contiguous near-equal ranges over the live
+    servers (sorted by id, so the assignment is a pure function of the
+    membership set), with each entry's ``fresh`` subrange = the part of its
+    new range the server did not already hold — the slice a worker must
+    install on cutover.
+    """
+    ids = sorted(set(int(s) for s in live_server_ids))
+    if not ids:
+        return ShardMap(prev.version + 1, prev.n_params, ())
+    ranges = shard_ranges(prev.n_params, len(ids))
+    prev_by_id = {e.server_id: e for e in prev.entries}
+    entries = []
+    for sid, (lo, hi) in zip(ids, ranges):
+        held = prev_by_id.get(sid)
+        if held is None:
+            fresh = (lo, hi)  # brand-new server: everything is new to it
+        else:
+            # the overlap [max(lo, held.lo), min(hi, held.hi)) keeps its
+            # authoritative server-side values; ONE new contiguous flank is
+            # the common case (contiguous ranges over a sorted id set can
+            # grow on both flanks only when neighbors vanish on both sides
+            # — then the larger flank is installed and the smaller rides
+            # the same install frame, see ElasticShardServer.resize)
+            o_lo, o_hi = max(lo, held.lo), min(hi, held.hi)
+            if o_lo >= o_hi:
+                fresh = (lo, hi)  # ranges moved entirely: all new
+            elif lo < o_lo:
+                fresh = (lo, o_lo) if hi == o_hi else (lo, hi)
+            elif hi > o_hi:
+                fresh = (o_hi, hi)
+            else:
+                fresh = (0, 0)
+        entries.append(ShardEntry(sid, lo, hi, fresh[0], fresh[1]))
+    return ShardMap(prev.version + 1, prev.n_params, entries)

@@ -79,6 +79,7 @@ class ParameterServer:
         worker_timeout: Optional[float] = None,
         ckpt_dir: Optional[str] = None,
         ckpt_every: int = 500,
+        staleness_damping: float = 0.0,
     ):
         if params is not None:
             self.central = np.asarray(params, dtype=np.float32).copy()
@@ -99,6 +100,13 @@ class ParameterServer:
         self._push_count = 0
         self._restored = False
         self.rejected_installs = 0
+        #: staleness-weighted apply (arxiv 2006.02924 motivates weighting
+        #: contributions by staleness): a push that raced `s` central
+        #: versions since its worker last pulled applies scaled by
+        #: 1/(1 + damping*s). 0 (default) is the exact reference behavior;
+        #: under straggler-heavy fleets a small damping keeps one slow
+        #: worker's very stale deltas from dragging the central params back.
+        self.staleness_damping = float(staleness_damping)
         from distributed_ml_pytorch_tpu.utils.failure import StalenessAuditor
 
         self.staleness = StalenessAuditor()
@@ -172,8 +180,11 @@ class ParameterServer:
         self.message_counts[code] = self.message_counts.get(code, 0) + 1
         if code == MessageCode.GradientUpdate:
             # workers pre-scale by -lr (Asynchronous.py:55) → server-side add
-            self.central += payload
-            self.staleness.on_push(sender)
+            staleness = self.staleness.on_push(sender)
+            if self.staleness_damping > 0.0 and staleness > 0:
+                self.central += payload / (1.0 + self.staleness_damping * staleness)
+            else:
+                self.central += payload
             self._push_count += 1
             if self.ckpt_dir and self.ckpt_every and (
                 self._push_count % self.ckpt_every == 0
@@ -927,6 +938,7 @@ def run_server(args, transport: Transport) -> ParameterServer:
         worker_timeout=getattr(args, "worker_timeout", 0.0) or None,
         ckpt_dir=getattr(args, "ckpt_dir", "") or None,
         ckpt_every=getattr(args, "ckpt_every", 500),
+        staleness_damping=getattr(args, "staleness_damping", 0.0),
     )
     if getattr(args, "resume", False) and server.maybe_restore():
         print("parameter server: resumed central params from", server._ckpt_path())

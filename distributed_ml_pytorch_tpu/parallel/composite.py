@@ -105,7 +105,9 @@ def create_composite_train_state(
         lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, P),
     )
-    state = jax.jit(init_fn, out_shardings=shardings)(rng)
+    from distributed_ml_pytorch_tpu.runtime.mesh import sharded_init
+
+    state = sharded_init(init_fn, rng, shardings)
     return state, shardings
 
 

@@ -74,7 +74,9 @@ def create_ep_train_state(
     shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
     )
-    return jax.jit(init_fn, out_shardings=shardings)(rng)
+    from distributed_ml_pytorch_tpu.runtime.mesh import sharded_init
+
+    return sharded_init(init_fn, rng, shardings)
 
 
 def make_ep_train_step(

@@ -116,7 +116,9 @@ def create_fsdp_train_state(
     """
     state_shapes = jax.eval_shape(init_fn, rng)
     shardings = _state_shardings(mesh, state_shapes, axis)
-    state = jax.jit(init_fn, out_shardings=shardings)(rng)
+    from distributed_ml_pytorch_tpu.runtime.mesh import sharded_init
+
+    state = sharded_init(init_fn, rng, shardings)
     return state, shardings
 
 

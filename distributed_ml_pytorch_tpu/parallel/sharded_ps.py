@@ -74,6 +74,7 @@ def make_shard_server(
     worker_timeout: Optional[float] = None,
     ckpt_dir: Optional[str] = None,
     ckpt_every: int = 500,
+    staleness_damping: float = 0.0,
 ) -> ParameterServer:
     """A shard server: a plain ParameterServer over its contiguous slice.
 
@@ -93,6 +94,7 @@ def make_shard_server(
         worker_timeout=worker_timeout,
         ckpt_dir=ckpt_dir,
         ckpt_every=ckpt_every,
+        staleness_damping=staleness_damping,
     )
 
 
@@ -103,6 +105,20 @@ class ShardedAsynchronous:
     Functional step API: ``params = opt.step(params, grads)``. Construction
     installs each server's slice of this worker's initial params — the same
     single-install wire pattern as the unsharded client, fanned out.
+
+    Elastic mode (ISSUE 3): with a ``coord`` client and a
+    ``transport_factory``, the shard set is no longer launch-time state.
+    Whenever the coordinator broadcasts a newer
+    :class:`~distributed_ml_pytorch_tpu.coord.shardmap.ShardMap`, the next
+    step boundary cuts over: in-flight pushes DRAIN under the old map (the
+    flusher queue empties, so no push is torn across maps), transports for
+    surviving servers are reused, new servers get transports from the
+    factory, and any range a server newly acquired is seeded with this
+    worker's current values (``MessageCode.RangeInstall`` — first worker to
+    cut over wins, the construction-install pattern scoped to the moved
+    range). The accumulated gradient survives untouched: it is a flat
+    vector over the WHOLE model, and the map only decides how it is sliced
+    at push time.
     """
 
     def __init__(
@@ -117,15 +133,35 @@ class ShardedAsynchronous:
         rejoin: bool = False,
         install_timeout: float = 5.0,
         heartbeats: Optional[Sequence] = None,
+        coord=None,
+        transport_factory=None,
+        shard_map=None,
     ):
         validate_downpour_args(lr, n_push, n_pull)
         if not transports:
             raise ValueError("need at least one shard transport")
+        if coord is not None and heartbeats:
+            raise ValueError(
+                "elastic mode: shard liveness is the coordinator's lease "
+                "job — per-shard heartbeat senders cannot follow a cutover")
+        if coord is not None and transport_factory is None:
+            raise ValueError("elastic mode needs a transport_factory")
         self.lr = float(lr)
         self.n_push = int(n_push)
         self.n_pull = int(n_pull)
         self.transports = list(transports)
+        self.coord = coord
+        self.transport_factory = transport_factory
+        self.map_version = shard_map.version if shard_map is not None else -1
+        #: stable per-shard server ids (coord-world ranks in elastic mode;
+        #: positional 0..k-1 in static mode) — how map entries match slots
+        self.server_ids = (
+            [e.server_id for e in shard_map.entries]
+            if shard_map is not None else list(range(len(self.transports))))
+        self._owned: set = set()  # server ids whose transports WE created
         self.idx = 0
+        self._last_step_t: Optional[float] = None
+        self._ewma_ms = 0.0  # inter-step latency EWMA fed to the coordinator
         self.unravel = make_unraveler(params)
         # worker-local optax transform (same contract as Asynchronous.tx:
         # default = the reference SGD recipe; state survives shard installs)
@@ -134,7 +170,18 @@ class ShardedAsynchronous:
         self.tx = tx if tx is not None else default_downpour_tx(self.lr)
         self.opt_state = self.tx.init(params)
         flat, self._flat_n, self._pad, self.accum = init_downpour_accumulator(params)
-        self.ranges = shard_ranges(self._flat_n, len(self.transports))
+        if shard_map is not None:
+            if shard_map.n_params != self._flat_n:
+                raise ValueError(
+                    f"shard map covers {shard_map.n_params} params but the "
+                    f"model ravels to {self._flat_n}")
+            if len(shard_map.entries) != len(self.transports):
+                raise ValueError(
+                    f"shard map has {len(shard_map.entries)} entries but "
+                    f"{len(self.transports)} transports were given")
+            self.ranges = shard_map.ranges
+        else:
+            self.ranges = shard_ranges(self._flat_n, len(self.transports))
         self._device_step = make_downpour_device_step(self.tx, self._pad)
         # per-shard liveness: a dead shard degrades that SLICE to purely-
         # local SGD (same contract as Asynchronous._send, per shard — the
@@ -178,8 +225,20 @@ class ShardedAsynchronous:
             self._send(s, MessageCode.GradientUpdate, arr[lo:hi])
 
     def _send(self, shard: int, code: MessageCode, payload: np.ndarray) -> None:
-        """Send toward one shard server; its death degrades, never crashes."""
+        """Send toward one shard server; its death degrades, never crashes.
+
+        A down-marked shard still gets ParameterRequests: the pull cadence
+        doubles as the revival probe (an empty frame, nothing to lose), and
+        a restarted server's reply is exactly the contact that
+        :meth:`_mark_up` revives on — without it the down flag would be a
+        one-way door and the revive path dead code."""
         if self.shard_down[shard]:
+            if code != MessageCode.ParameterRequest:
+                return
+            try:
+                send_message(code, payload, transport=self.transports[shard])
+            except (OSError, ConnectionError):
+                pass  # still down; the next cadence probes again
             return
         if self.heartbeats is not None and self.heartbeats[shard].peer_down:
             self._mark_down(shard)
@@ -190,11 +249,31 @@ class ShardedAsynchronous:
             self._mark_down(shard)
 
     def _mark_down(self, shard: int) -> None:
+        if self.shard_down[shard]:
+            return  # already down: no repeat transition logging
         self.shard_down[shard] = True
         lo, hi = self.ranges[shard]
         print(
-            f"worker: shard server {shard} (params [{lo},{hi})) "
-            "unreachable — that slice continues with purely-local SGD",
+            f"worker: shard {self.server_ids[shard]} state up->down "
+            f"(params [{lo},{hi})) — that slice continues with "
+            "purely-local SGD until the server answers again",
+            file=sys.stderr,
+        )
+
+    def _mark_up(self, shard: int) -> None:
+        """Revive-on-contact: a reply from a down-marked shard is evidence
+        of life (the reliable transport's any-frame-revives rule, lifted to
+        the shard slot level) — resume its push/pull service."""
+        self.shard_down[shard] = False
+        if self.heartbeats is not None:
+            # the sender keeps probing and clears this itself on the next
+            # successful send; clearing here just closes the race where a
+            # stale flag would re-mark the shard before that probe fires
+            self.heartbeats[shard].peer_down = False
+        lo, hi = self.ranges[shard]
+        print(
+            f"worker: shard {self.server_ids[shard]} state down->up "
+            f"(params [{lo},{hi})) — push/pull service resumes",
             file=sys.stderr,
         )
 
@@ -206,17 +285,118 @@ class ShardedAsynchronous:
             return params
         # np.array (not asarray): a jax array exports a read-only buffer
         flat = np.array(ravel_model_params(params), dtype=np.float32)
-        for (lo, hi), sl in zip(self.ranges, latest):
+        for s, ((lo, hi), sl) in enumerate(zip(self.ranges, latest)):
             if sl is not None:
                 if sl.shape[0] != hi - lo:
-                    raise ValueError(
-                        f"shard reply of {sl.shape[0]} params for a "
-                        f"[{lo},{hi}) range — shard/worker ranges disagree"
+                    if self.coord is None:
+                        # static fleet: ranges are launch-time constants, so
+                        # a size mismatch is a BUG — fail loudly, never
+                        # silently corrupt params
+                        raise ValueError(
+                            f"shard reply of {sl.shape[0]} params for a "
+                            f"[{lo},{hi}) range — shard/worker ranges disagree"
+                        )
+                    # elastic fleet: a reply sized for another map version
+                    # (the server resized mid-flight) is expected transient
+                    # traffic — drop it; the next pull under the agreed map
+                    # answers correctly
+                    print(
+                        f"worker: dropping shard {self.server_ids[s]} reply "
+                        f"of {sl.shape[0]} params for a [{lo},{hi}) range "
+                        "(stale shard-map traffic)",
+                        file=sys.stderr,
                     )
+                    continue
+                if self.shard_down[s]:
+                    self._mark_up(s)
                 flat[lo:hi] = sl
         return self.unravel(jnp.asarray(flat))
 
+    def _maybe_cutover(self, params: Pytree) -> None:
+        """Adopt a newer coordinator shard map at this step boundary."""
+        if self.coord is None:
+            return
+        m = self.coord.take_shard_map()
+        if m is None or m.version <= self.map_version:
+            return
+        self.apply_shard_map(m, params)
+
+    def apply_shard_map(self, m, params: Pytree) -> None:
+        """Cut this client over to shard map version ``m.version``.
+
+        Ordering: (1) drain the flusher so every in-flight push lands under
+        the OLD map (no push is split across maps — the accumulated
+        gradient is never lost, it is the same flat vector under any map);
+        (2) retire slots for servers the map dropped (stop their listeners;
+        close their transports only if this client created them); (3) build
+        slots for new servers via the factory, listener-before-any-send;
+        (4) seed every freshly-acquired range with this worker's current
+        values (``RangeInstall`` — first cutover wins server-side).
+        """
+        self._flusher.drain()
+        old = {sid: (t, listener, down) for sid, t, listener, down in zip(
+            self.server_ids, self.transports, self.listeners, self.shard_down)}
+        new_transports, new_listeners, new_down = [], [], []
+        for e in m.entries:
+            if e.server_id in old:
+                t, listener, down = old.pop(e.server_id)
+            else:
+                t = self.transport_factory(e)
+                self._owned.add(e.server_id)
+                listener = Listener(transport=t)
+                listener.start()
+                down = False
+            new_transports.append(t)
+            new_listeners.append(listener)
+            new_down.append(down)
+        for sid, (t, listener, _down) in old.items():
+            listener.stop()
+            if sid in self._owned:
+                self._owned.discard(sid)
+                t.close()
+        print(
+            "worker: shard map v{} adopted — {} shard(s): {}".format(
+                m.version, len(m.entries),
+                ", ".join(f"s{e.server_id}=[{e.lo},{e.hi})"
+                          for e in m.entries) or "none"),
+            file=sys.stderr,
+        )
+        self.transports = new_transports
+        self.listeners = new_listeners
+        self.shard_down = new_down
+        self.ranges = m.ranges
+        self.server_ids = [e.server_id for e in m.entries]
+        self.map_version = m.version
+        # seed moved ranges from this worker's CURRENT values (stale by at
+        # most one pull cadence — accepted DownPour staleness; losing the
+        # range entirely is the alternative)
+        flat = np.array(ravel_model_params(params), dtype=np.float32)
+        from distributed_ml_pytorch_tpu.utils.messaging import _split16
+
+        for s, e in enumerate(m.entries):
+            if e.needs_install:
+                frame = np.concatenate([
+                    np.asarray([*_split16(e.fresh_lo), *_split16(e.fresh_hi)],
+                               np.float32),
+                    flat[e.fresh_lo:e.fresh_hi],
+                ])
+                self._send(s, MessageCode.RangeInstall, frame)
+
     def step(self, params: Pytree, grads: Pytree) -> Pytree:
+        if self.coord is not None:
+            # progress report: inter-call gap EWMA (captures the WHOLE loop
+            # — data, grad compute, any stall — which is what a straggler
+            # actually costs the fleet); the renew thread ships it
+            import time as _time
+
+            now = _time.monotonic()
+            if self._last_step_t is not None:
+                dt_ms = (now - self._last_step_t) * 1e3
+                self._ewma_ms = (dt_ms if self._ewma_ms == 0.0
+                                 else 0.7 * self._ewma_ms + 0.3 * dt_ms)
+            self._last_step_t = now
+            self.coord.report(self.idx // self.n_push, self.idx, self._ewma_ms)
+        self._maybe_cutover(params)
         params = self._install_arrived(params)
         if self.idx % self.n_pull == 0:
             for s in range(len(self.transports)):
@@ -229,6 +409,22 @@ class ShardedAsynchronous:
             self.accum = jnp.zeros_like(self.accum)
         self.idx += 1
         return params
+
+    def push_speculative(self, task_id: int, flat_update: np.ndarray) -> None:
+        """Push one Sandblaster backup-task result: the accumulated
+        lr-scaled update of a straggler's remaining batches, tagged with
+        the coordinator-assigned ``task_id``. BOTH the victim and its
+        backup call this with the same id; each shard server applies the
+        first arrival and drops the rest (``ElasticShardServer`` dedup) —
+        first-result-wins without double-applying a whole tail of deltas.
+        """
+        from distributed_ml_pytorch_tpu.utils.messaging import _split16
+
+        head = np.asarray([*_split16(int(task_id))], np.float32)
+        flat_update = np.asarray(flat_update, np.float32).ravel()
+        for s, (lo, hi) in enumerate(self.ranges):
+            self._send(s, MessageCode.SpeculativeUpdate,
+                       np.concatenate([head, flat_update[lo:hi]]))
 
     def finish(self) -> None:
         """Flush the final push and close out every shard."""
@@ -272,6 +468,10 @@ def run_sharded_ps_process(args) -> int:
         )
     kind = getattr(args, "transport", "auto")
     reliable = getattr(args, "reliable", False)
+    coord_addr = getattr(args, "coord", "") or ""
+    if coord_addr:
+        return _run_elastic_ps_process(args, k, n_workers, kind, reliable,
+                                       coord_addr)
     if args.rank < k:
         shard = args.rank
         transport = make_transport(
@@ -294,6 +494,7 @@ def run_sharded_ps_process(args) -> int:
                 worker_timeout=getattr(args, "worker_timeout", 0.0) or None,
                 ckpt_dir=f"{ckpt_dir}/shard{shard}" if ckpt_dir else None,
                 ckpt_every=getattr(args, "ckpt_every", 500),
+                staleness_damping=getattr(args, "staleness_damping", 0.0),
             )
             if getattr(args, "resume", False) and server.maybe_restore():
                 print(f"shard server {shard}: resumed central params")
@@ -303,6 +504,13 @@ def run_sharded_ps_process(args) -> int:
         finally:
             transport.close()
         return 0
+    return _run_static_worker(args, k, n_workers, kind, reliable)
+
+
+def _run_static_worker(args, k, n_workers, kind, reliable) -> int:
+    from distributed_ml_pytorch_tpu.parallel.async_ps import train_worker
+    from distributed_ml_pytorch_tpu.utils.messaging import make_transport
+
     star_rank = args.rank - k + 1
     transports = [
         make_transport(
@@ -345,3 +553,121 @@ def run_sharded_ps_process(args) -> int:
         for t in transports:
             t.close()
     return 0
+
+
+def _run_elastic_ps_process(args, k, n_workers, kind, reliable,
+                            coord_addr) -> int:
+    """``--coord host:port``: run this PS rank against an elastic control
+    plane (``coord/``) instead of the static launch-time topology.
+
+    Shard rank ``r`` (< k) serves as an :class:`~distributed_ml_pytorch_tpu.
+    coord.elastic.ElasticShardServer` with server id ``r + 1`` on its own
+    star (``port + r``, the static convention — which is also how the
+    worker-side transport factory resolves a shard-map entry:
+    ``port + server_id − 1``); worker ranks run the normal training loop
+    with a coordinator-attached :class:`ShardedAsynchronous` that adopts
+    pushed shard maps at step boundaries. Membership ranks in the
+    coordination star are ``global rank + 1`` (the coordinator is 0).
+    """
+    import jax
+
+    from distributed_ml_pytorch_tpu.coord.elastic import ElasticShardServer
+    from distributed_ml_pytorch_tpu.coord.member import CoordClient
+    from distributed_ml_pytorch_tpu.models import get_model
+    from distributed_ml_pytorch_tpu.parallel.async_ps import train_worker
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        TCPTransport,
+        make_transport,
+    )
+
+    host, _, cport = coord_addr.partition(":")
+    coord_transport = TCPTransport(
+        rank=args.rank + 1, world_size=64, master=host or "localhost",
+        port=int(cport or 29700))
+    model = get_model(getattr(args, "model", "alexnet"))
+    params = model.init(
+        jax.random.key(getattr(args, "seed", 0)), jnp.zeros((1, 32, 32, 3))
+    )["params"]
+    from distributed_ml_pytorch_tpu.utils.serialization import (
+        ravel_model_params as _ravel,
+    )
+
+    flat = np.asarray(_ravel(params), np.float32)
+    try:
+        if args.rank < k:
+            client = CoordClient(coord_transport, "shard")
+            # wait_for=0: an ELASTIC server must join the coordinator and
+            # serve immediately — workers dial in whenever the map reaches
+            # them (the static path's blocking rendezvous would deadlock:
+            # workers wait for the map, the map waits for this join).
+            # Python transport only: the native lib has no elastic accept.
+            from distributed_ml_pytorch_tpu.utils.messaging import (
+                ReliableTransport as _Rel,
+                TCPTransport as _Tcp,
+            )
+
+            star = _Tcp(0, n_workers + 1, args.master,
+                        int(args.port) + args.rank, wait_for=0)
+            if reliable:
+                star = _Rel(star)
+            ckpt_dir = getattr(args, "ckpt_dir", "") or None
+            server = ElasticShardServer(
+                server_id=args.rank + 1, n_params=flat.shape[0],
+                transport=star, coord=client, init_params=flat,
+                staleness_damping=getattr(args, "staleness_damping", 0.0),
+                ckpt_dir=(f"{ckpt_dir}/shard{args.rank}" if ckpt_dir
+                          else None),
+                ckpt_every=getattr(args, "ckpt_every", 500))
+            try:
+                server.run()
+                print(f"elastic shard server {args.rank}: done "
+                      f"(range [{server.lo},{server.hi}), "
+                      f"stats {server.stats})")
+            finally:
+                star.close()
+            return 0
+        star_rank = args.rank - k + 1
+        client = CoordClient(coord_transport, "worker")
+        m = client.join(timeout=10)
+        # an EMPTY map just means no shard server has joined yet — this is
+        # an elastic fleet, wait for one (bounded) instead of failing
+        import time as _time
+
+        deadline = _time.monotonic() + 120
+        while (m is None or not m.entries) and _time.monotonic() < deadline:
+            _time.sleep(0.5)
+            m = client.current_map()
+        if m is None or not m.entries:
+            raise SystemExit(
+                "worker: no populated shard map from the coordinator at "
+                f"{coord_addr} after 120s — is coord/cli.py running and "
+                "did any shard rank join?")
+        created = []
+
+        def factory(entry):
+            t = make_transport(
+                star_rank, n_workers + 1, args.master,
+                int(args.port) + entry.server_id - 1, kind=kind,
+                reliable=reliable)
+            created.append(t)
+            return t
+
+        try:
+            initial = [factory(e) for e in m.entries]
+            opt_factory = lambda p, tx: ShardedAsynchronous(
+                p, lr=args.lr, n_push=args.num_push, n_pull=args.num_pull,
+                tx=tx, transports=initial,
+                coord=client, transport_factory=factory, shard_map=m,
+                rejoin=getattr(args, "rejoin", False))
+            _params, logger = train_worker(
+                args, initial[0], opt_factory=opt_factory)
+            path = logger.to_csv("node{}.csv".format(star_rank))
+            print("wrote", path)
+            print("Finished Training")
+        finally:
+            for t in created:
+                t.close()
+        return 0
+    finally:
+        client.close()
+        coord_transport.close()

@@ -126,7 +126,9 @@ def create_tp_train_state(
         lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, P),
     )
-    return jax.jit(init_fn, out_shardings=shardings)(rng)
+    from distributed_ml_pytorch_tpu.runtime.mesh import sharded_init
+
+    return sharded_init(init_fn, rng, shardings)
 
 
 def make_tp_train_step(

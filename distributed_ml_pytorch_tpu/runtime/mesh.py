@@ -161,6 +161,27 @@ def make_mesh(
     return Mesh(mesh_devs, names)
 
 
+def sharded_init(init_fn, rng, shardings):
+    """Jit ``init_fn(rng)`` so its output lands with ``shardings`` — with
+    values INDEPENDENT of the mesh shape.
+
+    On runtimes whose threefry is not partitionable (jax <= 0.4.x default),
+    ``jit(init_fn, out_shardings=...)`` generates DIFFERENT random values for
+    a leaf that is sharded over one mesh axis while replicated over another
+    (measured: identical keys gave divergent block kernels on a
+    ``{"data": 2, "stage": 2}`` mesh vs a ``{"stage": 2}`` mesh — the root
+    cause of the dp×pp×tp composite-loss "divergence" in dryrun_multichip;
+    1-D meshes agree with the unsharded init exactly). There the init runs
+    unsharded and is resharded with ``device_put`` — every device briefly
+    holds the full tree, the compat price of value-determinism. With a
+    partitionable threefry the sharded lowering is already value-invariant,
+    so the memory-frugal ``out_shardings`` path is kept.
+    """
+    if jax.config.jax_threefry_partitionable:
+        return jax.jit(init_fn, out_shardings=shardings)(rng)
+    return jax.device_put(jax.jit(init_fn)(rng), shardings)
+
+
 def data_mesh(n_devices: int | None = None) -> Mesh:
     """1-D ``data`` mesh over the first ``n_devices`` devices (default: all)."""
     devs = jax.devices()

@@ -81,6 +81,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cancel + free a request whose client has been "
                         "silent this long (disconnect/abandon cleanup); "
                         "streaming clients refresh liveness via StreamAck")
+    p.add_argument("--coord", type=str, default="", metavar="HOST:PORT",
+                   help="register this engine with an elastic control plane "
+                        "(coord/cli.py): lease-based membership, and the "
+                        "frontend holds submits while the coordinator "
+                        "reports the engine fleet down, re-admitting them "
+                        "on recovery")
+    p.add_argument("--coord-rank", type=int, default=0, metavar="R",
+                   help="this engine's rank in the coordination star "
+                        "(0 = derive from --port; two engines MUST use "
+                        "distinct ranks or the later one replaces the "
+                        "earlier in the coordinator's membership)")
     p.add_argument("--demo", type=int, default=0, metavar="N",
                    help="serve N synthetic requests from an in-process "
                         "client, print the SLO summary, exit")
@@ -211,13 +222,31 @@ def main(argv=None) -> int:
         TCPTransport,
     )
 
+    coord_client = None
+    if args.coord:
+        from distributed_ml_pytorch_tpu.coord.member import CoordClient
+
+        host, _, cport = args.coord.partition(":")
+        # engines live in the high end of the coordination rank space so
+        # they can never collide with training ranks (rank + 1 there);
+        # deriving from the SERVING port keeps co-hosted engines distinct
+        # (two engines cannot share a port) — cross-host fleets should pin
+        # --coord-rank explicitly
+        rank = args.coord_rank or 50 + int(args.port) % 14
+        coord_client = CoordClient(
+            TCPTransport(rank=rank, world_size=64,
+                         master=host or "localhost",
+                         port=int(cport or 29700)),
+            "engine")
+        coord_client.join(timeout=10)
     transport = TCPTransport(
         rank=0, world_size=1 + args.clients, master=args.master,
         port=int(args.port))
     if args.reliable:
         transport = ReliableTransport(transport)
-    frontend = ServingFrontend(engine, transport,
-                               client_deadline=args.client_deadline)
+    frontend = ServingFrontend(
+        engine, transport, client_deadline=args.client_deadline,
+        fleet=coord_client.fleet if coord_client is not None else None)
     print(f"serving on {args.master}:{args.port} "
           f"({args.slots} slots x {args.cache_size} rows, "
           f"block {args.decode_block}"
@@ -229,6 +258,9 @@ def main(argv=None) -> int:
     finally:
         frontend.stop()
         transport.close()
+        if coord_client is not None:
+            coord_client.close()
+            coord_client.transport.close()
         _print_summary(engine)
     return 0
 

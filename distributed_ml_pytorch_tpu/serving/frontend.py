@@ -140,13 +140,29 @@ class ServingFrontend:
     """
 
     def __init__(self, engine: ServingEngine, transport: Transport, *,
-                 client_deadline: float = 30.0, done_ttl: float = 60.0):
+                 client_deadline: float = 30.0, done_ttl: float = 60.0,
+                 fleet=None, hold_queue: int = 64):
         if engine.on_tokens is not None:
             raise ValueError("engine already has an on_tokens consumer")
         self.engine = engine
         self.transport = transport
         self.client_deadline = float(client_deadline)
         self.done_ttl = float(done_ttl)
+        #: coord-plane fleet view (ISSUE 3): when the coordinator reports
+        #: the engine fleet DOWN (``fleet.engine_up()`` False — e.g. the
+        #: backing engine member's lease expired), new submits are HELD in
+        #: arrival order instead of entering the engine, up to
+        #: ``hold_queue`` of them (beyond that: ServeReject, the existing
+        #: backpressure face); on recovery the sweep re-admits them. With
+        #: ``fleet=None`` (no control plane) behavior is unchanged.
+        self.fleet = fleet
+        self.hold_queue = int(hold_queue)
+        # appended by the pump thread, drained by the serve/sweep thread —
+        # every access goes through _held_lock or a re-admitted submit can
+        # land on the already-drained list and vanish
+        self._held: List[Tuple[int, np.ndarray]] = []  # (sender, payload)
+        self._held_lock = threading.Lock()
+        self.held_peak = 0
         engine.on_tokens = self._on_tokens
         #: engine-side request key -> live route state. Keys start far above
         #: the engine's own id counter so locally submitted requests can
@@ -189,6 +205,20 @@ class ServingFrontend:
                 payload: np.ndarray) -> None:
         now = time.monotonic()
         if code == MessageCode.SubmitRequest:
+            if self.fleet is not None and not self.fleet.engine_up():
+                # engine loss (coordinator's fleet view): queue-or-reject.
+                # Held submits re-enter via the sweep on recovery; the
+                # client's stream() just sees added latency, not an error.
+                with self._held_lock:
+                    held_room = len(self._held) < self.hold_queue
+                    if held_room:
+                        self._held.append(
+                            (sender, np.array(payload, copy=True)))
+                        self.held_peak = max(self.held_peak, len(self._held))
+                if not held_room and payload.size >= 1:
+                    self._send_to(sender, MessageCode.ServeReject,
+                                  np.asarray([payload[0]], np.float32))
+                return
             try:
                 rid, kwargs, prompt = decode_submit(payload)
             except (ValueError, IndexError, OverflowError):
@@ -234,6 +264,12 @@ class ServingFrontend:
             route = self._route_of(sender, rid)
             if route is None:
                 if code == MessageCode.ResumeStream:
+                    with self._held_lock:
+                        is_held = any(
+                            s == sender and h.size >= 1 and int(h[0]) == rid
+                            for s, h in self._held)
+                    if is_held:
+                        return  # held across an engine outage: not an error
                     # resume for a request the engine no longer knows
                     # (history expired, or never submitted): tell the
                     # client instead of letting it poll forever
@@ -276,9 +312,19 @@ class ServingFrontend:
             route.done_at = time.monotonic()
         self._send_frame(route, start=start, tokens=new_tokens, done=done)
 
+    def _readmit_held(self) -> None:
+        """Re-admit submits held across an engine outage (arrival order)."""
+        if self.fleet is not None and not self.fleet.engine_up():
+            return
+        with self._held_lock:
+            held, self._held = self._held, []
+        for sender, payload in held:
+            self._handle(sender, MessageCode.SubmitRequest, payload)
+
     def _sweep(self, now: float) -> None:
         """Free state for silent clients (cancel live requests; forget
         finished histories past their resume TTL)."""
+        self._readmit_held()
         with self._routes_lock:
             items = list(self._routes.items())
         for key, route in items:

@@ -179,6 +179,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="PS-mode server declares a worker failed after this "
                         "long without a frame, instead of waiting forever; "
                         "0 disables")
+    p.add_argument("--coord", type=str, default="", metavar="HOST:PORT",
+                   help="attach this PS-mode rank to an elastic control "
+                        "plane (coord/cli.py): membership + lease liveness, "
+                        "coordinator-pushed shard maps (workers cut over at "
+                        "step boundaries; shard servers resize), straggler "
+                        "speculation. Empty = static fleet (the classic "
+                        "launch-time topology)")
+    p.add_argument("--staleness-damping", type=float, default=0.0, metavar="D",
+                   help="PS-mode server scales each gradient push by "
+                        "1/(1 + D*staleness), where staleness counts central "
+                        "versions since that worker's last pull (straggler "
+                        "mitigation, arxiv 2006.02924); 0 = reference "
+                        "behavior (apply raw)")
     return p
 
 
@@ -240,7 +253,9 @@ def main(argv=None) -> int:
         # only the module imports sit in the try: a run-time ImportError
         # from inside training must surface, not masquerade as a build issue
         try:
-            if getattr(args, "n_servers", 1) > 1:
+            if getattr(args, "n_servers", 1) > 1 or getattr(args, "coord", ""):
+                # the sharded entry also hosts the elastic (--coord) path:
+                # k=1 is just a one-entry shard map there
                 from distributed_ml_pytorch_tpu.parallel.sharded_ps import (
                     run_sharded_ps_process as ps_entry,
                 )

@@ -206,6 +206,26 @@ class FaultyTransport(Transport):
         )
 
     # ------------------------------------------------------ chaos scripting
+    def sibling(self, inner: Transport) -> "FaultyTransport":
+        """Wrap a LATE-JOINING member's transport with this wrapper's plan,
+        log and crash-script state — the coordinator-era (ISSUE 3) analog
+        of :meth:`wrap_world`, for worlds whose membership is elastic: a
+        worker that joins mid-run gets the same seeded fault regime and is
+        visible to the same ``crash_rank`` scripting as everyone else."""
+        return FaultyTransport(inner, self.plan, log=self.log,
+                               world=self._world)
+
+    def crash_rank(self, rank: int) -> None:
+        """Script a crash of ANY rank of this world (not just this
+        endpoint): coordinator-aware chaos scripts crash members by id from
+        one place instead of needing each member's own wrapper in hand."""
+        with self._world.lock:
+            self._world.crashed.add(rank)
+
+    def restart_rank(self, rank: int) -> None:
+        with self._world.lock:
+            self._world.crashed.discard(rank)
+
     def partition(self, dst: int) -> None:
         """One-way partition: this endpoint's frames toward ``dst`` vanish
         (logged); the reverse direction is untouched."""

@@ -86,9 +86,14 @@ class FailureDetector:
 class HeartbeatSender(threading.Thread):
     """Worker-side daemon: send a Heartbeat frame every ``interval`` seconds.
 
-    Send failures mark the peer dead (exposed via :attr:`peer_down`) and end
-    the loop quietly — the training loop decides what to do about it; the
-    heartbeat thread must never take the process down.
+    Send failures mark the peer dead (exposed via :attr:`peer_down`) but the
+    loop keeps probing at the same cadence — one small frame per interval,
+    no storm — and a send that succeeds again CLEARS the flag. That makes
+    ``peer_down`` a live view, which the revive-on-contact path in
+    ``sharded_ps.ShardedAsynchronous`` depends on: a shard server that
+    restarts (same endpoint) must read as up again, not stay wedged on a
+    one-shot flag. The training loop decides what to do about either edge;
+    the heartbeat thread must never take the process down.
     """
 
     def __init__(self, transport, interval: float = 1.0):
@@ -108,9 +113,9 @@ class HeartbeatSender(threading.Thread):
         while not self._stop.wait(self.interval):
             try:
                 self.transport.send(self._code, empty)
+                self.peer_down = False
             except (OSError, ConnectionError, KeyError):
                 self.peer_down = True
-                return
 
     def stop(self) -> None:
         self._stop.set()

@@ -89,6 +89,15 @@ class MessageCode(enum.IntEnum):
     ReliableAck = 10    # receiver → sender: [seq_lo, seq_hi, inc_lo, inc_hi]
     StreamAck = 11      # client → engine: [id, n_received] — progress + liveness
     ResumeStream = 12   # client → engine: [id, n_received] — re-send from offset
+    # --- coordination plane (coord/, ISSUE 3): the elastic control plane ---
+    CoordJoin = 13      # member → coord: [kind, inc_lo, inc_hi]
+    CoordLeave = 14     # member → coord: [inc_lo, inc_hi] — explicit leave
+    LeaseRenew = 15     # member → coord: [inc_lo, inc_hi, push_count, step, ewma_ms]
+    ShardMapUpdate = 16 # coord → members: encoded versioned ShardMap (coord/shardmap.py)
+    FleetState = 17     # coord → members: [version, n_workers, n_shards, n_engines, workers_done]
+    SpeculateTask = 18  # coord → backup worker: [task_id, victim_rank, from_step]
+    SpeculativeUpdate = 19  # worker → PS shard: [task_lo, task_hi, *payload] — first wins
+    RangeInstall = 20   # worker → PS shard: [lo_lo, lo_hi, hi_lo, hi_hi, *values]
 
 
 Message = Tuple[int, MessageCode, np.ndarray]
@@ -123,6 +132,14 @@ class InProcessTransport(Transport):
     def create_world(cls, world_size: int) -> Dict[int, "InProcessTransport"]:
         boxes: Dict[int, queue.Queue] = {r: queue.Queue() for r in range(world_size)}
         return {r: cls(r, boxes) for r in range(world_size)}
+
+    def attach_rank(self, rank: int) -> "InProcessTransport":
+        """Elastic join: a transport for ``rank`` sharing this world's
+        mailboxes — a NEW rank gets a fresh mailbox, an existing rank id is
+        a restarted life reusing its box (the coord/ membership layer tells
+        those apart by incarnation, not by transport identity)."""
+        self._boxes.setdefault(rank, queue.Queue())
+        return InProcessTransport(rank, self._boxes)
 
     def send(self, code: MessageCode, payload: np.ndarray, dst: int = SERVER_RANK) -> None:
         # Copy: the receiver must never alias the sender's live buffer (e.g.
@@ -229,7 +246,13 @@ class TCPTransport(Transport):
         master: str = "localhost",
         port: int = 29500,
         connect_timeout: float = 60.0,
+        wait_for: Optional[int] = None,
     ):
+        """``wait_for`` (server only) overrides how many worker connections
+        the initial rendezvous blocks for — default ``world_size - 1``. An
+        ELASTIC hub (the coordinator, ``coord/``) passes 0: it must serve
+        the moment it is up, admitting members whenever they dial in;
+        ``world_size`` then only bounds the valid rank space."""
         self.rank = rank
         self.world_size = world_size
         self._inbox: "queue.Queue[Message]" = queue.Queue()
@@ -248,10 +271,12 @@ class TCPTransport(Transport):
             srv.bind((master if master != "localhost" else "", int(port)))
             srv.listen(world_size)
             self._server_sock = srv
-            # block until world_size-1 DISTINCT workers are admitted; garbage
-            # connections (malformed hello) are dropped, not fatal, matching
-            # the native transport's tolerant rendezvous
-            while len(self._peers) < world_size - 1:
+            # block until world_size-1 DISTINCT workers are admitted (or
+            # `wait_for`, for elastic hubs); garbage connections (malformed
+            # hello) are dropped, not fatal, matching the native transport's
+            # tolerant rendezvous
+            need = world_size - 1 if wait_for is None else int(wait_for)
+            while len(self._peers) < need:
                 conn, _addr = srv.accept()
                 try:
                     self._admit_worker(conn)
@@ -451,9 +476,9 @@ class ReliableTransport(Transport):
 
     Negotiation is per transport and symmetric-but-tolerant: both ends of a
     link should wrap (``--reliable``), yet plain frames from an unwrapped
-    peer pass straight through, and :attr:`unreliable_codes` (heartbeats by
-    default — periodic and self-healing) skip the envelope entirely so a
-    dead peer cannot trigger a heartbeat retry storm.
+    peer pass straight through, and :attr:`unreliable_codes` (heartbeats
+    and coord lease renewals by default — periodic and self-healing) skip
+    the envelope entirely so a dead peer cannot trigger a retry storm.
     """
 
     def __init__(
@@ -464,7 +489,8 @@ class ReliableTransport(Transport):
         max_backoff: float = 2.0,
         max_retries: int = 10,
         dedup_window: int = 4096,
-        unreliable_codes: Tuple[MessageCode, ...] = (MessageCode.Heartbeat,),
+        unreliable_codes: Tuple[MessageCode, ...] = (
+            MessageCode.Heartbeat, MessageCode.LeaseRenew),
     ):
         self.inner = inner
         self.rank = inner.rank

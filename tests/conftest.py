@@ -60,6 +60,13 @@ def pytest_configure(config):
         "reliability layer); `make chaos` selects exactly these — fast "
         "seeded cases run in tier-1, soak variants are additionally slow",
     )
+    config.addinivalue_line(
+        "markers",
+        "coord: elastic control-plane tests (coord/ — membership, leases, "
+        "shard rebalancing, speculation); `make coord` selects exactly "
+        "these — fast cases run in tier-1, the wall-clock scenario tests "
+        "are additionally listed in slow_tests.txt",
+    )
 
 
 # Modules whose tests launch real subprocess worlds (interpreter start + jit
