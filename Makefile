@@ -75,9 +75,17 @@ chaos:
 coord:
 	$(PY) -m pytest tests/ -q -m coord
 
-# fast core signal: everything that runs in-process (no subprocess worlds,
-# no end-to-end example trainings) — a couple of minutes on one core
-test:
+# distcheck (analysis/): protocol / concurrency / tracing-hygiene static
+# analysis over the whole package — exits non-zero on any unsuppressed
+# finding that is not in the checked-in baseline. Regenerate the baseline
+# (mirrors the slow_tests.txt workflow) with:
+#   python tests/regen_distcheck_baseline.py
+lint:
+	$(PY) -m distributed_ml_pytorch_tpu.analysis --baseline tests/distcheck_baseline.txt
+
+# fast core signal: distcheck + everything that runs in-process (no
+# subprocess worlds, no end-to-end example trainings) — minutes on one core
+test: lint
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 
 # the whole suite, subprocess worlds included (tens of minutes on one core)
@@ -103,4 +111,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo bench bench-serving bench-all chaos coord test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo bench bench-serving bench-all chaos coord lint test test-all verify-real-data graph install dist

@@ -1,0 +1,33 @@
+"""distcheck — AST-based static analysis for the whole stack (ISSUE 4).
+
+Three checker families over one findings engine:
+
+- ``analysis.wire`` (DC1xx): the ``MessageCode`` registry, the declarative
+  ``WIRE_SCHEMAS`` payload table, and every send/handler site cross-checked
+  package-wide — collisions, sends without handlers, dead handlers,
+  pack/unpack arity drift, and reliability-layer bypasses.
+- ``analysis.concurrency`` (DC2xx): a static lock-acquisition graph plus
+  guarded-by inference across the threaded PS / serving / coord classes —
+  lock-order cycles, attributes mutated or read outside their owning lock,
+  cross-thread shared state with no lock, and thread join/daemon
+  discipline. Cross-validated at runtime by ``analysis.witness``.
+- ``analysis.tracing_hygiene`` (DC3xx): inside jit/shard_map programs —
+  Python branching on traced values, host-state reads frozen at trace
+  time, PRNG key reuse without split/fold_in, donated-buffer reuse.
+
+Run it: ``python -m distributed_ml_pytorch_tpu.analysis`` or ``make lint``.
+Suppress a finding: ``# distcheck: ignore[DC2xx] <required reason>``.
+Baseline: ``tests/distcheck_baseline.txt`` (regen via
+``tests/regen_distcheck_baseline.py``); tier-1 asserts no new findings.
+"""
+
+from distributed_ml_pytorch_tpu.analysis.cli import (  # noqa: F401
+    analyze,
+    analyze_path,
+    main,
+)
+from distributed_ml_pytorch_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Package,
+    load_package,
+)

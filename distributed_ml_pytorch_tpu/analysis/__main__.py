@@ -1,0 +1,5 @@
+import sys
+
+from distributed_ml_pytorch_tpu.analysis.cli import main
+
+sys.exit(main())

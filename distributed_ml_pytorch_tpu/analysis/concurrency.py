@@ -1,0 +1,621 @@
+"""distcheck DC2xx — static concurrency checks over the threaded planes.
+
+The PS, serving and coord planes are all "threads around queues and locks":
+listener/pump/retry/renew threads mutating state the main loop reads. The
+invariants here are machine-checkable from the AST:
+
+- **DC201** — an attribute mutated both under and outside its owning lock.
+  The owning lock is *inferred from majority use*: an attribute with >= 2
+  mutation sites under one ``self``-lock and fewer unguarded ones is
+  treated as guarded-by that lock, and each unguarded mutation is flagged.
+- **DC202** — a cycle in the static lock-acquisition graph. Edges come
+  from lexically nested ``with self.A: … with self.B:`` blocks and from
+  same-class method calls made while a lock is held (transitively closed).
+- **DC203** — a thread created without a join/daemon discipline: neither
+  ``daemon=True`` at construction (directly, or inherited from a
+  ``Thread`` subclass whose ``__init__`` passes it), nor a ``.join(`` in
+  the creating scope. Such threads strand interpreter shutdown.
+- **DC204** — an attribute whose every mutation is under one lock (clearly
+  lock-owned) read without that lock. Reads are where torn state actually
+  escapes — a resize swap observed halfway, a dict iterated mid-update.
+- **DC205** — cross-thread shared state with no lock at all: a class whose
+  method is a ``threading.Thread`` target (directly, via an instance
+  variable, or by subclassing ``Thread``) where an attribute is mutated on
+  one side of the thread boundary and referenced on the other, with no
+  lock anywhere near it.
+
+Noise control, so the checks stay sharp on this codebase's idioms:
+``__init__`` never counts (construction happens-before the thread start);
+attributes held in thread-safe containers (``Event``/``Queue``/``Lock``/
+``Condition``/``Semaphore``/``deque``) are exempt; attributes only ever
+assigned boolean constants are exempt from DC205 (a monotonic flag store
+is atomic under the GIL — the revive/degrade flags are this on purpose);
+and any attribute with at least one guarded access is left to the
+sharper DC201/DC204 rules instead of DC205.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from distributed_ml_pytorch_tpu.analysis.core import (
+    Finding,
+    Package,
+    SourceFile,
+    call_name,
+    self_attr,
+    walk_list,
+)
+
+#: method names that mutate their receiver in place
+MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "discard", "remove", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "push", "heappush",
+})
+
+#: constructors whose instances are safe to share without a lock
+_SAFE_CTORS = frozenset({
+    "Event", "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue", "Lock",
+    "RLock", "Condition", "Semaphore", "BoundedSemaphore", "Barrier",
+    "deque", "local",
+})
+
+_LOCK_CTORS = frozenset({"Lock", "RLock"})
+
+
+@dataclasses.dataclass
+class Access:
+    attr: str
+    line: int
+    locks: frozenset  # self-lock attrs held at this point
+    method: str
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    path: str
+    line: int
+    src: SourceFile
+    bases: List[str]
+    methods: Dict[str, ast.FunctionDef] = dataclasses.field(default_factory=dict)
+    lock_attrs: Dict[str, int] = dataclasses.field(default_factory=dict)
+    safe_attrs: Set[str] = dataclasses.field(default_factory=set)
+    bool_attrs: Set[str] = dataclasses.field(default_factory=set)
+    nonbool_assigned: Set[str] = dataclasses.field(default_factory=set)
+    mutations: List[Access] = dataclasses.field(default_factory=list)
+    reads: List[Access] = dataclasses.field(default_factory=list)
+    #: method → same-class methods it calls
+    calls: Dict[str, Set[str]] = dataclasses.field(default_factory=dict)
+    #: (held_locks, acquired_lock, line) triples for the lock graph
+    acquires: List[Tuple[frozenset, str, int]] = dataclasses.field(
+        default_factory=list)
+    #: (held_locks, called_method, line) for transitive lock-graph edges
+    held_calls: List[Tuple[frozenset, str, int]] = dataclasses.field(
+        default_factory=list)
+    #: methods driven by a thread (Thread targets / Thread-subclass run)
+    thread_entries: Set[str] = dataclasses.field(default_factory=set)
+    daemonic: bool = False  # Thread subclass passing daemon=True upward
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    return call_name(node) == "Thread"
+
+
+def _is_thread_join(node: ast.AST) -> bool:
+    """A ``.join(...)`` call that plausibly joins a THREAD — not
+    ``", ".join(parts)``. String receivers (constants, f-strings) are
+    excluded, and any positional argument must look like a timeout (a
+    numeric constant), since ``str.join`` always takes an iterable."""
+    if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"):
+        return False
+    if isinstance(node.func.value, (ast.Constant, ast.JoinedStr)):
+        return False
+    if len(node.args) > 1:
+        return False
+    if node.args:
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, (int, float))):
+            return False
+    return all(kw.arg == "timeout" for kw in node.keywords)
+
+
+def _with_lock_attr(item: ast.withitem, lock_attrs: Dict[str, int]) -> Optional[str]:
+    attr = self_attr(item.context_expr)
+    if attr is not None and attr in lock_attrs:
+        return attr
+    return None
+
+
+class _MethodWalker(ast.NodeVisitor):
+    """Collect accesses/locks/calls for one method body."""
+
+    def __init__(self, info: ClassInfo, method: str):
+        self.info = info
+        self.method = method
+        self.held: Tuple[str, ...] = ()
+
+    # ----------------------------------------------------------- lock scope
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            lock = _with_lock_attr(item, self.info.lock_attrs)
+            if lock is not None:
+                self.info.acquires.append(
+                    (frozenset(self.held), lock, node.lineno))
+                acquired.append(lock)
+            else:
+                self.visit(item.context_expr)
+        self.held = self.held + tuple(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        if acquired:
+            self.held = self.held[: len(self.held) - len(acquired)]
+
+    # ------------------------------------------------------------ accesses
+    def _note_mut(self, attr: str, line: int) -> None:
+        self.info.mutations.append(
+            Access(attr, line, frozenset(self.held), self.method))
+
+    def _note_read(self, attr: str, line: int) -> None:
+        self.info.reads.append(
+            Access(attr, line, frozenset(self.held), self.method))
+
+    def _mut_target(self, target: ast.expr) -> None:
+        attr = self_attr(target)
+        if attr is not None:
+            self._note_mut(attr, target.lineno)
+            return
+        if isinstance(target, ast.Subscript):
+            self._mut_target(target.value)
+        elif isinstance(target, ast.Attribute):
+            # self.a.b = … mutates the object held in self.a
+            base = self_attr(target.value)
+            if base is not None:
+                self._note_mut(base, target.lineno)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._mut_target(elt)
+        elif isinstance(target, ast.Starred):
+            self._mut_target(target.value)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            self._mut_target(target)
+        # track bool-flag attrs (exempted from DC205 as GIL-atomic stores)
+        is_bool = isinstance(node.value, ast.Constant) and \
+            isinstance(node.value.value, bool)
+        for target in node.targets:
+            attr = self_attr(target)
+            if attr is not None:
+                if is_bool:
+                    self.info.bool_attrs.add(attr)
+                else:
+                    self.info.nonbool_assigned.add(attr)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._mut_target(node.target)
+        attr = self_attr(node.target)
+        if attr is not None:
+            self.info.nonbool_assigned.add(attr)
+        self.visit(node.value)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in MUTATORS:
+                base = self_attr(node.func.value)
+                if base is not None:
+                    self._note_mut(base, node.lineno)
+                elif isinstance(node.func.value, ast.Attribute):
+                    root = self_attr(node.func.value.value)
+                    if root is not None:
+                        self._note_mut(root, node.lineno)
+            # same-class method call: self.m(...)
+            target = self_attr(node.func)
+            if target is not None and target in self.info.methods:
+                self.info.calls.setdefault(self.method, set()).add(target)
+                self.info.held_calls.append(
+                    (frozenset(self.held), target, node.lineno))
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Load):
+            self._note_read(attr, node.lineno)
+        self.generic_visit(node)
+
+    # nested defs (listener closures): same thread context as creator —
+    # unless they are Thread targets, which collect() handles separately
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self.visit(node.body)
+
+
+def _collect_class(src: SourceFile, node: ast.ClassDef) -> ClassInfo:
+    info = ClassInfo(
+        name=node.name, path=src.path, line=node.lineno, src=src,
+        bases=[b.attr if isinstance(b, ast.Attribute) else
+               b.id if isinstance(b, ast.Name) else "" for b in node.bases])
+    for stmt in node.body:
+        if isinstance(stmt, ast.FunctionDef):
+            info.methods[stmt.name] = stmt
+    # first pass: lock / safe attrs (any method, __init__ included)
+    for name, fn in info.methods.items():
+        for sub in walk_list(fn):
+            if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+                ctor = call_name(sub.value)
+                for target in sub.targets:
+                    attr = self_attr(target)
+                    if attr is None:
+                        continue
+                    if ctor in _LOCK_CTORS:
+                        info.lock_attrs[attr] = sub.lineno
+                    if ctor in _SAFE_CTORS:
+                        info.safe_attrs.add(attr)
+    # Thread subclass passing daemon=True to super().__init__
+    init = info.methods.get("__init__")
+    if init is not None:
+        for sub in walk_list(init):
+            if isinstance(sub, ast.Call) and call_name(sub) == "__init__":
+                if any(kw.arg == "daemon" and
+                       isinstance(kw.value, ast.Constant) and
+                       kw.value.value is True for kw in sub.keywords):
+                    info.daemonic = True
+    # second pass: accesses per method (construction is happens-before)
+    for name, fn in info.methods.items():
+        if name in ("__init__", "__post_init__"):
+            continue
+        walker = _MethodWalker(info, name)
+        for stmt in fn.body:
+            walker.visit(stmt)
+    return info
+
+
+def _merge_inherited(classes: Dict[str, ClassInfo]) -> None:
+    """Pull package-internal base-class state into subclasses so closure
+    and guarded-by analysis see inherited methods (Listener ← MessageListener)."""
+    def bases_of(info: ClassInfo) -> List[ClassInfo]:
+        return [classes[b] for b in info.bases if b in classes]
+
+    # simple one-level-at-a-time fixpoint (hierarchies here are shallow)
+    for _ in range(3):
+        for info in classes.values():
+            for base in bases_of(info):
+                for name, fn in base.methods.items():
+                    info.methods.setdefault(name, fn)
+                info.lock_attrs.update(
+                    {k: v for k, v in base.lock_attrs.items()
+                     if k not in info.lock_attrs})
+                info.safe_attrs |= base.safe_attrs
+                info.bool_attrs |= base.bool_attrs
+                info.nonbool_assigned |= base.nonbool_assigned
+                for acc in base.mutations:
+                    if acc not in info.mutations:
+                        info.mutations.append(acc)
+                for acc in base.reads:
+                    if acc not in info.reads:
+                        info.reads.append(acc)
+                for m, callees in base.calls.items():
+                    info.calls.setdefault(m, set()).update(callees)
+
+
+def _is_thread_subclass(info: ClassInfo, classes: Dict[str, ClassInfo]) -> bool:
+    seen = set()
+    stack = [info]
+    while stack:
+        cur = stack.pop()
+        if cur.name in seen:
+            continue
+        seen.add(cur.name)
+        for base in cur.bases:
+            if base == "Thread":
+                return True
+            if base in classes:
+                stack.append(classes[base])
+    return False
+
+
+def _class_daemonic(info: ClassInfo, classes: Dict[str, ClassInfo]) -> bool:
+    seen = set()
+    stack = [info]
+    while stack:
+        cur = stack.pop()
+        if cur.name in seen:
+            continue
+        seen.add(cur.name)
+        if cur.daemonic:
+            return True
+        stack.extend(classes[b] for b in cur.bases if b in classes)
+    return False
+
+
+def _find_thread_targets(
+    pkg: Package, classes: Dict[str, ClassInfo]
+) -> List[Finding]:
+    """Register thread-entry methods on their classes and run the DC203
+    join/daemon-discipline check over every Thread construction."""
+    findings: List[Finding] = []
+    for src in pkg:
+        for node in walk_list(src.tree):
+            if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+                continue
+            # the construction's scope: innermost function, else the module
+            scope = _enclosing_function(src, node) or src.tree
+            # local variable → class-name map (srv = ElasticShardServer(...))
+            var_class: Dict[str, str] = {}
+            for sub in walk_list(scope):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                        and isinstance(sub.targets[0], ast.Name) \
+                        and isinstance(sub.value, ast.Call):
+                    ctor = call_name(sub.value)
+                    if ctor in classes:
+                        var_class[sub.targets[0].id] = ctor
+            has_join = any(_is_thread_join(n) for n in walk_list(scope))
+            target = next(
+                (kw.value for kw in node.keywords if kw.arg == "target"),
+                None)
+            daemon = any(
+                kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True for kw in node.keywords)
+            if target is not None:
+                if isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name):
+                    owner = target.value.id
+                    if owner == "self":
+                        # .get: function-local classes are not in the
+                        # top-level table — their threads still get the
+                        # DC203 check below, just no DC205 closure
+                        cls = _enclosing_class(src, node)
+                        info = classes.get(cls) if cls is not None else None
+                        if info is not None:
+                            info.thread_entries.add(target.attr)
+                    elif owner in var_class:
+                        classes[var_class[owner]].thread_entries.add(
+                            target.attr)
+            if not daemon and not has_join:
+                findings.append(Finding(
+                    src.path, node.lineno, "DC203",
+                    "thread created without daemon=True or a join() in "
+                    "the creating scope — it will strand interpreter "
+                    "shutdown"))
+        # Thread-subclass instantiations: daemon discipline by construction?
+        for node in walk_list(src.tree):
+            if isinstance(node, ast.Call):
+                ctor = call_name(node)
+                info = classes.get(ctor)
+                if info is not None and _is_thread_subclass(info, classes) \
+                        and not _class_daemonic(info, classes):
+                    daemon = any(
+                        kw.arg == "daemon" and
+                        isinstance(kw.value, ast.Constant) and
+                        kw.value.value is True for kw in node.keywords)
+                    enclosing = _enclosing_function(src, node)
+                    has_join = enclosing is not None and any(
+                        _is_thread_join(n) for n in walk_list(enclosing))
+                    if not daemon and not has_join:
+                        findings.append(Finding(
+                            src.path, node.lineno, "DC203",
+                            f"{ctor} (a Thread subclass that does not set "
+                            "daemon=True) created without daemon=True or a "
+                            "join() in the creating scope"))
+    # Thread subclasses: run() is a thread entry
+    for info in classes.values():
+        if _is_thread_subclass(info, classes) and "run" in info.methods:
+            info.thread_entries.add("run")
+    return findings
+
+
+def _enclosing_class(src: SourceFile, node: ast.AST) -> Optional[str]:
+    for cls in walk_list(src.tree):
+        if isinstance(cls, ast.ClassDef) and \
+                cls.lineno <= node.lineno <= (cls.end_lineno or cls.lineno):
+            return cls.name
+    return None
+
+
+def _enclosing_function(src: SourceFile, node: ast.AST) -> Optional[ast.AST]:
+    best = None
+    for fn in walk_list(src.tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                fn.lineno <= node.lineno <= (fn.end_lineno or fn.lineno):
+            if best is None or fn.lineno > best.lineno:
+                best = fn
+    return best
+
+
+def _closure(info: ClassInfo, roots: Set[str]) -> Set[str]:
+    out = set()
+    stack = list(roots)
+    while stack:
+        m = stack.pop()
+        if m in out:
+            continue
+        out.add(m)
+        stack.extend(info.calls.get(m, ()))
+    return out
+
+
+def collect_lock_sites(pkg: Package) -> Set[Tuple[str, int]]:
+    """(path, line) of every ``threading.Lock()/RLock()`` creation — the
+    runtime witness cross-validates its observed locks against this."""
+    sites: Set[Tuple[str, int]] = set()
+    for src in pkg:
+        for node in walk_list(src.tree):
+            if isinstance(node, ast.Call) and call_name(node) in _LOCK_CTORS:
+                chain = node.func
+                base = chain.value if isinstance(chain, ast.Attribute) else None
+                if base is None or (isinstance(base, ast.Name)
+                                    and base.id == "threading"):
+                    sites.add((src.path, node.lineno))
+    return sites
+
+
+def check(pkg: Package) -> List[Finding]:
+    classes: Dict[str, ClassInfo] = {}
+    for src in pkg:
+        for node in src.tree.body:
+            if isinstance(node, ast.ClassDef):
+                classes[node.name] = _collect_class(src, node)
+    findings = _find_thread_targets(pkg, classes)
+    _merge_inherited(classes)
+
+    for info in classes.values():
+        findings.extend(_check_guarded_by(info))
+        findings.extend(_check_lock_cycles(info))
+        if info.thread_entries:
+            findings.extend(_check_cross_thread(info))
+    return findings
+
+
+def _check_guarded_by(info: ClassInfo) -> List[Finding]:
+    """DC201 (mixed mutations) and DC204 (unguarded reads of owned attrs)."""
+    findings: List[Finding] = []
+    attrs = {a.attr for a in info.mutations}
+    for attr in sorted(attrs):
+        if attr in info.lock_attrs or attr in info.safe_attrs:
+            continue
+        muts = [a for a in info.mutations if a.attr == attr]
+        by_lock: Dict[str, List[Access]] = {}
+        unguarded = []
+        for a in muts:
+            if a.locks:
+                for lock in a.locks:
+                    by_lock.setdefault(lock, []).append(a)
+            else:
+                unguarded.append(a)
+        if not by_lock:
+            continue
+        owner, owned = max(by_lock.items(), key=lambda kv: len(kv[1]))
+        if len(owned) >= 2 and unguarded and len(owned) > len(unguarded):
+            for a in unguarded:
+                findings.append(Finding(
+                    info.path, a.line, "DC201",
+                    f"{info.name}.{attr} is mutated here without "
+                    f"{info.name}.{owner}, which guards its other "
+                    f"{len(owned)} mutation site(s)"))
+        if len(owned) >= 2 and not unguarded:
+            mut_lines = {(a.line, a.attr) for a in muts}
+            for r in info.reads:
+                if r.attr != attr or owner in r.locks:
+                    continue
+                if (r.line, r.attr) in mut_lines:
+                    continue  # the read half of a guarded mutation
+                findings.append(Finding(
+                    info.path, r.line, "DC204",
+                    f"{info.name}.{attr} is lock-owned (every mutation "
+                    f"holds {info.name}.{owner}) but this read does not "
+                    "hold it — torn/stale state can escape here"))
+    return findings
+
+
+def _check_lock_cycles(info: ClassInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    # locks acquired anywhere inside each method (acquire records don't
+    # carry the method name — recover it via the method's line range)
+    acquired_in: Dict[str, Set[str]] = {m: set() for m in info.methods}
+    for m, fn in info.methods.items():
+        lo, hi = fn.lineno, fn.end_lineno or fn.lineno
+        for _held, lock, line in info.acquires:
+            if lo <= line <= hi:
+                acquired_in[m].add(lock)
+    # transitive closure through same-class calls
+    changed = True
+    while changed:
+        changed = False
+        for m, callees in info.calls.items():
+            for c in callees:
+                extra = acquired_in.get(c, set()) - acquired_in.get(m, set())
+                if extra:
+                    acquired_in.setdefault(m, set()).update(extra)
+                    changed = True
+    edges: Dict[Tuple[str, str], int] = {}
+    for held, lock, line in info.acquires:
+        for h in held:
+            if h != lock:
+                edges.setdefault((h, lock), line)
+    for held, callee, line in info.held_calls:
+        for lock in acquired_in.get(callee, ()):
+            for h in held:
+                if h != lock:
+                    edges.setdefault((h, lock), line)
+    # cycle detection over the small per-class graph
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+
+    def reachable(frm: str, to: str) -> bool:
+        stack, seen = [frm], set()
+        while stack:
+            cur = stack.pop()
+            if cur == to:
+                return True
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(graph.get(cur, ()))
+        return False
+
+    for (a, b), line in sorted(edges.items(), key=lambda kv: kv[1]):
+        if reachable(b, a):
+            findings.append(Finding(
+                info.path, line, "DC202",
+                f"lock-order cycle: {info.name}.{a} is held while "
+                f"acquiring {info.name}.{b}, and elsewhere {info.name}.{b} "
+                f"is held while (transitively) acquiring {info.name}.{a} — "
+                "two threads taking the two orders deadlock"))
+    return findings
+
+
+def _check_cross_thread(info: ClassInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    thread_side = _closure(info, set(info.thread_entries))
+    guarded_attrs = {
+        a.attr for a in info.mutations + info.reads if a.locks}
+    mut_by_method: Dict[str, Set[str]] = {}
+    ref_by_method: Dict[str, Set[str]] = {}
+    for a in info.mutations:
+        mut_by_method.setdefault(a.method, set()).add(a.attr)
+    for a in info.reads + info.mutations:
+        ref_by_method.setdefault(a.method, set()).add(a.attr)
+    other_methods = [
+        m for m in info.methods
+        if m not in thread_side and m not in ("__init__", "__post_init__")]
+
+    def closure_attrs(table, roots):
+        out: Set[str] = set()
+        for m in _closure(info, set(roots)):
+            out |= table.get(m, set())
+        return out
+
+    t_mut = closure_attrs(mut_by_method, thread_side)
+    t_ref = closure_attrs(ref_by_method, thread_side)
+    flagged: Set[str] = set()
+    for m in sorted(other_methods):
+        o_mut = closure_attrs(mut_by_method, {m})
+        o_ref = closure_attrs(ref_by_method, {m})
+        for attr in sorted((t_mut & o_ref) | (o_mut & t_ref)):
+            if attr in flagged or attr in guarded_attrs or \
+                    attr in info.lock_attrs or attr in info.safe_attrs:
+                continue
+            if attr in info.bool_attrs and attr not in info.nonbool_assigned:
+                continue  # pure boolean flag: GIL-atomic store, monotonic
+            flagged.add(attr)
+            fn = info.methods[m]
+            findings.append(Finding(
+                info.path, fn.lineno, "DC205",
+                f"{info.name}.{attr} is shared across the thread boundary "
+                f"(thread entry {sorted(info.thread_entries)}) and touched "
+                f"by {m}() with no lock anywhere — guard it or document "
+                "why the race is benign"))
+    return findings

@@ -1,0 +1,296 @@
+"""distcheck core — findings, suppressions, and the analyzed-package model.
+
+The analyzer is a pure function of source text: every checker works on the
+``ast`` of the package files, never on imported runtime objects, so the same
+engine runs over the real tree and over the seeded-bug fixture corpora in
+``tests/test_distcheck.py`` (a checker that needed to import its target
+could not be tested against deliberately-broken twins).
+
+Vocabulary:
+
+- :class:`Finding` — one diagnostic, with a stable per-checker code
+  (``DC1xx`` wire protocol, ``DC2xx`` concurrency, ``DC3xx`` tracing
+  hygiene, ``DC0xx`` for the analyzer's own hygiene rules). The
+  :meth:`~Finding.baseline_key` deliberately omits the line number so the
+  checked-in baseline survives unrelated edits above a finding.
+- Suppressions — ``# distcheck: ignore[DC201] <reason>`` on the flagged
+  line or the line directly above it. The reason is REQUIRED: a bare
+  ignore is itself a finding (DC001), and a suppression that matches
+  nothing is flagged too (DC002) so stale ignores rot away instead of
+  hiding future regressions.
+- :class:`SourceFile` / :class:`Package` — parsed files plus the repo-
+  relative paths every finding and baseline entry is keyed by.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One diagnostic: ``path:line: CODE message``."""
+
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+    def baseline_key(self) -> str:
+        """Line-number-free identity used by the checked-in baseline (a
+        finding that merely moved is not 'new')."""
+        return f"{self.path} | {self.code} | {self.message}"
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*distcheck:\s*ignore\[([A-Za-z0-9_,\s]+)\]\s*(.*)$")
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int
+    codes: Tuple[str, ...]
+    reason: str
+    end_line: int = 0  # last line of the contiguous comment block
+    used: bool = False
+
+    def covers(self, line: int) -> bool:
+        """A suppression silences findings on its own line(s) and on the
+        first code line after its comment block."""
+        return self.line <= line <= max(self.end_line, self.line) + 1
+
+
+class SourceFile:
+    """One parsed source file: AST + suppression comments + plane."""
+
+    def __init__(self, path: str, abspath: str, text: str):
+        self.path = path  # repo-relative, forward slashes (baseline key)
+        self.abspath = abspath
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=abspath)
+        # suppressions come from real COMMENT tokens only — the same text
+        # inside a docstring (e.g. documentation of the syntax) is not one
+        self.suppressions: Dict[int, Suppression] = {}
+        comment_lines = set()
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                comment_lines.add(tok.start[0])
+                m = _SUPPRESS_RE.search(tok.string)
+                if m:
+                    codes = tuple(
+                        c.strip() for c in m.group(1).split(",") if c.strip())
+                    self.suppressions[tok.start[0]] = Suppression(
+                        line=tok.start[0], codes=codes,
+                        reason=m.group(2).strip(" -—:\t"))
+        except tokenize.TokenError:
+            pass  # unterminated constructs: AST parse above already raised
+        # a multi-line suppression comment covers its whole block: the
+        # reason may wrap, and the silenced line is the first CODE line
+        # after the block
+        for sup in self.suppressions.values():
+            end = sup.line
+            while end + 1 in comment_lines:
+                end += 1
+            sup.end_line = end
+
+    @property
+    def plane(self) -> str:
+        return plane_of(self.path)
+
+
+def plane_of(path: str) -> str:
+    """Module path → protocol plane (which side of the wire it serves)."""
+    parts = path.replace(os.sep, "/").split("/")
+    for part in parts[:-1]:
+        if part == "serving":
+            return "serving"
+        if part == "coord":
+            return "coord"
+        if part in ("parallel", "training"):
+            return "ps"
+        if part in ("utils", "native"):
+            return "transport"
+    return "misc"
+
+
+class Package:
+    """The set of files one analyzer run covers."""
+
+    def __init__(self, files: Sequence[SourceFile]):
+        self.files = list(files)
+
+    def __iter__(self):
+        return iter(self.files)
+
+
+def load_package(root: str, rel_base: Optional[str] = None) -> Package:
+    """Parse every ``*.py`` under ``root`` (a package directory).
+
+    Paths are reported relative to ``rel_base`` (default: the parent of
+    ``root``), so findings over the installed package read
+    ``distributed_ml_pytorch_tpu/utils/messaging.py:…``.
+    """
+    root = os.path.abspath(root)
+    base = os.path.abspath(rel_base) if rel_base else os.path.dirname(root)
+    files = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d != "__pycache__" and not d.startswith("."))
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            abspath = os.path.join(dirpath, name)
+            rel = os.path.relpath(abspath, base).replace(os.sep, "/")
+            with open(abspath, "r", encoding="utf-8") as fh:
+                text = fh.read()
+            files.append(SourceFile(rel, abspath, text))
+    return Package(files)
+
+
+def apply_suppressions(
+    pkg: Package, findings: Iterable[Finding]
+) -> Tuple[List[Finding], List[Finding]]:
+    """Split findings into (active, suppressed) and append the analyzer's
+    own hygiene findings: DC001 (suppression without a reason) and DC002
+    (suppression that matched nothing)."""
+    by_path = {f.path: f for f in pkg.files}
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    for finding in sorted(findings):
+        src = by_path.get(finding.path)
+        sup = None
+        if src is not None:
+            for cand in src.suppressions.values():
+                if finding.code in cand.codes and cand.covers(finding.line):
+                    sup = cand
+                    break
+        if sup is not None and sup.reason:
+            sup.used = True
+            suppressed.append(finding)
+        else:
+            if sup is not None:
+                sup.used = True  # matched, but unusable: DC001 below says why
+            active.append(finding)
+    for src in pkg.files:
+        for sup in src.suppressions.values():
+            if not sup.reason:
+                active.append(Finding(
+                    src.path, sup.line, "DC001",
+                    "suppression without a reason — write WHY after the "
+                    "bracket: # distcheck: ignore[%s] <reason>"
+                    % ",".join(sup.codes)))
+            elif not sup.used:
+                active.append(Finding(
+                    src.path, sup.line, "DC002",
+                    "unused suppression for %s — the finding it silenced is "
+                    "gone; delete the comment" % ",".join(sup.codes)))
+    return sorted(active), suppressed
+
+
+def baseline_keys(findings: Sequence[Finding]) -> List[str]:
+    """Baseline keys for a (sorted) finding list, with duplicates numbered.
+
+    Several findings in one file can share a constant message (two
+    undisciplined threads, two ``.inner`` bypasses); numbering the 2nd+
+    occurrence (``… | #2``) means a parked baseline entry covers exactly
+    ONE occurrence — a new instance of the same defect still fails lint.
+    The first occurrence keeps the plain key, so removing a duplicate
+    never invalidates the surviving entry."""
+    counts: Dict[str, int] = {}
+    out = []
+    for f in findings:
+        base = f.baseline_key()
+        n = counts.get(base, 0) + 1
+        counts[base] = n
+        out.append(base if n == 1 else f"{base} | #{n}")
+    return out
+
+
+def read_baseline(path: str) -> frozenset:
+    if not path or not os.path.exists(path):
+        return frozenset()
+    with open(path) as fh:
+        return frozenset(
+            line.strip() for line in fh
+            if line.strip() and not line.startswith("#"))
+
+
+# --------------------------------------------------------------- AST helpers
+
+def walk_list(node: ast.AST) -> list:
+    """``list(ast.walk(node))`` memoized ON the node — the checkers walk
+    the same functions many times (sends, locals, handlers, locks), and
+    without the cache a package run re-traverses ~70x. The cache rides the
+    node's ``__dict__``, so it lives exactly as long as the tree."""
+    cached = getattr(node, "_distcheck_walk", None)
+    if cached is None:
+        cached = list(ast.walk(node))
+        try:
+            node._distcheck_walk = cached
+        except AttributeError:
+            pass  # nodes without __dict__: walk uncached
+    return cached
+
+
+def call_name(node: ast.Call) -> str:
+    """Last dotted segment of a call target (``jax.jit`` → ``jit``)."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Render a Name/Attribute chain (``np.random.default_rng``); empty
+    string when the expression is not a plain dotted chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def const_int(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = const_int(node.operand)
+        return None if inner is None else -inner
+    return None
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` → ``"X"``; anything else → None."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def message_code_names(node: ast.AST) -> List[Tuple[str, int]]:
+    """Every ``MessageCode.<Name>`` attribute inside ``node`` with its line."""
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and \
+                isinstance(sub.value, ast.Name) and \
+                sub.value.id == "MessageCode":
+            out.append((sub.attr, sub.lineno))
+    return out

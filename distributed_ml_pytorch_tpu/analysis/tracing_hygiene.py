@@ -1,0 +1,382 @@
+"""distcheck DC3xx — tracing hygiene inside jit/shard_map programs.
+
+A traced function runs ONCE at trace time; anything Python-level inside it
+is baked into the compiled program. The PR-3 dp×pp×tp divergence was
+exactly this class of bug (sharding-dependent init under a traced code
+path), and these checks make the discipline mechanical:
+
+- **DC301** — Python branching (``if``/``while``) on a traced value.
+  Tracing either crashes (TracerBoolConversionError) or, worse, silently
+  specializes on the tracer's first value. Shape-derived tests
+  (``x.shape``, ``x.ndim``, ``len(x)``, ``is None``, ``isinstance``) are
+  static and exempt.
+- **DC302** — host-state reads (``time.*``, ``random.*``, ``np.random.*``,
+  ``datetime.*``, ``os.environ``/``os.getenv``) inside a traced function:
+  the value observed at trace time is frozen into every execution.
+- **DC303** — a PRNG key consumed by more than one ``jax.random`` sampler
+  without an intervening ``split``/``fold_in``: identical randomness where
+  independence was intended.
+- **DC304** — a buffer passed at a ``donate_argnums`` position used again
+  after the call: donation invalidates the buffer; XLA may have already
+  reused its memory.
+
+Traced functions are found structurally: ``@jax.jit`` / ``@jit`` /
+``@partial(jax.jit, …)`` decorations, ``jax.jit(f, …)`` /
+``jax.shard_map(f, …)`` wrapping of a locally defined ``f``, and every
+``def`` nested inside a traced function (scan bodies, loss closures).
+Parameters listed in ``static_argnums`` are not traced. Taint propagates
+through simple assignments; anything derived from ``.shape``/``len`` is
+demoted back to static.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from distributed_ml_pytorch_tpu.analysis.core import (
+    Finding,
+    Package,
+    SourceFile,
+    call_name,
+    const_int,
+    dotted_name,
+    walk_list,
+)
+
+#: jax.random functions that DERIVE keys (consuming none of the stream)
+_KEY_DERIVERS = frozenset({
+    "key", "PRNGKey", "split", "fold_in", "wrap_key_data", "key_data",
+    "clone",
+})
+
+#: dotted prefixes whose calls read host state
+_HOST_STATE_PREFIXES = (
+    "time.", "random.", "datetime.", "np.random.", "numpy.random.",
+)
+_HOST_STATE_CALLS = frozenset({"os.getenv", "os.environ.get", "open"})
+
+_KEY_PARAM_HINTS = ("rng", "key", "prng")
+
+
+def _jit_call_info(call: ast.Call) -> Optional[dict]:
+    """``jax.jit`` / ``partial(jax.jit, …)`` call → its static/donate
+    argnums (literal tuples/ints only); None if not a jit expression."""
+    name = dotted_name(call.func)
+    args = list(call.args)
+    if name in ("partial", "functools.partial") and args:
+        inner = dotted_name(args[0])
+        if inner in ("jax.jit", "jit"):
+            return _argnums(call.keywords)
+        return None
+    if name in ("jax.jit", "jit"):
+        return _argnums(call.keywords)
+    return None
+
+
+def _argnums(keywords) -> dict:
+    out = {"static": set(), "donate": set()}
+    for kw in keywords:
+        if kw.arg not in ("static_argnums", "donate_argnums"):
+            continue
+        key = "static" if kw.arg == "static_argnums" else "donate"
+        val = kw.value
+        if isinstance(val, (ast.Tuple, ast.List)):
+            for e in val.elts:
+                n = const_int(e)
+                if n is not None:
+                    out[key].add(n)
+        else:
+            n = const_int(val)
+            if n is not None:
+                out[key].add(n)
+    return out
+
+
+class TracedFn:
+    def __init__(self, fn: ast.FunctionDef, static: Set[int],
+                 donate: Set[int], outer_taint: Set[str]):
+        self.fn = fn
+        self.static = static
+        self.donate = donate
+        self.outer_taint = outer_taint
+
+    @property
+    def param_names(self) -> List[str]:
+        return [a.arg for a in self.fn.args.args]
+
+    def traced_params(self) -> Set[str]:
+        return {name for i, name in enumerate(self.param_names)
+                if i not in self.static}
+
+
+def find_traced(src: SourceFile) -> List[TracedFn]:
+    """Every traced function in a file (decorated, wrapped, or nested)."""
+    defs: Dict[str, ast.FunctionDef] = {}
+    for node in walk_list(src.tree):
+        if isinstance(node, ast.FunctionDef):
+            defs.setdefault(node.name, node)
+    traced: Dict[ast.FunctionDef, TracedFn] = {}
+
+    def mark(fn: ast.FunctionDef, static=(), donate=(), outer=frozenset()):
+        if fn not in traced:
+            traced[fn] = TracedFn(fn, set(static), set(donate), set(outer))
+
+    for node in walk_list(src.tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    info = _jit_call_info(dec)
+                    if info is not None:
+                        mark(node, info["static"], info["donate"])
+                elif dotted_name(dec) in ("jax.jit", "jit"):
+                    mark(node)
+        if isinstance(node, ast.Call):
+            info = _jit_call_info(node)
+            wrapped = None
+            if info is not None and node.args:
+                wrapped = node.args[0]
+            elif dotted_name(node.func) in ("jax.shard_map", "shard_map") \
+                    and node.args:
+                wrapped, info = node.args[0], {"static": set(), "donate": set()}
+            if wrapped is None:
+                continue
+            # unwrap jax.jit(jax.shard_map(f, …), …)
+            while isinstance(wrapped, ast.Call) and dotted_name(
+                    wrapped.func) in ("jax.shard_map", "shard_map") \
+                    and wrapped.args:
+                wrapped = wrapped.args[0]
+            if isinstance(wrapped, ast.Name) and wrapped.id in defs:
+                mark(defs[wrapped.id], info["static"], info["donate"])
+
+    # nested defs inside traced functions are traced with the outer taint
+    frontier = list(traced.values())
+    while frontier:
+        tf = frontier.pop()
+        outer = tf.traced_params() | tf.outer_taint
+        for node in walk_list(tf.fn):
+            if isinstance(node, ast.FunctionDef) and node is not tf.fn \
+                    and node not in traced:
+                inner = TracedFn(node, set(), set(), set(outer))
+                traced[node] = inner
+                frontier.append(inner)
+    return list(traced.values())
+
+
+def _shape_derived(expr: ast.expr) -> bool:
+    """Static even when built from traced names: shapes, dims, lengths."""
+    for node in walk_list(expr):
+        if isinstance(node, ast.Attribute) and node.attr in (
+                "shape", "ndim", "dtype"):
+            return True
+        if isinstance(node, ast.Call) and call_name(node) in (
+                "len", "isinstance", "hasattr", "type"):
+            return True
+    return False
+
+
+def _is_none_test(test: ast.expr) -> bool:
+    return isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+
+
+def _names(expr: ast.expr) -> Set[str]:
+    return {n.id for n in walk_list(expr) if isinstance(n, ast.Name)}
+
+
+def _check_one(src: SourceFile, tf: TracedFn) -> List[Finding]:
+    findings: List[Finding] = []
+    fn = tf.fn
+    taint = tf.traced_params() | set(tf.outer_taint)
+    keys: Set[str] = {
+        name for name in tf.traced_params()
+        if any(h in name.lower() for h in _KEY_PARAM_HINTS)}
+    consumed: Dict[str, int] = {}
+
+    nested = {n for n in walk_list(fn)
+              if isinstance(n, ast.FunctionDef) and n is not fn}
+    nested_spans = [(n.lineno, n.end_lineno or n.lineno) for n in nested]
+
+    def skip(node: ast.AST) -> bool:
+        # nested defs are their own TracedFn — don't double-report
+        return any(lo < node.lineno <= hi or
+                   (lo == node.lineno and isinstance(node, ast.FunctionDef))
+                   for lo, hi in nested_spans)
+
+    for node in walk_list(fn):
+        if node is fn or not hasattr(node, "lineno") or skip(node):
+            continue
+        # --- taint propagation through simple assignments
+        if isinstance(node, ast.Assign):
+            rhs_tainted = bool(_names(node.value) & taint) and \
+                not _shape_derived(node.value)
+            for target in node.targets:
+                for name_node in walk_list(target):
+                    if isinstance(name_node, ast.Name):
+                        if rhs_tainted:
+                            taint.add(name_node.id)
+                        else:
+                            taint.discard(name_node.id)
+                        consumed.pop(name_node.id, None)
+                        keys.discard(name_node.id)
+            if isinstance(node.value, ast.Call) and \
+                    dotted_name(node.value.func).startswith("jax.random."):
+                der = dotted_name(node.value.func).rsplit(".", 1)[-1]
+                if der in _KEY_DERIVERS:
+                    for target in node.targets:
+                        for name_node in walk_list(target):
+                            if isinstance(name_node, ast.Name):
+                                keys.add(name_node.id)
+        # --- DC301: Python control flow on traced values
+        if isinstance(node, (ast.If, ast.While)):
+            test = node.test
+            if _names(test) & taint and not _shape_derived(test) \
+                    and not _is_none_test(test):
+                findings.append(Finding(
+                    src.path, node.lineno, "DC301",
+                    f"Python {'while' if isinstance(node, ast.While) else 'if'}"
+                    " on a traced value inside a jit/shard_map function — "
+                    "use jnp.where / lax.cond, or mark the argument static"))
+        # --- DC302 / DC303: calls
+        if isinstance(node, ast.Call):
+            dname = dotted_name(node.func)
+            if dname:
+                if any(dname.startswith(p) for p in _HOST_STATE_PREFIXES) \
+                        or dname in _HOST_STATE_CALLS:
+                    findings.append(Finding(
+                        src.path, node.lineno, "DC302",
+                        f"host-state read {dname}(...) inside a traced "
+                        "function — its value is frozen at trace time"))
+                if dname.startswith("jax.random."):
+                    sampler = dname.rsplit(".", 1)[-1]
+                    if sampler not in _KEY_DERIVERS and node.args:
+                        first = node.args[0]
+                        if isinstance(first, ast.Name) and first.id in keys:
+                            consumed[first.id] = consumed.get(first.id, 0) + 1
+                            if consumed[first.id] == 2:
+                                findings.append(Finding(
+                                    src.path, node.lineno, "DC303",
+                                    f"PRNG key '{first.id}' consumed by more "
+                                    "than one jax.random sampler without "
+                                    "split/fold_in — identical randomness "
+                                    "where independence was intended"))
+            # bare key names passed as rngs={...} values count as consumption
+            for kw in node.keywords:
+                if kw.arg == "rngs" and isinstance(kw.value, ast.Dict):
+                    for val in kw.value.values:
+                        if isinstance(val, ast.Name) and val.id in keys:
+                            consumed[val.id] = consumed.get(val.id, 0) + 1
+                            if consumed[val.id] == 2:
+                                findings.append(Finding(
+                                    src.path, val.lineno, "DC303",
+                                    f"PRNG key '{val.id}' reused as an rngs "
+                                    "value after already being consumed — "
+                                    "split or fold_in first"))
+    return findings
+
+
+def _check_donation(src: SourceFile) -> List[Finding]:
+    """DC304: a donated argument used after the donating call."""
+    findings: List[Finding] = []
+    donated: Dict[str, Set[int]] = {}
+    for node in walk_list(src.tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    info = _jit_call_info(dec)
+                    if info and info["donate"]:
+                        donated[node.name] = info["donate"]
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Call):
+            info = _jit_call_info(node.value)
+            if info and info["donate"]:
+                donated[node.targets[0].id] = info["donate"]
+    if not donated:
+        return findings
+    for fn in walk_list(src.tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_block(src, fn.body, [], donated, findings)
+    return findings
+
+
+def _child_blocks(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    """The statement lists nested in a compound statement (loop/branch/try
+    bodies) — where most real donating calls actually live."""
+    blocks = []
+    for field in ("body", "orelse", "finalbody"):
+        sub = getattr(stmt, field, None)
+        if isinstance(sub, list) and sub and isinstance(sub[0], ast.stmt):
+            blocks.append(sub)
+    for handler in getattr(stmt, "handlers", []) or []:
+        blocks.append(handler.body)
+    return blocks
+
+
+def _scan_block(src: SourceFile, body: List[ast.stmt], tail: List[ast.stmt],
+                donated: Dict[str, Set[int]],
+                findings: List[Finding]) -> None:
+    """Scan one statement block for donate-then-reuse. ``tail`` carries the
+    statements that follow this block in every enclosing block, so a call
+    inside an ``if``/``for`` body is still checked against the code after
+    the compound statement — without cross-matching sibling branches."""
+    for i, stmt in enumerate(body):
+        later = body[i + 1:] + tail
+        call = _stmt_call(stmt)
+        if call is not None:
+            cname = call_name(call)
+            if cname in donated:
+                rebound = _assigned_names(stmt)
+                for idx in donated[cname]:
+                    if idx >= len(call.args):
+                        continue
+                    arg = call.args[idx]
+                    if not isinstance(arg, ast.Name) or arg.id in rebound:
+                        continue
+                    for after in later:
+                        if arg.id in _assigned_names(after):
+                            break
+                        used = [n for n in walk_list(after)
+                                if isinstance(n, ast.Name) and n.id == arg.id
+                                and isinstance(n.ctx, ast.Load)]
+                        if used:
+                            findings.append(Finding(
+                                src.path, used[0].lineno, "DC304",
+                                f"'{arg.id}' was donated to {cname}(...) at "
+                                f"line {call.lineno} (donate_argnums) and "
+                                "is used again here — the buffer may "
+                                "already be reused by XLA"))
+                            break
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # nested defs run later, not in this flow
+        for block in _child_blocks(stmt):
+            _scan_block(src, block, later, donated, findings)
+
+
+def _stmt_call(stmt: ast.stmt) -> Optional[ast.Call]:
+    if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+        return stmt.value
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        return stmt.value
+    return None
+
+
+def _assigned_names(stmt: ast.stmt) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(stmt, ast.Assign):
+        for target in stmt.targets:
+            for node in walk_list(target):
+                if isinstance(node, ast.Name):
+                    out.add(node.id)
+    elif isinstance(stmt, ast.AugAssign) and isinstance(stmt.target, ast.Name):
+        out.add(stmt.target.id)
+    return out
+
+
+def check(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in pkg:
+        for tf in find_traced(src):
+            findings.extend(_check_one(src, tf))
+        findings.extend(_check_donation(src))
+    return findings

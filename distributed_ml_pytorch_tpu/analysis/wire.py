@@ -1,0 +1,655 @@
+"""distcheck DC1xx — wire-protocol consistency across the whole stack.
+
+The protocol's ground truth is data, not prose: the ``MessageCode`` enum
+and the declarative ``WIRE_SCHEMAS`` table in ``utils/messaging.py``
+(ISSUE 4 satellite — payload layouts moved out of comments). This checker
+extracts both FROM THE AST (so the seeded-bug corpora can carry their own
+broken registries) plus every send site and handler site package-wide, and
+cross-checks them:
+
+- **DC101** — two ``MessageCode`` members share an int value. ``IntEnum``
+  silently aliases the second onto the first, so its frames dispatch to the
+  wrong handler; only a static check sees it.
+- **DC102** — a code is sent somewhere but no module of its declared
+  ``handled_by`` plane(s) compares against it: frames that arrive and rot
+  in a mailbox (or hit a default-drop branch) forever.
+- **DC103** — a handler exists for a code nothing ever sends or even
+  references: dead protocol surface that will silently diverge.
+- **DC104** — pack/unpack arity drift against the schema: a send site
+  whose fixed head has the wrong number of fields, a handler guard
+  (``code == X and payload.size >= K``) checking the wrong K, or a handler
+  body indexing past the declared head / slicing the rest at the wrong
+  offset.
+- **DC105** — a module that opted into reliability (it wraps transports in
+  ``ReliableTransport`` or passes ``reliable=`` to ``make_transport``)
+  constructs a raw TCP transport it never wraps, or reaches through the
+  wrapper with ``x.inner.send(...)`` — frames that silently skip the
+  seq/CRC/ack service the rest of the module negotiated.
+- **DC106** — a ``MessageCode`` with no ``WIRE_SCHEMAS`` entry (or a
+  schema for a name the enum does not define): the table must stay total
+  or every other check here has holes.
+
+Send-site payload arity is resolved structurally: literal
+``np.asarray([...])`` heads (``*_split16(x)`` counts as 2 — the documented
+uint16-halves idiom), ``np.concatenate([head, tail])`` splits head/rest,
+and one level of local-variable / builder-function indirection
+(``encode_join(...)`` and friends) is followed.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from distributed_ml_pytorch_tpu.analysis.core import (
+    Finding,
+    Package,
+    SourceFile,
+    call_name,
+    const_int,
+    message_code_names,
+    walk_list,
+)
+
+#: helpers known to expand to N wire fields when splatted into a head list
+_SPLAT_ARITY = {"_split16": 2, "split16": 2}
+
+#: the module that IS the reliability layer (its raw sends are the layer)
+_LAYER_MODULE = "utils/messaging.py"
+
+
+@dataclasses.dataclass
+class SchemaInfo:
+    code: str
+    fields: Tuple[str, ...]
+    rest: Optional[str]
+    rest_min: int
+    handled_by: Tuple[str, ...]
+    path: str
+    line: int
+
+    @property
+    def head(self) -> int:
+        return len(self.fields)
+
+    @property
+    def min_size(self) -> int:
+        return self.head + self.rest_min
+
+
+@dataclasses.dataclass
+class SendSite:
+    code: str
+    path: str
+    line: int
+    head: Optional[int]  # fixed-head arity when statically resolvable
+    has_rest: Optional[bool]
+
+
+@dataclasses.dataclass
+class HandlerSite:
+    code: str
+    path: str
+    line: int
+    plane: str
+    guard_min: Optional[int]  # K from `payload.size >= K` in the same test
+    body: Optional[List[ast.stmt]]
+    payload_name: Optional[str]
+
+
+# --------------------------------------------------------------- extraction
+
+def _is_schema_table(node: ast.AST) -> bool:
+    """``WIRE_SCHEMAS = {…}`` as a plain or annotated assignment."""
+    if isinstance(node, ast.Assign):
+        return len(node.targets) == 1 and \
+            isinstance(node.targets[0], ast.Name) and \
+            node.targets[0].id == "WIRE_SCHEMAS"
+    if isinstance(node, ast.AnnAssign):
+        return isinstance(node.target, ast.Name) and \
+            node.target.id == "WIRE_SCHEMAS" and node.value is not None
+    return False
+
+
+def extract_enum(pkg: Package) -> Tuple[Dict[str, int], List[Finding]]:
+    """The ``MessageCode`` members, plus DC101 collisions."""
+    values: Dict[str, int] = {}
+    findings: List[Finding] = []
+    by_value: Dict[int, Tuple[str, str, int]] = {}
+    for src in pkg:
+        for node in walk_list(src.tree):
+            if not (isinstance(node, ast.ClassDef)
+                    and node.name == "MessageCode"):
+                continue
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    val = const_int(stmt.value)
+                    if val is None:
+                        continue
+                    name = stmt.targets[0].id
+                    values[name] = val
+                    prev = by_value.get(val)
+                    if prev is not None:
+                        findings.append(Finding(
+                            src.path, stmt.lineno, "DC101",
+                            f"MessageCode.{name} = {val} collides with "
+                            f"MessageCode.{prev[0]} — IntEnum aliases them "
+                            "and frames dispatch to the wrong handler"))
+                    else:
+                        by_value[val] = (name, src.path, stmt.lineno)
+    return values, findings
+
+
+def extract_schemas(pkg: Package) -> Dict[str, SchemaInfo]:
+    schemas: Dict[str, SchemaInfo] = {}
+    for src in pkg:
+        for node in walk_list(src.tree):
+            if not (_is_schema_table(node) and isinstance(node.value, ast.Dict)):
+                continue
+            for key, val in zip(node.value.keys, node.value.values):
+                names = message_code_names(key) if key is not None else []
+                if len(names) != 1 or not isinstance(val, ast.Call):
+                    continue
+                code = names[0][0]
+                fields: Tuple[str, ...] = ()
+                rest = None
+                rest_min = 0
+                handled_by: Tuple[str, ...] = ()
+                for kw in val.keywords:
+                    if kw.arg == "fields" and isinstance(
+                            kw.value, (ast.Tuple, ast.List)):
+                        fields = tuple(
+                            e.value for e in kw.value.elts
+                            if isinstance(e, ast.Constant))
+                    elif kw.arg == "rest" and isinstance(kw.value, ast.Constant):
+                        rest = kw.value.value
+                    elif kw.arg == "rest_min":
+                        rest_min = const_int(kw.value) or 0
+                    elif kw.arg == "handled_by" and isinstance(
+                            kw.value, (ast.Tuple, ast.List)):
+                        handled_by = tuple(
+                            e.value for e in kw.value.elts
+                            if isinstance(e, ast.Constant))
+                schemas[code] = SchemaInfo(
+                    code, fields, rest, rest_min, handled_by,
+                    src.path, val.lineno)
+    return schemas
+
+
+def _count_head(elts: List[ast.expr]) -> Optional[int]:
+    """Arity of a literal payload head list; None when not resolvable."""
+    n = 0
+    for e in elts:
+        if isinstance(e, ast.Starred):
+            if isinstance(e.value, ast.Call) and \
+                    call_name(e.value) in _SPLAT_ARITY:
+                n += _SPLAT_ARITY[call_name(e.value)]
+            else:
+                return None
+        else:
+            n += 1
+    return n
+
+
+def _local_assignments(fn: ast.AST) -> Dict[str, ast.expr]:
+    """name → last simple-RHS assignment within a function (one level of
+    indirection for payload heads built in a local variable)."""
+    out: Dict[str, ast.expr] = {}
+    for node in walk_list(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            out[node.targets[0].id] = node.value
+    return out
+
+
+def _payload_shape(
+    expr: Optional[ast.expr],
+    local: Dict[str, ast.expr],
+    builders: Dict[str, Tuple[Optional[int], Optional[bool]]],
+    depth: int = 0,
+) -> Tuple[Optional[int], Optional[bool]]:
+    """(head_arity, has_rest) of a payload expression, or (None, None)."""
+    if expr is None or depth > 3:
+        return None, None
+    if isinstance(expr, ast.Name):
+        if expr.id in local:
+            return _payload_shape(local[expr.id], local, builders, depth + 1)
+        return None, None
+    if isinstance(expr, ast.Call):
+        name = call_name(expr)
+        if name in ("asarray", "array") and expr.args:
+            inner = expr.args[0]
+            if isinstance(inner, (ast.List, ast.Tuple)):
+                return _count_head(inner.elts), False
+            if isinstance(inner, ast.Name) and inner.id in local:
+                resolved = local[inner.id]
+                if isinstance(resolved, (ast.List, ast.Tuple)):
+                    return _count_head(resolved.elts), False
+            return None, None
+        if name == "zeros" and expr.args:
+            n = const_int(expr.args[0])
+            return (n, False) if n is not None else (None, None)
+        if name == "concatenate" and expr.args and \
+                isinstance(expr.args[0], (ast.List, ast.Tuple)):
+            parts = expr.args[0].elts
+            if not parts:
+                return None, None
+            head, _ = _payload_shape(parts[0], local, builders, depth + 1)
+            if head is None:
+                return None, None
+            return head, len(parts) > 1
+        if name in builders:
+            return builders[name]
+    return None, None
+
+
+def extract_builders(
+    pkg: Package,
+) -> Dict[str, Tuple[Optional[int], Optional[bool]]]:
+    """Payload-builder functions (``encode_join`` …): name → (head, rest)
+    resolved from their return expression."""
+    builders: Dict[str, Tuple[Optional[int], Optional[bool]]] = {}
+    # two passes so builders may reference other builders defined later
+    for _ in range(2):
+        for src in pkg:
+            for node in walk_list(src.tree):
+                if not isinstance(node, ast.FunctionDef):
+                    continue
+                returns = [s for s in walk_list(node)
+                           if isinstance(s, ast.Return) and s.value is not None]
+                if len(returns) != 1:
+                    continue
+                local = _local_assignments(node)
+                shape = _payload_shape(returns[0].value, local, builders)
+                if shape[0] is not None:
+                    builders[node.name] = shape
+    return builders
+
+
+def _code_args(call: ast.Call) -> List[Tuple[str, int, int]]:
+    """Positional args that are (possibly wrapped) ``MessageCode.X``:
+    list of (code_name, arg_index, line)."""
+    out = []
+    for i, arg in enumerate(call.args):
+        names = message_code_names(arg)
+        if len(names) == 1:
+            out.append((names[0][0], i, names[0][1]))
+    return out
+
+
+def extract_sends(
+    pkg: Package,
+    builders: Dict[str, Tuple[Optional[int], Optional[bool]]],
+) -> List[SendSite]:
+    sends: List[SendSite] = []
+    for src in pkg:
+        for fn in walk_list(src.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            local = _local_assignments(fn)
+            for node in walk_list(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if "send" not in name.lower():
+                    continue
+                for code, idx, line in _code_args(node):
+                    payload = node.args[idx + 1] \
+                        if idx + 1 < len(node.args) else None
+                    head, rest = _payload_shape(payload, local, builders)
+                    sends.append(SendSite(code, src.path, line, head, rest))
+    return sends
+
+
+def _size_guard(test: ast.expr) -> Dict[str, int]:
+    """``payload.size >= K`` comparisons in a test: payload-name → K."""
+    out: Dict[str, int] = {}
+    for node in walk_list(test):
+        if not (isinstance(node, ast.Compare) and len(node.ops) == 1
+                and isinstance(node.ops[0], ast.GtE)):
+            continue
+        left = node.left
+        if isinstance(left, ast.Attribute) and left.attr == "size" and \
+                isinstance(left.value, ast.Name):
+            k = const_int(node.comparators[0])
+            if k is not None:
+                out[left.value.id] = k
+    return out
+
+
+def _handler_codes(test: ast.expr) -> List[Tuple[str, int, bool]]:
+    """Codes a dispatch test selects: (name, line, is_positive_match).
+
+    Positive matches are ``x == MessageCode.C`` and
+    ``x in (MessageCode.A, …)``; ``!=`` / ``not in`` still count as handler
+    *evidence* (the code is dispatched on) but carry no body to arity-check.
+    """
+    out = []
+    for node in walk_list(test):
+        if not (isinstance(node, ast.Compare) and len(node.ops) == 1):
+            continue
+        positive = isinstance(node.ops[0], (ast.Eq, ast.In))
+        if not isinstance(node.ops[0], (ast.Eq, ast.In, ast.NotEq, ast.NotIn)):
+            continue
+        for side in (node.left, *node.comparators):
+            for name, line in message_code_names(side):
+                out.append((name, line, positive))
+    return out
+
+
+def extract_handlers(pkg: Package) -> List[HandlerSite]:
+    handlers: List[HandlerSite] = []
+    for src in pkg:
+        for node in walk_list(src.tree):
+            if not isinstance(node, ast.If):
+                continue
+            codes = _handler_codes(node.test)
+            if not codes:
+                continue
+            guards = _size_guard(node.test)
+            payload_name = next(iter(guards), None)
+            guard = guards.get(payload_name) if payload_name else None
+            positive = [c for c in codes if c[2]]
+            for name, line, is_pos in codes:
+                handlers.append(HandlerSite(
+                    name, src.path, line, src.plane,
+                    guard_min=guard if is_pos else None,
+                    body=node.body if is_pos else None,
+                    payload_name=payload_name if is_pos and positive else None))
+    return handlers
+
+
+def _non_handler_references(pkg: Package) -> Set[str]:
+    """Codes referenced outside dispatch comparisons, schema table and the
+    enum definition itself — 'someone constructs/assigns this code'."""
+    refs: Set[str] = set()
+    for src in pkg:
+        skip_spans: List[Tuple[int, int]] = []
+        for node in walk_list(src.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "MessageCode":
+                skip_spans.append((node.lineno, node.end_lineno or node.lineno))
+            if _is_schema_table(node):
+                skip_spans.append((node.lineno, node.end_lineno or node.lineno))
+            if isinstance(node, ast.Compare):
+                skip_spans.append((node.lineno, node.end_lineno or node.lineno))
+        for name, line in message_code_names(src.tree):
+            if not any(lo <= line <= hi for lo, hi in skip_spans):
+                refs.add(name)
+    return refs
+
+
+# ----------------------------------------------------------------- checking
+
+def _check_handler_body(
+    site: HandlerSite, schema: SchemaInfo
+) -> List[Finding]:
+    """Constant subscripts / rest slices inside one positive handler body."""
+    findings: List[Finding] = []
+    if site.body is None or site.payload_name is None:
+        return findings
+    for stmt in site.body:
+        for node in walk_list(stmt):
+            if not (isinstance(node, ast.Subscript)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == site.payload_name):
+                continue
+            sl = node.slice
+            idx = const_int(sl)
+            if idx is not None:
+                limit = schema.head if schema.rest is not None \
+                    else schema.min_size
+                if idx >= limit:
+                    findings.append(Finding(
+                        site.path, node.lineno, "DC104",
+                        f"handler for MessageCode.{site.code} reads "
+                        f"payload[{idx}] but the schema declares "
+                        f"{schema.head} fixed field(s)"
+                        + (f" before the '{schema.rest}' tail"
+                           if schema.rest else "")))
+            elif isinstance(sl, ast.Slice) and sl.lower is not None \
+                    and sl.upper is None and sl.step is None:
+                lower = const_int(sl.lower)
+                if lower is None:
+                    continue
+                if schema.rest is None:
+                    findings.append(Finding(
+                        site.path, node.lineno, "DC104",
+                        f"handler for MessageCode.{site.code} slices a "
+                        f"payload[{lower}:] tail but the schema declares "
+                        "no variable tail"))
+                elif lower != schema.head:
+                    findings.append(Finding(
+                        site.path, node.lineno, "DC104",
+                        f"handler for MessageCode.{site.code} slices the "
+                        f"'{schema.rest}' tail at payload[{lower}:] but the "
+                        f"schema puts it at payload[{schema.head}:]"))
+    return findings
+
+
+def check(pkg: Package) -> List[Finding]:
+    enum, findings = extract_enum(pkg)
+    if not enum:
+        return findings  # nothing protocol-shaped in this tree
+    schemas = extract_schemas(pkg)
+    builders = extract_builders(pkg)
+    sends = extract_sends(pkg, builders)
+    handlers = extract_handlers(pkg)
+    other_refs = _non_handler_references(pkg)
+
+    # DC106 — the schema table must be total over the enum (both ways);
+    # missing entries anchor at the table itself
+    table_loc = None
+    for src in pkg:
+        for node in walk_list(src.tree):
+            if _is_schema_table(node):
+                table_loc = (src.path, node.lineno)
+                break
+        if table_loc:
+            break
+    for name in sorted(enum):
+        if schemas and name not in schemas:
+            findings.append(Finding(
+                table_loc[0], table_loc[1], "DC106",
+                f"MessageCode.{name} has no WIRE_SCHEMAS entry — declare "
+                "its payload layout so the wire checks cover it"))
+    for name, info in sorted(schemas.items()):
+        if name not in enum:
+            findings.append(Finding(
+                info.path, info.line, "DC106",
+                f"WIRE_SCHEMAS declares MessageCode.{name} but the enum "
+                "does not define it"))
+
+    sends_by_code: Dict[str, List[SendSite]] = {}
+    for s in sends:
+        sends_by_code.setdefault(s.code, []).append(s)
+    handlers_by_code: Dict[str, List[HandlerSite]] = {}
+    for h in handlers:
+        handlers_by_code.setdefault(h.code, []).append(h)
+
+    # DC102 — every sent code needs a handler on its declared plane(s)
+    for code, sites in sorted(sends_by_code.items()):
+        if code not in enum:
+            continue
+        hs = handlers_by_code.get(code, [])
+        schema = schemas.get(code)
+        planes = schema.handled_by if schema and schema.handled_by else ()
+        ok = any(h.plane in planes for h in hs) if planes else bool(hs)
+        if not ok:
+            where = " or ".join(planes) if planes else "any plane"
+            first = min(sites, key=lambda s: (s.path, s.line))
+            findings.append(Finding(
+                first.path, first.line, "DC102",
+                f"MessageCode.{code} is sent here but no module of the "
+                f"{where} handles it — frames arrive and rot"))
+
+    # DC103 — a handler for a code nothing sends or references
+    for code, hs in sorted(handlers_by_code.items()):
+        if code not in enum:
+            continue
+        if code not in sends_by_code and code not in other_refs:
+            first = min(hs, key=lambda h: (h.path, h.line))
+            findings.append(Finding(
+                first.path, first.line, "DC103",
+                f"handler for MessageCode.{code} but nothing in the "
+                "package ever sends or references it — dead protocol "
+                "surface"))
+
+    # DC104 — pack arity at send sites
+    for code, sites in sorted(sends_by_code.items()):
+        schema = schemas.get(code)
+        if schema is None:
+            continue
+        for s in sites:
+            if s.head is None:
+                continue
+            if schema.rest is None:
+                if s.has_rest:
+                    findings.append(Finding(
+                        s.path, s.line, "DC104",
+                        f"MessageCode.{code} sent with a variable tail but "
+                        "the schema declares a fixed payload of "
+                        f"{schema.head} field(s)"))
+                elif s.head != schema.head:
+                    findings.append(Finding(
+                        s.path, s.line, "DC104",
+                        f"MessageCode.{code} sent with {s.head} field(s) "
+                        f"but the schema declares {schema.head}"))
+            elif s.head != schema.head and not (
+                    s.head == 0 and not s.has_rest and schema.rest_min == 0):
+                findings.append(Finding(
+                    s.path, s.line, "DC104",
+                    f"MessageCode.{code} sent with a {s.head}-field head "
+                    f"but the schema declares {schema.head} field(s) before "
+                    f"the '{schema.rest}' tail"))
+
+    # DC104 — unpack guards and body subscripts at handler sites
+    for code, hs in sorted(handlers_by_code.items()):
+        schema = schemas.get(code)
+        if schema is None:
+            continue
+        for h in hs:
+            if h.guard_min is not None:
+                expected = schema.min_size
+                # a guard shared by several codes must fit the smallest
+                shared = [schemas[c].min_size
+                          for c, sibs in handlers_by_code.items()
+                          if c in schemas
+                          for sib in sibs
+                          if sib.path == h.path and sib.line != h.line
+                          and sib.guard_min == h.guard_min
+                          and abs(sib.line - h.line) <= 1]
+                candidates = {expected, *shared}
+                if h.guard_min not in candidates:
+                    findings.append(Finding(
+                        h.path, h.line, "DC104",
+                        f"handler guard for MessageCode.{code} checks "
+                        f"payload.size >= {h.guard_min} but the schema "
+                        f"requires {expected}"))
+            findings.extend(_check_handler_body(h, schema))
+
+    findings.extend(_check_reliability_bypass(pkg))
+    return findings
+
+
+# --------------------------------------------------------------- DC105
+
+_RAW_TRANSPORTS = ("TCPTransport", "NativeTCPTransport")
+
+
+def _reliable_aliases(src: SourceFile) -> Set[str]:
+    """Local names bound to ReliableTransport: import aliases, plus the
+    bare name for direct/attribute-qualified CODE references. Prose
+    mentions in comments or docstrings do not count (the AST never sees
+    them), so a suppression comment cannot opt a module in."""
+    names: Set[str] = set()
+    referenced = False
+    for node in walk_list(src.tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "ReliableTransport":
+                    names.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Name) and node.id == "ReliableTransport":
+            referenced = True
+        elif isinstance(node, ast.Attribute) and \
+                node.attr == "ReliableTransport":
+            referenced = True
+    if referenced:
+        names.add("ReliableTransport")
+    return names
+
+
+def _opted_in(src: SourceFile) -> bool:
+    if _reliable_aliases(src):
+        return True
+    for node in walk_list(src.tree):
+        if isinstance(node, ast.Call) and call_name(node) == "make_transport":
+            if any(kw.arg == "reliable" for kw in node.keywords):
+                return True
+    return False
+
+
+def _check_reliability_bypass(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in pkg:
+        if src.path.endswith(_LAYER_MODULE):
+            continue  # the layer's own plumbing IS the raw path
+        if not _opted_in(src):
+            continue
+        rel_names = _reliable_aliases(src)
+        raw_aliases: Set[str] = {n for n in _RAW_TRANSPORTS}
+        for node in walk_list(src.tree):
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name in _RAW_TRANSPORTS:
+                        raw_aliases.add(alias.asname or alias.name)
+        # (a) reaching under the wrapper: x.inner.send(...)
+        for node in walk_list(src.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "send" and \
+                    isinstance(node.func.value, ast.Attribute) and \
+                    node.func.value.attr == "inner":
+                findings.append(Finding(
+                    src.path, node.lineno, "DC105",
+                    "send through .inner bypasses the ReliableTransport "
+                    "this module otherwise negotiates"))
+        # (b) raw transport construction never handed to the wrapper
+        for fn in walk_list(src.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            wrapped_names: Set[str] = set()
+            raw_ctors: List[Tuple[Optional[str], int, str]] = []
+            for node in walk_list(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name in rel_names:
+                    for arg in node.args:
+                        if isinstance(arg, ast.Name):
+                            wrapped_names.add(arg.id)
+                        elif isinstance(arg, ast.Call) and \
+                                call_name(arg) in raw_aliases:
+                            wrapped_names.add(f"@{arg.lineno}")
+                elif name in raw_aliases:
+                    raw_ctors.append((None, node.lineno, name))
+            for node in walk_list(fn):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Call) \
+                        and call_name(node.value) in raw_aliases:
+                    raw_ctors = [
+                        (t if t is not None or line != node.value.lineno
+                         else node.targets[0].id, line, cname)
+                        for t, line, cname in raw_ctors
+                    ]
+            for target, line, cname in raw_ctors:
+                if target in wrapped_names or f"@{line}" in wrapped_names:
+                    continue
+                findings.append(Finding(
+                    src.path, line, "DC105",
+                    f"raw {cname}(...) in a module that opted into "
+                    "reliability — wrap it in ReliableTransport or via "
+                    "make_transport(reliable=...)"))
+    return findings

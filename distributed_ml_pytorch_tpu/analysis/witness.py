@@ -1,0 +1,210 @@
+"""Runtime lock-order witness — the dynamic half of DC202.
+
+The static lock graph (``analysis/concurrency.py``) is an over-
+approximation built from lexical nesting; this witness observes the REAL
+acquisition orders of a running scenario and cross-validates:
+
+- every lock the witness sees created inside the package must map to a
+  statically known ``threading.Lock()/RLock()`` creation site
+  (``collect_lock_sites``) — if not, the static model has a hole;
+- the observed acquisition-order graph must be acyclic — a runtime cycle
+  is a latent deadlock even if no run has hung yet.
+
+Install by patching the ``threading.Lock``/``RLock`` factories, so every
+lock constructed AFTER install (transports, frontends, coord clients —
+they all create their locks in ``__init__``) is wrapped. The wrapper keys
+each lock by its creation site (file:line), so all instances born at one
+source line are one node — exactly the granularity of the static graph.
+
+Enabled in the determinism suites via the ``DISTCHECK_WITNESS`` env flag
+(:func:`maybe_install`): the chaos/coord acceptance scenarios then double
+as concurrency validators at zero cost to the default test run.
+
+The witness itself synchronizes with raw ``_thread.allocate_lock()``
+primitives so its own bookkeeping never enters the graph.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import _thread
+from typing import Dict, List, Optional, Set, Tuple
+
+Site = Tuple[str, int]  # (filename, lineno) of the lock's creation
+
+
+class _WitnessLock:
+    """Drop-in for a ``threading.Lock``/``RLock``, reporting to a witness."""
+
+    __slots__ = ("_inner", "site", "_witness")
+
+    def __init__(self, inner, site: Site, witness: "LockOrderWitness"):
+        self._inner = inner
+        self.site = site
+        self._witness = witness
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._witness._note_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        self._witness._note_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def _at_fork_reinit(self) -> None:  # stdlib calls this after fork
+        reinit = getattr(self._inner, "_at_fork_reinit", None)
+        if reinit is not None:
+            reinit()
+
+
+class LockOrderWitness:
+    """Observe lock creation sites and acquisition-order edges."""
+
+    def __init__(self, package_root: Optional[str] = None):
+        self._mu = _thread.allocate_lock()
+        self._tls = threading.local()
+        self._edges: Dict[Tuple[Site, Site], int] = {}  # edge -> count
+        self._sites: Set[Site] = set()
+        self._orig_lock = None
+        self._orig_rlock = None
+        self._enabled = False
+        if package_root is None:
+            package_root = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+        self.package_root = package_root
+
+    # ------------------------------------------------------------- install
+    def install(self) -> "LockOrderWitness":
+        if self._enabled:
+            return self
+        self._orig_lock = threading.Lock
+        self._orig_rlock = threading.RLock
+        witness = self
+
+        def make_lock():
+            site = witness._creation_site()
+            inner = witness._orig_lock()
+            witness._register(site)
+            return _WitnessLock(inner, site, witness)
+
+        def make_rlock():
+            site = witness._creation_site()
+            inner = witness._orig_rlock()
+            witness._register(site)
+            return _WitnessLock(inner, site, witness)
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        self._enabled = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._enabled:
+            return
+        threading.Lock = self._orig_lock
+        threading.RLock = self._orig_rlock
+        self._enabled = False  # existing wrapped locks keep working silently
+
+    def _creation_site(self) -> Site:
+        frame = sys._getframe(2)  # caller of threading.Lock()
+        return (frame.f_code.co_filename, frame.f_lineno)
+
+    def _register(self, site: Site) -> None:
+        with self._mu:
+            self._sites.add(site)
+
+    # ----------------------------------------------------------- recording
+    def _stack(self) -> List["_WitnessLock"]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _note_acquire(self, lock: _WitnessLock) -> None:
+        if not self._enabled:
+            return
+        stack = self._stack()
+        reentrant = any(held is lock for held in stack)
+        if not reentrant:
+            new_edges = [
+                (held.site, lock.site) for held in stack
+                if held.site != lock.site]
+            if new_edges:
+                with self._mu:
+                    for edge in new_edges:
+                        self._edges[edge] = self._edges.get(edge, 0) + 1
+        stack.append(lock)
+
+    def _note_release(self, lock: _WitnessLock) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lock:
+                del stack[i]
+                return
+
+    # ------------------------------------------------------------ analysis
+    def edges(self) -> Dict[Tuple[Site, Site], int]:
+        with self._mu:
+            return dict(self._edges)
+
+    def sites(self) -> Set[Site]:
+        with self._mu:
+            return set(self._sites)
+
+    def package_sites(self) -> Set[Site]:
+        return {s for s in self.sites() if s[0].startswith(self.package_root)}
+
+    def cycles(self) -> List[List[Site]]:
+        """Every elementary cycle in the observed order graph (DFS; the
+        graphs here are tiny)."""
+        graph: Dict[Site, Set[Site]] = {}
+        for (a, b) in self.edges():
+            graph.setdefault(a, set()).add(b)
+        cycles: List[List[Site]] = []
+        seen_cycles: Set[Tuple[Site, ...]] = set()
+
+        def dfs(start: Site, node: Site, path: List[Site]):
+            for nxt in graph.get(node, ()):
+                if nxt == start:
+                    canon = tuple(sorted(path))
+                    if canon not in seen_cycles:
+                        seen_cycles.add(canon)
+                        cycles.append(path + [start])
+                elif nxt not in path:
+                    dfs(start, nxt, path + [nxt])
+
+        for start in graph:
+            dfs(start, start, [start])
+        return cycles
+
+    def report(self) -> str:
+        lines = ["lock-order witness:"]
+        for (a, b), n in sorted(self.edges().items()):
+            lines.append(
+                f"  {a[0]}:{a[1]} -> {b[0]}:{b[1]}  ({n} acquisitions)")
+        for cycle in self.cycles():
+            lines.append("  CYCLE: " + " -> ".join(
+                f"{s[0]}:{s[1]}" for s in cycle))
+        return "\n".join(lines)
+
+
+def maybe_install(package_root: Optional[str] = None) -> Optional[LockOrderWitness]:
+    """Install a witness iff ``DISTCHECK_WITNESS`` is set (how the chaos /
+    coord determinism suites opt in without taxing the default run)."""
+    if not os.environ.get("DISTCHECK_WITNESS"):
+        return None
+    return LockOrderWitness(package_root).install()

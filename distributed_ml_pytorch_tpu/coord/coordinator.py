@@ -175,6 +175,10 @@ class Coordinator:
             },
         }
 
+    # distcheck: ignore[DC205] membership decisions are single-threaded by
+    # design (handle/tick run on the serve thread only — module docstring);
+    # engine_up is an advisory GIL-atomic dict snapshot for the serving
+    # fleet hook, and a one-poll-stale answer is within its contract
     def engine_up(self) -> bool:
         return bool(self._live(KIND_ENGINE))
 

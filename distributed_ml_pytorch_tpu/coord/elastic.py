@@ -90,6 +90,13 @@ class ElasticShardServer:
             "dup_installs": 0, "spec_applied": 0, "spec_dropped": 0,
             "resizes": 0,
         }
+        #: guards the served state (range bounds, ps.central, stats) —
+        #: the serve loop resizes and applies on its thread while demos,
+        #: benchmarks and the chaos scripts read ``central``/``snapshot()``
+        #: from theirs; an unguarded reader could otherwise observe a
+        #: mid-resize (lo, hi) paired with the previous central vector
+        #: (distcheck DC205)
+        self._mu = threading.Lock()
         self._stop = threading.Event()
         self._crashed = False
 
@@ -104,6 +111,10 @@ class ElasticShardServer:
 
     # ------------------------------------------------------------------ map
     def _apply_map(self, m: ShardMap) -> None:
+        with self._mu:
+            self._apply_map_locked(m)
+
+    def _apply_map_locked(self, m: ShardMap) -> None:
         if m.version <= self.map_version:
             return
         self.map_version = m.version
@@ -145,6 +156,11 @@ class ElasticShardServer:
     # --------------------------------------------------------------- handle
     def handle(self, sender: int, code: MessageCode,
                payload: np.ndarray) -> None:
+        with self._mu:
+            self._handle_locked(sender, code, payload)
+
+    def _handle_locked(self, sender: int, code: MessageCode,
+                       payload: np.ndarray) -> None:
         size = self.hi - self.lo
         if code == MessageCode.GradientUpdate:
             if payload.shape[0] != size:
@@ -230,9 +246,28 @@ class ElasticShardServer:
                 continue  # malformed frame: drop, never die
         if self._crashed:
             return  # scripted silent death: no checkpoint, no leave
-        self.ps.save_checkpoint()
+        with self._mu:
+            self.ps.save_checkpoint()
         self.coord.close()
 
     @property
     def central(self) -> np.ndarray:
-        return self.ps.central
+        """A COPY of the served values, taken under the serve mutex — the
+        live buffer is mutated in place by the serve thread (installs,
+        gradient adds), so handing it out would let a reader observe a
+        half-applied update no matter what the lock proved."""
+        with self._mu:
+            return np.array(self.ps.central, copy=True)
+
+    def snapshot(self) -> dict:
+        """A consistent mid-run view for demos/benchmarks: the range
+        bounds, a COPY of the served values, and the counters — all read
+        under the same lock the serve loop mutates them under, so a
+        concurrent resize can never be observed halfway."""
+        with self._mu:
+            return {
+                "lo": self.lo, "hi": self.hi,
+                "map_version": self.map_version,
+                "central": np.array(self.ps.central, copy=True),
+                "stats": dict(self.stats),
+            }

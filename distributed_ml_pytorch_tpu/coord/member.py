@@ -164,7 +164,8 @@ class CoordClient:
     def _renew_loop(self) -> None:
         tick = 0
         while not self._stop.wait(self.renew_interval):
-            push_count, step, ewma_ms = self._progress
+            with self._lock:
+                push_count, step, ewma_ms = self._progress
             self._send(MessageCode.LeaseRenew, encode_renew(
                 self.incarnation, push_count, step, ewma_ms))
             tick += 1
@@ -191,8 +192,11 @@ class CoordClient:
         return self.current_map()
 
     def report(self, push_count: int, step: int, ewma_ms: float) -> None:
-        """Stash this member's latest progress; the renew thread ships it."""
-        self._progress = (int(push_count), int(step), float(ewma_ms))
+        """Stash this member's latest progress; the renew thread ships it
+        (written under the client lock so the renew thread never reads a
+        torn tuple — distcheck DC205)."""
+        with self._lock:
+            self._progress = (int(push_count), int(step), float(ewma_ms))
 
     def current_map(self) -> Optional[ShardMap]:
         with self._lock:

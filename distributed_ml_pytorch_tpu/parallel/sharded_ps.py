@@ -581,6 +581,11 @@ def _run_elastic_ps_process(args, k, n_workers, kind, reliable,
     )
 
     host, _, cport = coord_addr.partition(":")
+    # distcheck: ignore[DC105] the coordination star is deliberately
+    # unreliable: joins retry until answered, LeaseRenew is periodic and
+    # self-healing (ReliableTransport itself exempts it via
+    # unreliable_codes), and a retry storm toward a dead coordinator would
+    # be worse than the loss
     coord_transport = TCPTransport(
         rank=args.rank + 1, world_size=64, master=host or "localhost",
         port=int(cport or 29700))

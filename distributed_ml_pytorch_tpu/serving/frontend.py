@@ -302,7 +302,11 @@ class ServingFrontend:
         return self._send_to(route.rank, MessageCode.StreamTokens, frame)
 
     def _on_tokens(self, req, new_tokens: List[int], done: bool) -> None:
-        route = self._routes.get(req.request_id)
+        # the route table is rewired by the pump/sweep threads (submit,
+        # drop, reap) while this engine-thread callback streams — the
+        # lookup must hold the same lock (distcheck DC204)
+        with self._routes_lock:
+            route = self._routes.get(req.request_id)
         if route is None:
             return  # locally-submitted request (no transport client)
         start = len(route.tokens)

@@ -36,6 +36,7 @@ negotiate reliability pass through untouched.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import enum
 import logging
 import queue
@@ -81,23 +82,133 @@ class MessageCode(enum.IntEnum):
     GradientUpdate = 2
     WorkerDone = 3
     Heartbeat = 4
-    SubmitRequest = 5   # client → engine: [id, max_new, temp, top_k, top_p, seed, eos, *prompt]
-    StreamTokens = 6    # engine → client: [id, done_flag, start_index, *tokens]
-    ServeReject = 7     # engine → client: [id] — queue full / unknown resume
-    CancelRequest = 8   # client → engine: [id]
-    ReliableFrame = 9   # envelope: [inc_lo, inc_hi, seq_lo, seq_hi, crc_lo, crc_hi, code, *payload]
-    ReliableAck = 10    # receiver → sender: [seq_lo, seq_hi, inc_lo, inc_hi]
-    StreamAck = 11      # client → engine: [id, n_received] — progress + liveness
-    ResumeStream = 12   # client → engine: [id, n_received] — re-send from offset
+    SubmitRequest = 5
+    StreamTokens = 6
+    ServeReject = 7
+    CancelRequest = 8
+    ReliableFrame = 9
+    ReliableAck = 10
+    StreamAck = 11
+    ResumeStream = 12
     # --- coordination plane (coord/, ISSUE 3): the elastic control plane ---
-    CoordJoin = 13      # member → coord: [kind, inc_lo, inc_hi]
-    CoordLeave = 14     # member → coord: [inc_lo, inc_hi] — explicit leave
-    LeaseRenew = 15     # member → coord: [inc_lo, inc_hi, push_count, step, ewma_ms]
-    ShardMapUpdate = 16 # coord → members: encoded versioned ShardMap (coord/shardmap.py)
-    FleetState = 17     # coord → members: [version, n_workers, n_shards, n_engines, workers_done]
-    SpeculateTask = 18  # coord → backup worker: [task_id, victim_rank, from_step]
-    SpeculativeUpdate = 19  # worker → PS shard: [task_lo, task_hi, *payload] — first wins
-    RangeInstall = 20   # worker → PS shard: [lo_lo, lo_hi, hi_lo, hi_hi, *values]
+    CoordJoin = 13
+    CoordLeave = 14
+    LeaseRenew = 15
+    ShardMapUpdate = 16
+    FleetState = 17
+    SpeculateTask = 18
+    SpeculativeUpdate = 19
+    RangeInstall = 20
+
+
+@dataclasses.dataclass(frozen=True)
+class PayloadSchema:
+    """Declarative wire layout of one :class:`MessageCode` (ISSUE 4).
+
+    Every payload is ``[*fields, *rest]`` on the tagged-float32 wire:
+    ``fields`` names the fixed head positions (``*_lo``/``*_hi`` pairs are
+    uint16 halves of one 32-bit value — the :func:`_split16` idiom), and
+    ``rest`` names the variable tail (``None`` for fixed-size frames;
+    ``rest_min`` is the tail's minimum length when one is required).
+    ``handled_by`` declares WHICH plane's modules must dispatch on the
+    code — ``ps`` (parallel/, training/), ``serving``, ``coord``, or
+    ``transport`` (utils/, native/).
+
+    This table is the single source of truth the ``distcheck`` wire
+    checker (``analysis/wire.py``) validates send sites, handler guards
+    and subscripts against — layouts are DATA here, not comments, so
+    drifting either side of the wire fails ``make lint``. The receiver-
+    side minimum frame size is :attr:`min_size`.
+    """
+
+    fields: Tuple[str, ...] = ()
+    rest: Optional[str] = None
+    rest_min: int = 0
+    handled_by: Tuple[str, ...] = ()
+    doc: str = ""
+
+    @property
+    def min_size(self) -> int:
+        return len(self.fields) + self.rest_min
+
+
+WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
+    MessageCode.ParameterUpdate: PayloadSchema(
+        rest="params", handled_by=("ps", "coord"),
+        doc="central flat params (server push / construction install)"),
+    MessageCode.ParameterRequest: PayloadSchema(
+        handled_by=("ps", "coord"),
+        doc="empty pull request (also the TCP hello frame)"),
+    MessageCode.GradientUpdate: PayloadSchema(
+        rest="params", handled_by=("ps", "coord"),
+        doc="lr-pre-scaled accumulated update; server ADDS it"),
+    MessageCode.WorkerDone: PayloadSchema(
+        handled_by=("ps", "coord"), doc="clean worker exit"),
+    MessageCode.Heartbeat: PayloadSchema(
+        handled_by=("ps", "coord"), doc="liveness only; never retried"),
+    MessageCode.SubmitRequest: PayloadSchema(
+        fields=("id", "max_new", "temperature", "top_k", "top_p", "seed",
+                "eos"),
+        rest="prompt", rest_min=1, handled_by=("serving",),
+        doc="client -> engine; eos < 0 means none"),
+    MessageCode.StreamTokens: PayloadSchema(
+        fields=("id", "done_flag", "start_index"), rest="tokens",
+        handled_by=("serving",),
+        doc="engine -> client; start_index enables gap arithmetic"),
+    MessageCode.ServeReject: PayloadSchema(
+        fields=("id",), handled_by=("serving",),
+        doc="queue full, or a resume the engine cannot serve"),
+    MessageCode.CancelRequest: PayloadSchema(
+        fields=("id",), handled_by=("serving",), doc="client -> engine"),
+    MessageCode.ReliableFrame: PayloadSchema(
+        fields=("inc_lo", "inc_hi", "seq_lo", "seq_hi", "crc_lo", "crc_hi",
+                "code"),
+        rest="payload", handled_by=("transport",),
+        doc="reliability envelope; CRC covers header + body"),
+    MessageCode.ReliableAck: PayloadSchema(
+        fields=("seq_lo", "seq_hi", "inc_lo", "inc_hi"),
+        handled_by=("transport",),
+        doc="ack echoes the frame's incarnation (stale-life acks ignored)"),
+    MessageCode.StreamAck: PayloadSchema(
+        fields=("id", "n_received"), handled_by=("serving",),
+        doc="client progress + liveness"),
+    MessageCode.ResumeStream: PayloadSchema(
+        fields=("id", "n_received"), handled_by=("serving",),
+        doc="re-send the stream from offset (gap recovery / reconnect)"),
+    MessageCode.CoordJoin: PayloadSchema(
+        fields=("kind", "inc_lo", "inc_hi"), handled_by=("coord",),
+        doc="member -> coordinator; idempotent, retried until answered"),
+    MessageCode.CoordLeave: PayloadSchema(
+        fields=("inc_lo", "inc_hi"), handled_by=("coord",),
+        doc="explicit leave; stale incarnations cannot evict newer lives"),
+    MessageCode.LeaseRenew: PayloadSchema(
+        fields=("inc_lo", "inc_hi", "push_count", "step", "ewma_ms"),
+        handled_by=("coord",),
+        doc="lease refresh carrying the straggler-detector progress report"),
+    MessageCode.ShardMapUpdate: PayloadSchema(
+        fields=("n_entries", "version_lo", "version_hi", "n_params_lo",
+                "n_params_hi"),
+        rest="entries", handled_by=("coord",),
+        doc="encoded ShardMap; 9 floats per entry (coord/shardmap.py)"),
+    MessageCode.FleetState: PayloadSchema(
+        fields=("version_lo", "version_hi", "n_workers", "n_shards",
+                "n_engines", "workers_done"),
+        handled_by=("coord",),
+        doc="compact fleet broadcast the serving frontend consumes"),
+    MessageCode.SpeculateTask: PayloadSchema(
+        fields=("task_id", "victim_rank", "from_step"),
+        handled_by=("coord",),
+        doc="coordinator -> backup AND victim; same id for dedup"),
+    MessageCode.SpeculativeUpdate: PayloadSchema(
+        fields=("task_lo", "task_hi"), rest="payload",
+        handled_by=("coord",),
+        doc="Sandblaster backup-task result; first task id wins at the PS"),
+    MessageCode.RangeInstall: PayloadSchema(
+        fields=("lo_lo", "lo_hi", "hi_lo", "hi_hi"), rest="values",
+        handled_by=("coord",),
+        doc="worker seeds a freshly-acquired shard range; first install "
+            "wins"),
+}
 
 
 Message = Tuple[int, MessageCode, np.ndarray]
@@ -264,6 +375,12 @@ class TCPTransport(Transport):
         # syscalls on large payloads. The native transport's send_mu
         # (native/transport.cpp) guards the same hazard.
         self._send_locks: Dict[int, threading.Lock] = {}
+        # guards the peer-table structures (_peers/_send_locks/_retired):
+        # the accept-loop thread rewires them on elastic rejoin while the
+        # training/heartbeat threads look sockets up to send (distcheck
+        # DC205 — the per-peer send lock orders I/O on one socket, but the
+        # TABLE itself needs its own guard)
+        self._peers_mu = threading.Lock()
         self._retired: list = []  # replaced-on-rejoin sockets, closed at close()
         if rank == SERVER_RANK:
             srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -330,16 +447,28 @@ class TCPTransport(Transport):
         # swap under the peer's send lock so an in-flight send to the dead
         # socket finishes before the replacement (shutdown only — closing
         # here could recycle the fd under the old reader; closed at close())
-        with self._send_locks.setdefault(peer_rank, threading.Lock()):
-            old = self._peers.get(peer_rank)
+        with self._send_lock_for(peer_rank):
+            with self._peers_mu:
+                old = self._peers.get(peer_rank)
+                self._peers[peer_rank] = conn
+                if old is not None:
+                    self._retired.append(old)
             if old is not None:
                 try:
                     old.shutdown(socket.SHUT_RDWR)
                 except OSError:
                     pass
-                self._retired.append(old)
-            self._peers[peer_rank] = conn
         self._spawn_reader(conn)
+
+    def _send_lock_for(self, dst: int) -> threading.Lock:
+        """The per-peer send serializer, created on first use. Lock ORDER
+        is per-peer-lock → _peers_mu (send and _admit_worker both); this
+        helper holds only _peers_mu, so the orders can never cross."""
+        with self._peers_mu:
+            lock = self._send_locks.get(dst)
+            if lock is None:
+                lock = self._send_locks[dst] = threading.Lock()
+            return lock
 
     def _accept_loop(self) -> None:
         # poll with a timeout: a close() in another thread does not reliably
@@ -373,8 +502,14 @@ class TCPTransport(Transport):
 
     def send(self, code: MessageCode, payload: np.ndarray, dst: int = SERVER_RANK) -> None:
         arr = np.asarray(payload, dtype=np.float32).ravel()
-        with self._send_locks.setdefault(dst, threading.Lock()):
-            _send_frame(self._peers[dst], self.rank, int(code), arr)
+        with self._send_lock_for(dst):
+            # the socket lookup rides under BOTH locks: the per-peer lock
+            # means no rejoin swap can land mid-send, _peers_mu means the
+            # table read itself is never torn (KeyError for an unknown dst
+            # is the documented contract, unchanged)
+            with self._peers_mu:
+                sock = self._peers[dst]
+            _send_frame(sock, self.rank, int(code), arr)
 
     def recv(self, timeout: Optional[float] = None) -> Optional[Message]:
         # Poll in short slices so a blocking recv() still returns None once the
@@ -392,7 +527,9 @@ class TCPTransport(Transport):
 
     def close(self) -> None:
         self._closed = True
-        for s in list(self._peers.values()) + self._retired:
+        with self._peers_mu:
+            targets = list(self._peers.values()) + list(self._retired)
+        for s in targets:
             try:
                 s.shutdown(socket.SHUT_RDWR)
             except OSError:
@@ -527,7 +664,9 @@ class ReliableTransport(Transport):
         if int(code) in self.unreliable_codes:
             self.inner.send(code, payload, dst=dst)
             return
-        if dst in self._dead_peers:
+        with self._lock:
+            dead = dst in self._dead_peers
+        if dead:
             raise ConnectionError(
                 f"peer {dst} declared dead after {self.max_retries} "
                 "unacked retries")
@@ -596,10 +735,10 @@ class ReliableTransport(Transport):
         sender, code, payload = msg
         # ANY frame from a rank previously declared dead is evidence of
         # life: a restarted peer on the same rank must be sendable again
-        # (the reconnect-and-resume / rejoin paths)
-        if sender in self._dead_peers:
-            with self._lock:
-                self._dead_peers.discard(sender)
+        # (the reconnect-and-resume / rejoin paths); discard is idempotent,
+        # so the membership test rides inside the lock with it
+        with self._lock:
+            self._dead_peers.discard(sender)
         if code == MessageCode.ReliableAck:
             # the ack echoes the FRAME's incarnation: a straggler ack for a
             # previous life's frame (same seq, old inc) must not clear the

@@ -41,6 +41,22 @@ def mesh8():
     return data_mesh(8)
 
 
+@pytest.fixture
+def lock_witness():
+    """Runtime lock-order witness (analysis/witness.py, ISSUE 4): under
+    DISTCHECK_WITNESS=1 the chaos/coord acceptance scenarios double as
+    concurrency validators — every lock acquisition order observed during
+    the run must be acyclic. Without the env flag this is a no-op, so the
+    default suite pays nothing."""
+    from distributed_ml_pytorch_tpu.analysis.witness import maybe_install
+
+    w = maybe_install()
+    yield w
+    if w is not None:
+        w.uninstall()
+        assert not w.cycles(), w.report()
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

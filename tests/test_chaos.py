@@ -46,6 +46,8 @@ from distributed_ml_pytorch_tpu.utils.serialization import ravel_model_params
 
 pytestmark = pytest.mark.chaos
 
+# the shared lock_witness fixture (tests/conftest.py) arms the acceptance
+# scenario below as a concurrency validator under DISTCHECK_WITNESS=1
 
 # ---------------------------------------------------------------------------
 # unit: FaultyTransport
@@ -329,7 +331,7 @@ _ACCEPTANCE_PLAN = ChaosPlan(
     seed=42)
 
 
-def test_async_ps_chaos_deterministic_and_converges(ps_fixture):
+def test_async_ps_chaos_deterministic_and_converges(ps_fixture, lock_witness):
     """THE acceptance test (ISSUE 2): drop=0.1 + dup=0.05, 2 workers,
     in-process transport, 3 runs in a row — training reaches the fault-free
     loss corridor and the fault log is byte-identical across runs."""

@@ -63,6 +63,8 @@ from distributed_ml_pytorch_tpu.utils.serialization import ravel_model_params
 
 pytestmark = pytest.mark.coord
 
+# the shared lock_witness fixture (tests/conftest.py) arms the acceptance
+# scenario below as a concurrency validator under DISTCHECK_WITNESS=1
 
 # ---------------------------------------------------------------------------
 # unit: shard maps
@@ -355,7 +357,8 @@ def elastic_fixture():
     return x, y, grad_fn, params0
 
 
-def test_elastic_acceptance_join_crash_rebalance_corridor(elastic_fixture):
+def test_elastic_acceptance_join_crash_rebalance_corridor(
+        elastic_fixture, lock_witness):
     """THE acceptance test (ISSUE 3): 2 workers + 2 PS shards under
     FaultyTransport; a 3rd worker joins at step N; a shard server is
     silently crashed at step M; the coordinator detects the death by lease

@@ -1,0 +1,934 @@
+"""distcheck (analysis/) — the static-analysis suite's own test corpus.
+
+Three layers (ISSUE 4 acceptance):
+
+1. **Seeded-bug twins** — for every checker code, a fixture package with a
+   planted defect and a clean twin: the checker must fire on the seeded
+   file and stay silent on the clean one. Fixtures are source TEXT (the
+   analyzer is pure-AST), so the broken twins never need to import.
+2. **Suppression semantics** — inline ignores silence exactly their code,
+   a reasonless ignore is itself a finding (DC001), a stale ignore is
+   flagged (DC002).
+3. **The real tree** — the installed package runs clean against the
+   checked-in baseline (tests/distcheck_baseline.txt), and the runtime
+   lock-order witness (analysis/witness.py) cross-validates the static
+   lock model on a live reliable-transport scenario.
+
+Plus regression tests for the genuine defects the tool surfaced (ISSUE 4
+satellite): the frontend's route-table callback, the elastic server's
+resize-vs-reader race, the reliable transport's dead-peer reads, the TCP
+peer-table rewiring, and the coord client's progress tuple.
+"""
+
+import os
+import textwrap
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.analysis import analyze_path
+from distributed_ml_pytorch_tpu.analysis.core import read_baseline
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _run(tmp_path, files):
+    """Write a fixture package and analyze it; returns (active, suppressed)."""
+    root = tmp_path / "fixturepkg"
+    for rel, text in files.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(text))
+    return analyze_path(str(root), rel_base=str(tmp_path))
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+# ---------------------------------------------------------------- fixtures
+
+_MINI_MESSAGING = """
+    import enum
+
+    class MessageCode(enum.IntEnum):
+        Ping = 0
+        Pong = 1
+
+    class PayloadSchema:
+        def __init__(self, fields=(), rest=None, rest_min=0, handled_by=()):
+            self.fields = fields
+            self.rest = rest
+            self.rest_min = rest_min
+            self.handled_by = handled_by
+
+    WIRE_SCHEMAS = {
+        MessageCode.Ping: PayloadSchema(
+            fields=("a", "b"), handled_by=("ps",)),
+        MessageCode.Pong: PayloadSchema(
+            fields=("x",), rest="data", handled_by=("serving",)),
+    }
+"""
+
+_PING_HANDLER = """
+    from fixturepkg.utils.messaging import MessageCode
+
+    def serve(transport):
+        msg = transport.recv()
+        sender, code, payload = msg
+        if code == MessageCode.Ping and payload.size >= 2:
+            return payload[0] + payload[1]
+"""
+
+_PONG_ROUNDTRIP = """
+    import numpy as np
+    from fixturepkg.utils.messaging import MessageCode
+
+    def push(transport):
+        transport.send(MessageCode.Pong,
+                       np.concatenate([np.asarray([7.0], np.float32),
+                                       np.zeros(3, np.float32)]))
+
+    def serve(transport):
+        sender, code, payload = transport.recv()
+        if code == MessageCode.Pong and payload.size >= 1:
+            return payload[0], payload[1:]
+"""
+
+
+def _wire_files(**overrides):
+    files = {
+        "utils/messaging.py": _MINI_MESSAGING,
+        "parallel/worker.py": """
+            import numpy as np
+            from fixturepkg.utils.messaging import MessageCode
+
+            def push(transport):
+                transport.send(MessageCode.Ping,
+                               np.asarray([1.0, 2.0], np.float32))
+        """,
+        "parallel/server.py": _PING_HANDLER,
+        "serving/stream.py": _PONG_ROUNDTRIP,
+    }
+    files.update(overrides)
+    return files
+
+
+# ----------------------------------------------------------- DC1xx: wire
+
+def test_dc101_code_collision_fires_and_clean_twin_silent(tmp_path):
+    broken = _wire_files(**{"utils/messaging.py": _MINI_MESSAGING.replace(
+        "Pong = 1", "Pong = 0")})
+    active, _ = _run(tmp_path, broken)
+    assert "DC101" in _codes(active)
+    clean, _ = _run(tmp_path, _wire_files())
+    assert not clean, [f.render() for f in clean]
+
+
+def test_dc102_send_without_handler_on_plane(tmp_path):
+    # the Ping handler lives on the wrong plane → DC102 names the plane
+    broken = _wire_files()
+    broken["serving/misplaced.py"] = broken.pop("parallel/server.py")
+    active, _ = _run(tmp_path, broken)
+    assert "DC102" in _codes(active)
+    assert any("ps" in f.message for f in active if f.code == "DC102")
+
+
+def test_dc103_handler_for_never_sent_code(tmp_path):
+    broken = _wire_files()
+    broken["serving/stream.py"] = """
+        from fixturepkg.utils.messaging import MessageCode
+
+        def serve(transport):
+            sender, code, payload = transport.recv()
+            if code == MessageCode.Pong and payload.size >= 1:
+                return payload[0], payload[1:]
+    """
+    active, _ = _run(tmp_path, broken)
+    assert "DC103" in _codes(active)
+
+
+def test_dc104_send_head_arity_drift(tmp_path):
+    broken = _wire_files(**{"parallel/worker.py": """
+        import numpy as np
+        from fixturepkg.utils.messaging import MessageCode
+
+        def push(transport):
+            transport.send(MessageCode.Ping,
+                           np.asarray([1.0, 2.0, 3.0], np.float32))
+    """})
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC104"]
+    assert "3 field(s)" in active[0].message
+
+
+def test_dc104_handler_guard_and_subscript_drift(tmp_path):
+    broken = _wire_files(**{"parallel/server.py": """
+        from fixturepkg.utils.messaging import MessageCode
+
+        def serve(transport):
+            sender, code, payload = transport.recv()
+            if code == MessageCode.Ping and payload.size >= 5:
+                return payload[4]
+    """})
+    active, _ = _run(tmp_path, broken)
+    codes = _codes(active)
+    assert codes.count("DC104") == 2  # wrong guard K AND out-of-head read
+
+
+def test_dc104_rest_sliced_at_wrong_offset(tmp_path):
+    broken = _wire_files(**{"serving/stream.py": _PONG_ROUNDTRIP.replace(
+        "payload[0], payload[1:]", "payload[0], payload[2:]")})
+    active, _ = _run(tmp_path, broken)
+    assert "DC104" in _codes(active)
+    assert any("payload[2:]" in f.message for f in active)
+
+
+def test_dc105_raw_transport_in_reliable_module(tmp_path):
+    client = """
+        import numpy as np
+        from fixturepkg.utils.messaging import MessageCode
+        from fixturepkg.utils.transports import ReliableTransport, TCPTransport
+
+        def dial(reliable):
+            t = TCPTransport(0, 2)
+            return t
+    """
+    files = _wire_files(**{
+        "utils/transports.py": """
+            class TCPTransport:
+                def __init__(self, rank, world_size):
+                    self.rank = rank
+
+            class ReliableTransport:
+                def __init__(self, inner):
+                    self.inner = inner
+        """,
+        "training/client.py": client,
+    })
+    active, _ = _run(tmp_path, files)
+    assert "DC105" in _codes(active)
+    fixed = dict(files)
+    fixed["training/client.py"] = client.replace(
+        "t = TCPTransport(0, 2)", "t = ReliableTransport(TCPTransport(0, 2))")
+    active, _ = _run(tmp_path, fixed)
+    assert "DC105" not in _codes(active)
+
+
+def test_dc106_schema_table_must_be_total(tmp_path):
+    broken = _wire_files(**{"utils/messaging.py": _MINI_MESSAGING.replace(
+        'MessageCode.Pong: PayloadSchema(\n            fields=("x",), rest="data", handled_by=("serving",)),\n',
+        "")})
+    active, _ = _run(tmp_path, broken)
+    assert "DC106" in _codes(active)
+
+
+# ----------------------------------------------------- DC2xx: concurrency
+
+_GUARDED_BOX = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = []
+
+        def put(self, x):
+            with self._lock:
+                self.items.append(x)
+
+        def put_front(self, x):
+            with self._lock:
+                self.items.insert(0, x)
+
+        def drop(self):
+            {drop_body}
+"""
+
+
+def test_dc201_mutation_outside_owning_lock(tmp_path):
+    broken = {"box.py": _GUARDED_BOX.format(drop_body="self.items.clear()")}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC201"]
+    clean = {"box.py": _GUARDED_BOX.format(
+        drop_body="with self._lock:\n                self.items.clear()")}
+    active, _ = _run(tmp_path, clean)
+    assert not active
+
+
+def test_dc204_unguarded_read_of_lock_owned_attr(tmp_path):
+    broken = {"box.py": _GUARDED_BOX.format(
+        drop_body="with self._lock:\n                self.items.clear()")
+        + "\n        def peek(self):\n            return len(self.items)\n"}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC204"]
+
+
+def test_dc202_lock_order_cycle_including_transitive(tmp_path):
+    broken = {"ab.py": """
+        import threading
+
+        class AB:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def f(self):
+                with self.a:
+                    self._tail()
+
+            def _tail(self):
+                with self.b:
+                    pass
+
+            def g(self):
+                with self.b:
+                    with self.a:
+                        pass
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert "DC202" in _codes(active)
+    clean = {"ab.py": """
+        import threading
+
+        class AB:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def f(self):
+                with self.a:
+                    self._tail()
+
+            def _tail(self):
+                with self.b:
+                    pass
+
+            def g(self):
+                with self.a:
+                    with self.b:
+                        pass
+    """}
+    active, _ = _run(tmp_path, clean)
+    assert not active
+
+
+def test_dc203_thread_without_daemon_or_join(tmp_path):
+    broken = {"spawn.py": """
+        import threading
+
+        def work():
+            pass
+
+        def go():
+            t = threading.Thread(target=work)
+            t.start()
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC203"]
+    clean = {"spawn.py": """
+        import threading
+
+        def work():
+            pass
+
+        def go():
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+    """}
+    active, _ = _run(tmp_path, clean)
+    assert not active
+
+
+def test_dc203_not_masked_by_str_join(tmp_path):
+    """A ``", ".join(names)`` in the creating scope must NOT count as
+    joining the thread (review fix: str.join masked real findings)."""
+    broken = {"spawn.py": """
+        import threading
+
+        def work():
+            pass
+
+        def go(names):
+            t = threading.Thread(target=work)
+            t.start()
+            return ", ".join(names)
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC203"]
+    clean = {"spawn.py": """
+        import threading
+
+        def work():
+            pass
+
+        def go(names):
+            t = threading.Thread(target=work)
+            t.start()
+            t.join(timeout=5)
+            return ", ".join(names)
+    """}
+    active, _ = _run(tmp_path, clean)
+    assert not active
+
+
+def test_dc205_cross_thread_attr_without_lock(tmp_path):
+    broken = {"srv.py": """
+        import threading
+
+        class Srv:
+            def __init__(self):
+                self.count = 0
+                self._t = threading.Thread(target=self.run, daemon=True)
+
+            def run(self):
+                self.count += 1
+
+            def read(self):
+                return self.count
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC205"]
+    clean = {"srv.py": """
+        import threading
+
+        class Srv:
+            def __init__(self):
+                self.count = 0
+                self._mu = threading.Lock()
+                self._t = threading.Thread(target=self.run, daemon=True)
+
+            def run(self):
+                with self._mu:
+                    self.count += 1
+
+            def read(self):
+                with self._mu:
+                    return self.count
+    """}
+    active, _ = _run(tmp_path, clean)
+    assert not active
+
+
+def test_function_local_class_does_not_crash_analyzer(tmp_path):
+    """A Thread target on a function-LOCAL class must not crash the run
+    (review fix: the class table only holds top-level classes); the
+    thread-discipline check still applies."""
+    files = {"local.py": """
+        import threading
+
+        def make():
+            class Worker:
+                def run(self):
+                    pass
+
+                def go(self):
+                    t = threading.Thread(target=self.run)
+                    t.start()
+
+            return Worker()
+    """}
+    active, _ = _run(tmp_path, files)
+    assert [f.code for f in active] == ["DC203"]
+
+
+def test_dc205_bool_flags_are_exempt(tmp_path):
+    files = {"srv.py": """
+        import threading
+
+        class Srv:
+            def __init__(self):
+                self.closed = False
+                self._t = threading.Thread(target=self.run, daemon=True)
+
+            def run(self):
+                while not self.closed:
+                    pass
+
+            def close(self):
+                self.closed = True
+    """}
+    active, _ = _run(tmp_path, files)
+    assert not active, [f.render() for f in active]
+
+
+# ------------------------------------------------------- DC3xx: tracing
+
+def test_dc301_branch_on_traced_value(tmp_path):
+    broken = {"step.py": """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC301"]
+    clean = {"step.py": """
+        import jax
+
+        @jax.jit
+        def f(x):
+            s = x.shape[0]
+            if s > 1:
+                return x
+            return -x
+    """}
+    active, _ = _run(tmp_path, clean)
+    assert not active
+
+
+def test_dc302_host_state_read_in_traced_fn(tmp_path):
+    broken = {"step.py": """
+        import time
+        import jax
+
+        @jax.jit
+        def f(x):
+            t = time.time()
+            return x * t
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC302"]
+    clean = {"step.py": """
+        import time
+        import jax
+
+        @jax.jit
+        def f(x, t):
+            return x * t
+
+        def call(x):
+            return f(x, time.time())
+    """}
+    active, _ = _run(tmp_path, clean)
+    assert not active
+
+
+def test_dc303_key_reuse_without_split(tmp_path):
+    broken = {"sample.py": """
+        import jax
+
+        @jax.jit
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.uniform(key, (2,))
+            return a + b
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC303"]
+    clean = {"sample.py": """
+        import jax
+
+        @jax.jit
+        def f(key):
+            k1, k2 = jax.random.split(key)
+            a = jax.random.normal(k1, (2,))
+            b = jax.random.uniform(k2, (2,))
+            return a + b
+    """}
+    active, _ = _run(tmp_path, clean)
+    assert not active
+
+
+def test_dc304_donated_buffer_reused_after_call(tmp_path):
+    broken = {"donate.py": """
+        from functools import partial
+
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, x):
+            return state + x
+
+        def loop(state, xs):
+            out = step(state, xs)
+            return out + state
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC304"]
+    clean = {"donate.py": """
+        from functools import partial
+
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, x):
+            return state + x
+
+        def loop(state, xs):
+            state = step(state, xs)
+            return state
+    """}
+    active, _ = _run(tmp_path, clean)
+    assert not active
+
+
+def test_dc304_fires_inside_loop_bodies(tmp_path):
+    """Real training loops donate inside ``for``/``if`` bodies — the scan
+    must descend into compound statements (review fix) without cross-
+    matching exclusive branches."""
+    broken = {"donate.py": """
+        from functools import partial
+
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, x):
+            return state + x
+
+        def loop(state, xs):
+            for x in xs:
+                out = step(state, x)
+            return out + state
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC304"]
+    # donation in one branch, use in the OTHER branch: exclusive paths,
+    # not a reuse — must stay silent
+    clean = {"donate.py": """
+        from functools import partial
+
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, x):
+            return state + x
+
+        def run(state, x, flag):
+            if flag:
+                return step(state, x)
+            else:
+                return state * 2.0
+    """}
+    active, _ = _run(tmp_path, clean)
+    assert not active
+
+
+def test_traced_detection_covers_shard_map_wrapping(tmp_path):
+    broken = {"sharded.py": """
+        import time
+        import jax
+
+        def make_step(mesh):
+            def step(x):
+                t = time.monotonic()
+                return x * t
+
+            return jax.jit(jax.shard_map(step, mesh=mesh))
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC302"]
+
+
+# ------------------------------------------------------- suppressions
+
+def test_suppression_silences_with_reason_and_flags_without(tmp_path):
+    body = _GUARDED_BOX.format(
+        drop_body="self.items.clear()  # distcheck: ignore[DC201] {reason}")
+    active, suppressed = _run(
+        tmp_path, {"box.py": body.format(reason="drop() is init-only")})
+    assert not active and [f.code for f in suppressed] == ["DC201"]
+    active, _ = _run(tmp_path, {"box.py": body.format(reason="")})
+    assert "DC001" in _codes(active)  # reasonless ignore is itself flagged
+    assert "DC201" in _codes(active)  # ... and does NOT silence the finding
+
+
+def test_dc105_prose_mention_does_not_opt_in(tmp_path):
+    """A comment/docstring mentioning ReliableTransport (e.g. a DC105
+    suppression's own text) must not opt a module into reliability
+    (review fix: the opt-in is AST-only)."""
+    files = _wire_files(**{"training/client.py": """
+        import numpy as np
+        from fixturepkg.utils.messaging import MessageCode
+
+        # this demo deliberately does not use ReliableTransport
+        def dial(TCPTransport):
+            t = TCPTransport(0, 2)
+            return t
+    """})
+    active, _ = _run(tmp_path, files)
+    assert "DC105" not in _codes(active)
+
+
+def test_baseline_keys_number_duplicate_findings(tmp_path):
+    """Two identical-message findings in one file get distinct baseline
+    keys, so a parked entry covers exactly one occurrence (review fix)."""
+    from distributed_ml_pytorch_tpu.analysis.core import baseline_keys
+
+    broken = {"spawn.py": """
+        import threading
+
+        def work():
+            pass
+
+        def go():
+            a = threading.Thread(target=work)
+            a.start()
+            b = threading.Thread(target=work)
+            b.start()
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC203", "DC203"]
+    keys = baseline_keys(active)
+    assert len(set(keys)) == 2 and keys[1].endswith("| #2")
+
+
+def test_unused_suppression_is_flagged(tmp_path):
+    files = {"ok.py": """
+        X = 1  # distcheck: ignore[DC201] nothing here needs this
+    """}
+    active, _ = _run(tmp_path, files)
+    assert [f.code for f in active] == ["DC002"]
+
+
+def test_multiline_suppression_covers_next_code_line(tmp_path):
+    files = {"box.py": _GUARDED_BOX.format(
+        drop_body="# distcheck: ignore[DC201] drop is only called after\n"
+                  "            # every worker thread has been joined\n"
+                  "            self.items.clear()")}
+    active, suppressed = _run(tmp_path, files)
+    assert not active and [f.code for f in suppressed] == ["DC201"]
+
+
+# ------------------------------------------------- the real package
+
+def _package_root():
+    import distributed_ml_pytorch_tpu
+
+    return os.path.dirname(os.path.abspath(distributed_ml_pytorch_tpu.__file__))
+
+
+@pytest.fixture(scope="module")
+def real_pkg():
+    """The installed package, parsed once for the whole module (parsing
+    ~60 files dominates the analyzer's wall time)."""
+    from distributed_ml_pytorch_tpu.analysis.core import load_package
+
+    return load_package(_package_root())
+
+
+def test_real_package_has_no_findings_beyond_baseline(real_pkg):
+    from distributed_ml_pytorch_tpu.analysis import analyze
+    from distributed_ml_pytorch_tpu.analysis.core import baseline_keys
+
+    active, suppressed = analyze(real_pkg)
+    baseline = read_baseline(os.path.join(HERE, "distcheck_baseline.txt"))
+    new = [f for f, k in zip(active, baseline_keys(active))
+           if k not in baseline]
+    assert not new, "new distcheck findings:\n" + "\n".join(
+        f.render() for f in new)
+    # the acceptance bar: every live suppression carries a reason (a
+    # reasonless one would have surfaced as an active DC001 above)
+    assert all(f.code.startswith("DC") for f in suppressed)
+
+
+# --------------------------------------------------- runtime witness
+
+def test_witness_detects_cyclic_acquisition_order():
+    from distributed_ml_pytorch_tpu.analysis.witness import LockOrderWitness
+
+    w = LockOrderWitness().install()
+    try:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with a:  # the reverse order — latent deadlock
+                pass
+    finally:
+        w.uninstall()
+    assert w.cycles(), w.report()
+
+
+def test_witness_cross_validates_static_lock_model(real_pkg):
+    """A live reliable-transport scenario under the witness: every lock it
+    observes in the package maps to a statically known creation site, and
+    the observed acquisition order is acyclic."""
+    from distributed_ml_pytorch_tpu.analysis import concurrency
+    from distributed_ml_pytorch_tpu.analysis.witness import LockOrderWitness
+
+    pkg_root = _package_root()
+    static_sites = concurrency.collect_lock_sites(real_pkg)
+    static_lines = {(os.path.basename(p), line) for p, line in static_sites}
+
+    w = LockOrderWitness(package_root=pkg_root).install()
+    try:
+        from distributed_ml_pytorch_tpu.utils.messaging import (
+            InProcessTransport,
+            MessageCode,
+            ReliableTransport,
+        )
+
+        world = InProcessTransport.create_world(2)
+        server = ReliableTransport(world[0], ack_timeout=0.05)
+        worker = ReliableTransport(world[1], ack_timeout=0.05)
+        got = []
+
+        def serve():
+            while len(got) < 8:
+                msg = server.recv(timeout=0.2)
+                if msg is None:
+                    continue
+                got.append(msg)
+                server.send(MessageCode.ParameterUpdate, msg[2], dst=msg[0])
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        for i in range(8):
+            worker.send(MessageCode.GradientUpdate,
+                        np.full(4, float(i), np.float32))
+        deadline = time.monotonic() + 10
+        while len(got) < 8 and time.monotonic() < deadline:
+            worker.recv(timeout=0.05)
+        t.join(timeout=5)
+        worker.close()
+        server.close()
+    finally:
+        w.uninstall()
+    assert len(got) == 8
+    observed = w.package_sites()
+    assert observed, "witness saw no package locks — is it installed?"
+    unknown = {(os.path.basename(p), line) for p, line in observed} - static_lines
+    assert not unknown, f"locks unknown to the static model: {unknown}"
+    assert not w.cycles(), w.report()
+
+
+# --------------------------------- regression tests for the fixed defects
+
+def test_frontend_on_tokens_takes_route_lock():
+    """The engine-thread stream callback must hold the route-table lock
+    (the DC204 fix): with the lock held elsewhere, the callback blocks."""
+    from distributed_ml_pytorch_tpu.serving.frontend import ServingFrontend
+
+    fe = ServingFrontend.__new__(ServingFrontend)
+    fe._routes_lock = threading.Lock()
+    fe._routes = {}
+    req = types.SimpleNamespace(request_id=1)
+    done = threading.Event()
+
+    def cb():
+        fe._on_tokens(req, [1], False)
+        done.set()
+
+    with fe._routes_lock:
+        t = threading.Thread(target=cb, daemon=True)
+        t.start()
+        assert not done.wait(0.25), "_on_tokens ignored _routes_lock"
+    assert done.wait(2.0)
+
+
+def test_elastic_server_snapshot_is_lock_consistent():
+    """The DC205 fix: resize/apply and external readers share one mutex,
+    and a snapshot always sees matching (lo, hi, central)."""
+    from distributed_ml_pytorch_tpu.coord.elastic import ElasticShardServer
+    from distributed_ml_pytorch_tpu.coord.shardmap import ShardEntry, ShardMap
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        InProcessTransport,
+        MessageCode,
+    )
+
+    world = InProcessTransport.create_world(2)
+    coord = types.SimpleNamespace(
+        report=lambda *a: None, stop=lambda: None, close=lambda: None)
+    srv = ElasticShardServer(
+        server_id=1, n_params=12, transport=world[0], coord=coord,
+        init_params=np.arange(12, dtype=np.float32))
+    srv._apply_map(ShardMap(1, 12, [ShardEntry(1, 0, 12, 0, 0)]))
+    snap = srv.snapshot()
+    assert snap["hi"] - snap["lo"] == snap["central"].shape[0] == 12
+
+    done = threading.Event()
+
+    def mutate():
+        srv.handle(1, MessageCode.GradientUpdate,
+                   np.ones(12, np.float32))
+        done.set()
+
+    with srv._mu:
+        t = threading.Thread(target=mutate, daemon=True)
+        t.start()
+        assert not done.wait(0.25), "handle() ignored the server mutex"
+    assert done.wait(2.0)
+    assert srv.snapshot()["central"][0] == 1.0  # the push landed once
+
+
+def test_reliable_send_checks_dead_peers_under_lock():
+    """The DC204 fix in ReliableTransport.send: the dead-peer check rides
+    the transport lock."""
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        InProcessTransport,
+        MessageCode,
+        ReliableTransport,
+    )
+
+    world = InProcessTransport.create_world(2)
+    rt = ReliableTransport(world[0], ack_timeout=0.05)
+    done = threading.Event()
+
+    def send():
+        rt.send(MessageCode.GradientUpdate, np.zeros(2, np.float32), dst=1)
+        done.set()
+
+    with rt._lock:
+        t = threading.Thread(target=send, daemon=True)
+        t.start()
+        assert not done.wait(0.25), "send() ignored the transport lock"
+    assert done.wait(2.0)
+    rt.close()
+
+
+def test_tcp_peer_table_is_mutex_guarded():
+    """The DC205 fix in TCPTransport: the peer/send-lock tables are behind
+    _peers_mu, and the per-peer serializer is stable across calls."""
+    from distributed_ml_pytorch_tpu.utils.messaging import TCPTransport
+
+    t = TCPTransport(0, 1, port=29731)  # solo server: no rendezvous wait
+    try:
+        assert t._send_lock_for(5) is t._send_lock_for(5)
+        got = threading.Event()
+
+        def lookup():
+            t._send_lock_for(6)
+            got.set()
+
+        with t._peers_mu:
+            thread = threading.Thread(target=lookup, daemon=True)
+            thread.start()
+            assert not got.wait(0.25), "_send_lock_for ignored _peers_mu"
+        assert got.wait(2.0)
+    finally:
+        t.close()
+
+
+def test_coord_client_progress_guarded():
+    """The DC205 fix in CoordClient: report() writes the progress tuple
+    under the client lock the renew thread reads it with."""
+    from distributed_ml_pytorch_tpu.coord.member import CoordClient
+    from distributed_ml_pytorch_tpu.utils.messaging import InProcessTransport
+
+    world = InProcessTransport.create_world(2)
+    client = CoordClient(world[1], "worker", renew_interval=30.0)
+    try:
+        done = threading.Event()
+
+        def report():
+            client.report(1, 2, 3.0)
+            done.set()
+
+        with client._lock:
+            t = threading.Thread(target=report, daemon=True)
+            t.start()
+            assert not done.wait(0.25), "report() ignored the client lock"
+        assert done.wait(2.0)
+        with client._lock:
+            assert client._progress == (1, 2, 3.0)
+    finally:
+        client.stop()
