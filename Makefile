@@ -75,6 +75,17 @@ chaos:
 coord:
 	$(PY) -m pytest tests/ -q -m coord
 
+# disaster-recovery drill suite (coord/drill.py + utils/wal.py): snapshot
+# barrier -> kill shard subsets mid-epoch -> restore from manifest + WAL
+# with zero acked-update loss, byte-identical fault logs across repeats;
+# soak variants additionally carry the slow marker
+drill:
+	$(PY) -m pytest tests/ -q -m drill
+
+# one-command drill demo (prints MTTR + replayed counts + accounting)
+drill-demo:
+	$(PY) -m distributed_ml_pytorch_tpu.coord.cli --drill
+
 # distcheck (analysis/): protocol / concurrency / tracing-hygiene static
 # analysis over the whole package — exits non-zero on any unsuppressed
 # finding that is not in the checked-in baseline. Regenerate the baseline
@@ -111,4 +122,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo bench bench-serving bench-all chaos coord lint test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo bench bench-serving bench-all chaos coord drill drill-demo lint test test-all verify-real-data graph install dist

@@ -28,6 +28,13 @@ cross-checks them:
 - **DC106** — a ``MessageCode`` with no ``WIRE_SCHEMAS`` entry (or a
   schema for a name the enum does not define): the table must stay total
   or every other check here has holes.
+- **DC107** — a module that opted into the durability discipline (it
+  references ``utils.durability.atomic_write``) still hand-rolls a
+  ``open(..., "w"/"wb")`` + ``os.replace``/``os.rename`` persistence pair
+  in some function: a write that is atomic but NOT power-loss durable (no
+  fsync of data or rename), silently weaker than the module's own
+  contract. Same opt-in style as DC105; the module that *defines*
+  ``atomic_write`` is the raw path itself and is exempt.
 
 Send-site payload arity is resolved structurally: literal
 ``np.asarray([...])`` heads (``*_split16(x)`` counts as 2 — the documented
@@ -550,6 +557,7 @@ def check(pkg: Package) -> List[Finding]:
             findings.extend(_check_handler_body(h, schema))
 
     findings.extend(_check_reliability_bypass(pkg))
+    findings.extend(_check_durability_bypass(pkg))
     return findings
 
 
@@ -652,4 +660,89 @@ def _check_reliability_bypass(pkg: Package) -> List[Finding]:
                     f"raw {cname}(...) in a module that opted into "
                     "reliability — wrap it in ReliableTransport or via "
                     "make_transport(reliable=...)"))
+    return findings
+
+
+# --------------------------------------------------------------- DC107
+
+_DURABILITY_HELPER = "atomic_write"
+
+
+def _durability_aliases(src: SourceFile) -> Set[str]:
+    """Local names bound to atomic_write — import aliases plus the bare
+    name for direct / attribute-qualified CODE references (AST only, so a
+    prose mention in a comment cannot opt a module in; DC105 precedent)."""
+    names: Set[str] = set()
+    referenced = False
+    for node in walk_list(src.tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == _DURABILITY_HELPER:
+                    names.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Name) and node.id == _DURABILITY_HELPER:
+            referenced = True
+        elif isinstance(node, ast.Attribute) and \
+                node.attr == _DURABILITY_HELPER:
+            referenced = True
+    if referenced:
+        names.add(_DURABILITY_HELPER)
+    return names
+
+
+def _defines_durability_helper(src: SourceFile) -> bool:
+    return any(
+        isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name == _DURABILITY_HELPER
+        for node in walk_list(src.tree))
+
+
+def _open_write_mode(node: ast.Call) -> bool:
+    """``open(..., "w"/"wb"/...)`` with a literal write mode (positional or
+    ``mode=``); append modes are WAL-style and exempt."""
+    if call_name(node) != "open":
+        return False
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    return (isinstance(mode, ast.Constant) and isinstance(mode.value, str)
+            and mode.value.startswith("w"))
+
+
+def _is_os_replace(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr in ("replace", "rename")
+            and isinstance(f.value, ast.Name) and f.value.id == "os")
+
+
+def _check_durability_bypass(pkg: Package) -> List[Finding]:
+    """DC107: hand-rolled ``open(.., "w") + os.replace`` persistence in a
+    module that otherwise routes writes through ``utils.atomic_write`` —
+    atomic, but not power-loss durable (no data fsync, no directory
+    fsync), silently weaker than the module's own discipline."""
+    findings: List[Finding] = []
+    for src in pkg:
+        if _defines_durability_helper(src):
+            continue  # the helper's own plumbing IS the raw path
+        if not _durability_aliases(src):
+            continue  # not opted in to the durability discipline
+        for fn in walk_list(src.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            opens = [n for n in walk_list(fn)
+                     if isinstance(n, ast.Call) and _open_write_mode(n)]
+            if not opens:
+                continue
+            if not any(isinstance(n, ast.Call) and _is_os_replace(n)
+                       for n in walk_list(fn)):
+                continue
+            for n in opens:
+                findings.append(Finding(
+                    src.path, n.lineno, "DC107",
+                    f"direct open(.., 'w') + os.replace persistence in "
+                    f"{fn.name}() bypasses utils.atomic_write() — atomic "
+                    "but not power-loss durable (no fsync of data or "
+                    "rename)"))
     return findings

@@ -57,6 +57,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--demo", action="store_true",
                    help="run the in-process elastic demo (join + shard "
                         "crash + rebalance) and exit")
+    p.add_argument("--drill", action="store_true",
+                   help="run the in-process disaster-recovery drill "
+                        "(snapshot barrier, kill ALL shards, restore from "
+                        "manifest + WAL, sequence-accounted) and exit")
+    p.add_argument("--manifest-dir", type=str, default="",
+                   help="directory for fleet snapshot manifests (TCP hub "
+                        "mode; empty = snapshots stay in memory)")
+    p.add_argument("--snapshot-interval", type=float, default=0.0,
+                   help="seconds between automatic fleet snapshot barriers "
+                        "(0 = only on demand)")
     p.add_argument("--seed", type=int, default=0)
     return p
 
@@ -89,11 +99,22 @@ def run_demo(args) -> int:
     return 0 if summary.get("ok") else 1
 
 
+def run_drill(args) -> int:
+    """The ISSUE 5 recovery drill as a one-command in-process script."""
+    from distributed_ml_pytorch_tpu.coord.drill import drill_demo
+
+    summary = drill_demo(seed=args.seed)
+    print("recovery drill:", summary)
+    return 0 if summary.get("ok") else 1
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     print(args)
     if args.demo:
         return run_demo(args)
+    if args.drill:
+        return run_drill(args)
 
     from distributed_ml_pytorch_tpu.coord.coordinator import Coordinator
     from distributed_ml_pytorch_tpu.utils.messaging import TCPTransport
@@ -105,7 +126,9 @@ def main(argv=None) -> int:
     coord = Coordinator(
         transport, n_params, lease=args.lease,
         straggler_factor=args.straggler_factor,
-        speculation=not args.no_speculation)
+        speculation=not args.no_speculation,
+        manifest_dir=args.manifest_dir or None,
+        snapshot_interval=args.snapshot_interval)
     print(f"coordinator on {args.master}:{args.port} "
           f"({n_params} params, lease {args.lease:.1f}s)")
     try:

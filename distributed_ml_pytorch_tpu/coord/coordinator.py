@@ -81,6 +81,20 @@ def encode_renew(incarnation: int, push_count: int = 0, step: int = 0,
          float(ewma_ms)], np.float32)
 
 
+def encode_snapshot_request(snapshot_id: int, map_version: int) -> np.ndarray:
+    return np.asarray(
+        [*_split16(snapshot_id), *_split16(map_version)], np.float32)
+
+
+def encode_snapshot_done(snapshot_id: int, map_version: int, lo: int,
+                         hi: int, apply_seq: int,
+                         push_count: int) -> np.ndarray:
+    return np.asarray(
+        [*_split16(snapshot_id), *_split16(map_version), *_split16(lo),
+         *_split16(hi), *_split16(apply_seq), *_split16(push_count)],
+        np.float32)
+
+
 def encode_fleet(version: int, n_workers: int, n_shards: int, n_engines: int,
                  workers_done: bool) -> np.ndarray:
     return np.asarray(
@@ -130,6 +144,10 @@ class Coordinator:
         straggler_after_steps: int = 4,
         speculation: bool = True,
         clock: Callable[[], float] = time.monotonic,
+        manifest_dir: Optional[str] = None,
+        snapshot_interval: float = 0.0,
+        snapshot_timeout: float = 30.0,
+        restore_manifest=None,
     ):
         self.transport = transport
         self.lease = float(lease)
@@ -144,6 +162,30 @@ class Coordinator:
         self._next_task = 1
         self._stop = threading.Event()
         self.events: List[str] = []  # human-readable decision log (tests/CLI)
+        # --- snapshot barrier (ISSUE 5): coordinator-aligned fleet ckpts ---
+        self.manifest_dir = manifest_dir
+        self.snapshot_interval = float(snapshot_interval)
+        self.snapshot_timeout = float(snapshot_timeout)
+        self._snap_seq = 0
+        self._snap: Optional[dict] = None  # the in-flight barrier, if any
+        #: set by trigger_snapshot() from any thread (GIL-atomic bool flag);
+        #: consumed by tick() on the serve thread, where all decisions run
+        self._snap_requested = False
+        self._next_snap_at = (
+            self._clock() + self.snapshot_interval
+            if self.snapshot_interval > 0 else None)
+        self.manifests_written = 0
+        self.last_manifest = None
+        if restore_manifest is not None:
+            # disaster recovery: adopt the manifest's shard map + snapshot
+            # clock so rebalances and snapshot ids continue, not restart
+            restore_manifest.validate()
+            self.shard_map = restore_manifest.shard_map
+            self._snap_seq = int(restore_manifest.snapshot_id)
+            self.last_manifest = restore_manifest
+            self._log(
+                f"restored from manifest: snapshot {self._snap_seq}, "
+                f"shard map v{self.shard_map.version}")
 
     # ------------------------------------------------------------ bookkeeping
     def _log(self, msg: str) -> None:
@@ -264,6 +306,19 @@ class Coordinator:
             else:
                 self._announce()
             return
+        if code == MessageCode.SnapshotDone and payload.size >= 12:
+            if not np.isfinite(payload[:12]).all():
+                return
+            member.last_seen = now
+            self._on_snapshot_done(
+                sender,
+                snapshot_id=_join16(payload[0], payload[1]),
+                map_version=_join16(payload[2], payload[3]),
+                lo=_join16(payload[4], payload[5]),
+                hi=_join16(payload[6], payload[7]),
+                apply_seq=_join16(payload[8], payload[9]),
+                push_count=_join16(payload[10], payload[11]))
+            return
         if code == MessageCode.LeaseRenew and payload.size >= 5:
             if not np.isfinite(payload[:5]).all():
                 return
@@ -299,6 +354,20 @@ class Coordinator:
             self._announce()
         if self.speculation:
             self.check_stragglers()
+        # --- snapshot barrier driving (serve-thread only, like the rest) ---
+        due = (self._next_snap_at is not None and now >= self._next_snap_at)
+        if self._snap_requested or due:
+            self._snap_requested = False
+            if self._next_snap_at is not None:
+                self._next_snap_at = now + self.snapshot_interval
+            self._start_snapshot(now)
+        if (self._snap is not None
+                and now - self._snap["started"] > self.snapshot_timeout):
+            self._log(
+                f"snapshot {self._snap['id']} abandoned: shards "
+                f"{sorted(self._snap['expected'] - set(self._snap['got']))} "
+                f"never reported within {self.snapshot_timeout:.0f}s")
+            self._snap = None
         return bool(expired)
 
     def _rebalance(self, why: str) -> None:
@@ -308,7 +377,114 @@ class Coordinator:
             f"shard map v{self.shard_map.version} on {why}: "
             + (", ".join(f"s{e.server_id}=[{e.lo},{e.hi})"
                          for e in self.shard_map.entries) or "EMPTY"))
+        if self._snap is not None:
+            # a barrier frozen at an older map version can never complete
+            # consistently — abort it; the next interval/trigger retries
+            self._log(
+                f"snapshot {self._snap['id']} aborted: shard map moved to "
+                f"v{self.shard_map.version} mid-barrier")
+            self._snap = None
         self._announce()
+
+    # ------------------------------------------------------ snapshot barrier
+    def trigger_snapshot(self) -> None:
+        """Request a fleet snapshot; the serve thread's next tick starts the
+        barrier. Safe from any thread (bool-flag handshake)."""
+        self._snap_requested = True
+
+    def manifest_path(self) -> Optional[str]:
+        if not self.manifest_dir:
+            return None
+        import os
+
+        from distributed_ml_pytorch_tpu.coord.manifest import MANIFEST_NAME
+
+        return os.path.join(self.manifest_dir, MANIFEST_NAME)
+
+    def _start_snapshot(self, now: float) -> None:
+        if self._snap is not None:
+            self._log(
+                f"snapshot request ignored: snapshot {self._snap['id']} "
+                "still in flight")
+            return
+        shards = self._live(KIND_SHARD)
+        if not shards:
+            self._log("snapshot request ignored: no live shard servers")
+            return
+        self._snap_seq += 1
+        self._snap = {
+            "id": self._snap_seq,
+            "map_version": self.shard_map.version,
+            "expected": {m.rank for m in shards},
+            "got": {},
+            "started": now,
+        }
+        self._log(
+            f"snapshot {self._snap_seq} started: map "
+            f"v{self.shard_map.version}, awaiting "
+            f"{sorted(self._snap['expected'])}")
+        frame = encode_snapshot_request(self._snap_seq, self.shard_map.version)
+        for m in shards:
+            self._send(m.rank, MessageCode.SnapshotRequest, frame)
+
+    def _on_snapshot_done(self, sender: int, *, snapshot_id: int,
+                          map_version: int, lo: int, hi: int, apply_seq: int,
+                          push_count: int) -> None:
+        snap = self._snap
+        if snap is None or snapshot_id != snap["id"]:
+            self._log(
+                f"stale SnapshotDone from shard {sender} "
+                f"(snapshot {snapshot_id})")
+            return
+        if map_version != snap["map_version"]:
+            # a shard checkpointed under another map: the barrier is mixed
+            # and must not produce a manifest — abort loudly, retry later
+            self._log(
+                f"snapshot {snap['id']} aborted: shard {sender} reported "
+                f"map v{map_version}, barrier is at v{snap['map_version']}")
+            self._snap = None
+            return
+        entry = self.shard_map.entry_for(sender)
+        if entry is None or (entry.lo, entry.hi) != (lo, hi):
+            self._log(
+                f"snapshot {snap['id']} aborted: shard {sender} reported "
+                f"range [{lo},{hi}) but the map assigns "
+                f"{None if entry is None else (entry.lo, entry.hi)}")
+            self._snap = None
+            return
+        from distributed_ml_pytorch_tpu.coord.manifest import ShardRecord
+
+        snap["got"][sender] = ShardRecord(
+            server_id=sender, lo=lo, hi=hi, map_version=map_version,
+            apply_seq=apply_seq, push_count=push_count)
+        if set(snap["got"]) >= snap["expected"]:
+            self._finalize_snapshot(snap)
+            self._snap = None
+
+    def _finalize_snapshot(self, snap: dict) -> None:
+        from distributed_ml_pytorch_tpu.coord.manifest import FleetManifest
+
+        manifest = FleetManifest(
+            snapshot_id=snap["id"],
+            map_version=snap["map_version"],
+            n_params=self.shard_map.n_params,
+            shards=tuple(snap["got"][r] for r in sorted(snap["got"])),
+            complete=True,
+        )
+        path = self.manifest_path()
+        if path is not None:
+            import os
+
+            os.makedirs(self.manifest_dir, exist_ok=True)
+            manifest.write(path)
+        self.last_manifest = manifest
+        self.manifests_written += 1
+        self._log(
+            f"snapshot {snap['id']} complete: map v{snap['map_version']}, "
+            + ", ".join(
+                f"s{r.server_id}=[{r.lo},{r.hi})@{r.apply_seq}"
+                for r in manifest.shards)
+            + (f" -> {path}" if path else " (in-memory only)"))
 
     # ---------------------------------------------------------- speculation
     def check_stragglers(self) -> Optional[int]:
@@ -361,7 +537,9 @@ class Coordinator:
             now = self._clock()
             if deadline is not None and now >= deadline:
                 break
-            if now >= next_tick:
+            if now >= next_tick or self._snap_requested:
+                # a requested snapshot barrier starts at the next loop pass,
+                # not the next lease tick — drills measure MTTR in real time
                 self.tick()
                 next_tick = now + max(0.05, self.lease / 4)
             msg = self.transport.recv(timeout=0.1)

@@ -1,0 +1,420 @@
+"""Disaster-recovery drills as first-class machinery (ISSUE 5 tentpole).
+
+A recovery path that is not continuously exercised is a recovery path that
+does not exist. :func:`recovery_drill` stands up the full stack in one
+process — coordinator + N elastic shard servers (WAL + checkpoints on disk)
++ M DownPour workers, the PS stars under ``FaultyTransport`` chaos and the
+``ReliableTransport`` envelope — and runs the ISSUE 5 script:
+
+1. train; at a scripted step, drive a **coordinator-aligned snapshot
+   barrier** (``SnapshotRequest``/``SnapshotDone`` → ``FleetManifest``);
+2. keep training past the snapshot (so acked updates exist that ONLY the
+   write-ahead logs hold);
+3. **kill a shard subset — by default all of them — silently** mid-epoch
+   (the in-process analog of SIGKILL: serve loops die without checkpoint,
+   leave, or WAL flush; their endpoints raise like dead sockets);
+4. **restore** from manifest + WAL: fresh server objects re-install their
+   ranges from the manifest's shard map, replay their logs past the
+   checkpoint, and re-seed their transports' dedup state; workers' pending
+   reliable retries and cadence probes reconnect the fleet;
+5. run to completion and **prove** the recovery: per-(worker, shard)
+   sequence accounting — every acked ``GradientUpdate`` is in the
+   restored server's applied counts (``acked <= applied``, zero acked
+   loss) — plus convergence into the fault-free corridor and a
+   byte-identical chaos log across repeats.
+
+Determinism contract: the injected wire faults are restricted to channels
+whose send sequences are pure functions of the (seeded, step-indexed)
+training script — worker 1's pull channel, with kill/restore driven
+synchronously from worker 1's own step hook — so the fault log renders
+byte-identically run after run (``tests/test_drill.py`` asserts it 3x).
+``GradientUpdate`` frames ride the reliability envelope and are never
+faulted directly: their loss-freedom must come from WAL + deferred acks,
+not from luck.
+
+``make drill`` runs the drill suite; ``bench_all.recovery_phase()`` times
+MTTR and replayed-update counts on this machinery.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from distributed_ml_pytorch_tpu.coord.coordinator import Coordinator
+from distributed_ml_pytorch_tpu.coord.elastic import ElasticShardServer
+from distributed_ml_pytorch_tpu.coord.manifest import (
+    MANIFEST_NAME,
+    FleetManifest,
+)
+from distributed_ml_pytorch_tpu.coord.member import CoordClient
+from distributed_ml_pytorch_tpu.utils.chaos import (
+    ChaosLog,
+    ChaosPlan,
+    FaultRule,
+    FaultyTransport,
+)
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    InProcessTransport,
+    MessageCode,
+    ReliableTransport,
+)
+
+#: codes that go PLAIN (outside the reliability envelope) in drill worlds.
+#: Pulls and replies are periodic, idempotent and cadence-driven — the
+#: staleness channel DownPour tolerates by design — which makes them both
+#: safe to fault and DETERMINISTIC to fault: their per-channel send indices
+#: are a pure function of the step script, so the chaos log is
+#: byte-identical across repeats.
+DRILL_UNRELIABLE = (
+    MessageCode.Heartbeat,
+    MessageCode.LeaseRenew,
+    MessageCode.ParameterRequest,
+    MessageCode.ParameterUpdate,
+)
+
+
+def default_drill_plan(seed: int = 0) -> ChaosPlan:
+    """Wire noise on worker 1's pull channel only (src=1 → server rank 0).
+
+    Worker 1 is the thread that drives kill/restore synchronously from its
+    own step hook, so its outage window is step-exact and its channel
+    indices replay identically; other workers' timing floats free of the
+    script, so faulting their channels would make the log race-dependent.
+    """
+    return ChaosPlan(
+        [FaultRule(src=1, dst=0, code=int(MessageCode.ParameterRequest),
+                   drop=0.2, dup=0.1)],
+        seed=seed)
+
+
+def _default_fixture(seed: int):
+    from distributed_ml_pytorch_tpu.coord.demo import (
+        _default_fixture as fixture,
+    )
+
+    return fixture(seed)
+
+
+def _wait_for(predicate, timeout: float, what: str, poll: float = 0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = predicate()
+        if got:
+            return got
+        time.sleep(poll)
+    raise TimeoutError(f"drill: timed out after {timeout:.0f}s waiting for "
+                       f"{what}")
+
+
+def recovery_drill(
+    *,
+    base_dir: str,
+    seed: int = 0,
+    steps: int = 18,
+    snapshot_at: Optional[int] = 6,
+    kill_at: Optional[int] = 10,
+    outage_steps: int = 2,
+    kill_shards: Optional[Sequence[int]] = None,
+    n_workers: int = 2,
+    n_shards: int = 2,
+    plan: Optional[ChaosPlan] = None,
+    lease: float = 5.0,
+    lr: float = 0.05,
+    n_push: int = 2,
+    n_pull: int = 2,
+    batch: int = 16,
+    wal_group_n: int = 4,
+    fixture=None,
+) -> Dict:
+    """Run one kill-and-recover drill (see module docstring).
+
+    ``snapshot_at`` / ``kill_at`` / the restore (``kill_at + outage_steps``)
+    are step indices of worker 1's loop, driven synchronously from its step
+    hook. ``kill_shards`` selects the victim subset (shard indices; default
+    = ALL shards). ``kill_at=None`` runs the fault-free corridor baseline.
+    Per-shard state (checkpoint + WAL) lives under ``base_dir/shard<i>``,
+    the fleet manifest under ``base_dir``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ml_pytorch_tpu.parallel.sharded_ps import (
+        ShardedAsynchronous,
+    )
+    from distributed_ml_pytorch_tpu.utils.serialization import (
+        ravel_model_params,
+    )
+
+    if fixture is not None:
+        x, y, grad_fn, params0 = fixture
+    else:
+        x, y, grad_fn, params0 = _default_fixture(seed)
+    flat0 = np.asarray(ravel_model_params(params0), np.float32)
+    n_params = int(flat0.shape[0])
+    victims = (list(range(n_shards)) if kill_shards is None
+               else sorted(set(int(i) for i in kill_shards)))
+
+    # --- worlds: coordination star (plain) + one chaos-wrapped PS star per
+    # shard, all stars sharing one fault log; each star owns its own crash
+    # state so a subset kill stays a subset ------------------------------
+    log = ChaosLog()
+    the_plan = plan if plan is not None else ChaosPlan(seed=seed)
+    coord_world = InProcessTransport.create_world(1 + n_shards + n_workers)
+    star_chaos: List[Dict[int, FaultyTransport]] = []
+    for i in range(n_shards):
+        world = InProcessTransport.create_world(1 + n_workers)
+        hub = FaultyTransport(world[0], the_plan, log=log)
+        star = {0: hub}
+        for r in range(1, 1 + n_workers):
+            star[r] = hub.sibling(world[r])
+        star_chaos.append(star)
+
+    def make_server_transport(i: int) -> ReliableTransport:
+        return ReliableTransport(
+            star_chaos[i][0], ack_timeout=0.05, max_backoff=0.25,
+            max_retries=120, unreliable_codes=DRILL_UNRELIABLE,
+            ack_on_delivery=False)
+
+    rel_workers: List[Dict[int, ReliableTransport]] = []
+    for i in range(n_shards):
+        rel_workers.append({
+            j: ReliableTransport(
+                star_chaos[i][j], ack_timeout=0.05, max_backoff=0.25,
+                max_retries=120, unreliable_codes=DRILL_UNRELIABLE)
+            for j in range(1, 1 + n_workers)})
+
+    manifest_path = os.path.join(base_dir, MANIFEST_NAME)
+    coord = Coordinator(
+        coord_world[0], n_params, lease=lease, speculation=False,
+        manifest_dir=base_dir)
+    coord_thread = threading.Thread(
+        target=coord.run, kwargs={"timeout": 600}, daemon=True)
+    coord_thread.start()
+
+    def start_server(i: int) -> ElasticShardServer:
+        client = CoordClient(coord_world[1 + i], "shard",
+                             renew_interval=lease / 4)
+        srv = ElasticShardServer(
+            server_id=1 + i, n_params=n_params,
+            transport=make_server_transport(i), coord=client,
+            init_params=flat0, ckpt_dir=os.path.join(base_dir, f"shard{i}"),
+            ckpt_every=0, wal=True, wal_group_n=wal_group_n)
+        t = threading.Thread(target=srv.run, kwargs={"timeout": 600},
+                             daemon=True)
+        t.start()
+        return srv
+
+    servers: List[ElasticShardServer] = [start_server(i)
+                                         for i in range(n_shards)]
+    retired_servers: List[ElasticShardServer] = []
+    _wait_for(lambda: len(coord.shard_map.entries) == n_shards, 60,
+              "all shard servers to join the map")
+
+    timings: Dict[str, float] = {}
+    losses: Dict[int, list] = {}
+    opts: Dict[int, object] = {}
+    errors: list = []
+    restored_info = {"replayed": 0, "manifest": None}
+    restored_evt = threading.Event()
+    if kill_at is None:
+        restored_evt.set()  # corridor baseline: nothing to wait out
+
+    def kill_fleet() -> None:
+        timings["killed"] = time.monotonic()
+        for i in victims:
+            servers[i].crash()
+            star_chaos[i][0].crash()
+
+    def restore_fleet() -> None:
+        t0 = time.monotonic()
+        manifest = FleetManifest.load(manifest_path)  # refuses bad manifests
+        restored_info["manifest"] = manifest.to_dict()
+        for i in victims:
+            star_chaos[i][0].restart()
+            old = servers[i]
+            detach = getattr(old.transport, "detach", None)
+            if detach is not None:
+                detach()  # the dead life's wrapper; its endpoint lives on
+            retired_servers.append(old)
+            client = CoordClient(coord_world[1 + i], "shard",
+                                 renew_interval=lease / 4)
+            srv = ElasticShardServer(
+                server_id=1 + i, n_params=n_params,
+                transport=make_server_transport(i), coord=client,
+                init_params=flat0,
+                ckpt_dir=os.path.join(base_dir, f"shard{i}"),
+                ckpt_every=0, wal=True, wal_group_n=wal_group_n)
+            srv.restore_from_manifest(manifest)
+            restored_info["replayed"] += srv.ps.replayed_updates
+            servers[i] = srv
+            t = threading.Thread(target=srv.run, kwargs={"timeout": 600},
+                                 daemon=True)
+            t.start()
+        timings["restored"] = time.monotonic()
+        timings["restore_s"] = timings["restored"] - t0
+
+    def step_hook(j: int, step: int) -> None:
+        if j != 1:
+            # every other worker pauses at the kill step until the fleet is
+            # restored, so the WHOLE fleet (not just the scripting worker)
+            # trains across the outage; this couples only thread timing on
+            # unfaulted channels, so the chaos log stays deterministic
+            if kill_at is not None and step == kill_at:
+                restored_evt.wait(300)
+            return
+        if snapshot_at is not None and step == snapshot_at:
+            coord.trigger_snapshot()
+            _wait_for(lambda: os.path.exists(manifest_path)
+                      and coord.manifests_written > 0, 60,
+                      "the snapshot barrier to publish a manifest")
+        if kill_at is not None:
+            if step == kill_at:
+                kill_fleet()
+            elif step == kill_at + outage_steps:
+                try:
+                    restore_fleet()
+                finally:
+                    restored_evt.set()  # waiting workers resume even if
+                    # the restore itself failed (the error surfaces)
+
+    def run_worker(j: int) -> None:
+        try:
+            _run_worker(j)
+        except Exception as e:  # noqa: BLE001 — surfaced to the caller
+            errors.append((j, repr(e)))
+
+    def _run_worker(j: int) -> None:
+        client = CoordClient(coord_world[n_shards + j], "worker",
+                             renew_interval=lease / 4)
+        m = client.join(timeout=30)
+        assert m is not None and m.entries, "worker never got a shard map"
+        factory = lambda entry: rel_workers[entry.server_id - 1][j]
+        params = jax.tree.map(jnp.asarray, params0)
+        opt = ShardedAsynchronous(
+            params, lr=lr, n_push=n_push, n_pull=n_pull,
+            transports=[factory(e) for e in m.entries],
+            coord=client, transport_factory=factory, shard_map=m)
+        opts[j] = opt
+        rng = jax.random.key(100 + j)
+        my_losses = losses.setdefault(j, [])
+        for step in range(steps):
+            sel = np.random.default_rng(j * 1000 + step).integers(
+                0, len(x), batch)
+            loss, grads = grad_fn(params, x[sel], y[sel],
+                                  jax.random.fold_in(rng, step))
+            params = opt.step(params, grads)
+            my_losses.append(float(loss))
+            step_hook(j, step)
+        opt.finish()
+        client.close()
+
+    # MTTR watcher: "recovered" = every restored shard has answered a pull
+    # again (message_counts starts at 0 on the fresh server objects)
+    def watch_recovery() -> None:
+        while "killed" not in timings:
+            if watch_stop.wait(0.02):
+                return
+        while not watch_stop.is_set():
+            if "restored" in timings and all(
+                servers[i].ps.message_counts.get(
+                    MessageCode.ParameterRequest, 0) > 0
+                for i in victims
+            ):
+                timings["recovered"] = time.monotonic()
+                return
+            watch_stop.wait(0.02)
+
+    watch_stop = threading.Event()
+    watcher = None
+    if kill_at is not None:
+        watcher = threading.Thread(target=watch_recovery, daemon=True)
+        watcher.start()
+
+    worker_threads = [threading.Thread(target=run_worker, args=(j,),
+                                       daemon=True)
+                      for j in range(1, n_workers + 1)]
+    for t in worker_threads:
+        t.start()
+    for t in worker_threads:
+        t.join(timeout=600)
+    stuck = [t for t in worker_threads if t.is_alive()]
+    watch_stop.set()
+    if watcher is not None:
+        watcher.join(timeout=10)
+    for srv in servers:
+        srv.stop()
+    time.sleep(0.05)
+    coord.stop()
+    coord_thread.join(timeout=30)
+
+    # ---- sequence accounting: every acked GradientUpdate must be in the
+    # (restored) server's applied counts ---------------------------------
+    acked: Dict[int, Dict[int, int]] = {}
+    applied: Dict[int, Dict[int, int]] = {}
+    for i in range(n_shards):
+        acked[i] = {j: rel_workers[i][j].acked_count(
+            0, MessageCode.GradientUpdate) for j in range(1, 1 + n_workers)}
+        applied[i] = {j: servers[i].ps.applied_by_sender.get(j, 0)
+                      for j in range(1, 1 + n_workers)}
+    accounting_ok = all(
+        acked[i][j] <= applied[i][j]
+        for i in range(n_shards) for j in range(1, 1 + n_workers))
+
+    for star in rel_workers:
+        for t in star.values():
+            t.close()
+    for srv in servers:
+        close = getattr(srv.transport, "close", None)
+        if close is not None:
+            close()
+    for t in coord_world.values():
+        t.close()
+
+    mttr = (timings["recovered"] - timings["killed"]
+            if "recovered" in timings and "killed" in timings else None)
+    return {
+        "ok": not stuck and not errors and accounting_ok,
+        "errors": errors,
+        "stuck_workers": len(stuck),
+        "losses": losses,
+        "acked": acked,
+        "applied": applied,
+        "accounting_ok": accounting_ok,
+        "replayed_updates": restored_info["replayed"],
+        "manifest": restored_info["manifest"],
+        "chaos_lines": log.lines(),
+        "chaos_counts": log.counts(),
+        "events": list(coord.events),
+        "stats": {srv.server_id: dict(srv.stats) for srv in servers},
+        "mttr_s": mttr,
+        "restore_s": timings.get("restore_s"),
+        "servers": servers,
+    }
+
+
+def drill_demo(seed: int = 0, base_dir: Optional[str] = None) -> Dict:
+    """One self-contained drill pass (``coord/cli.py --drill``)."""
+    import tempfile
+
+    base = base_dir or tempfile.mkdtemp(prefix="drill_")
+    out = recovery_drill(base_dir=base, seed=seed,
+                         plan=default_drill_plan(seed))
+    return {
+        # > 0: the drill must actually have exercised WAL replay (acked
+        # updates that ONLY the logs held), or "ok" proves nothing
+        "ok": out["ok"] and out["replayed_updates"] > 0,
+        "mttr_s": out["mttr_s"],
+        "restore_s": out["restore_s"],
+        "replayed_updates": out["replayed_updates"],
+        "acked": out["acked"],
+        "applied": out["applied"],
+        "chaos": out["chaos_counts"],
+        "events": out["events"],
+        "manifest": out["manifest"],
+        "state_dir": base,
+    }

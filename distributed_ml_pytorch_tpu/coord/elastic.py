@@ -63,6 +63,8 @@ class ElasticShardServer:
         staleness_damping: float = 0.0,
         ckpt_dir: Optional[str] = None,
         ckpt_every: int = 500,
+        wal: bool = False,
+        wal_group_n: int = 8,
     ):
         self.server_id = int(server_id)
         self.n_params = int(n_params)
@@ -83,8 +85,17 @@ class ElasticShardServer:
         self.ps = ParameterServer(
             params=np.zeros(1, np.float32), transport=transport,
             ckpt_dir=ckpt_dir, ckpt_every=ckpt_every,
-            staleness_damping=staleness_damping)
+            staleness_damping=staleness_damping, wal=wal,
+            wal_group_n=wal_group_n)
         self._seen_tasks: set = set()
+        #: snapshot-barrier mailbox: the coord listener thread deposits the
+        #: (snapshot_id, map_version) request here; the serve loop takes it
+        #: at its next version boundary (between applied updates) — the
+        #: barrier's "checkpoint at your next boundary" semantics
+        self._snap_mu = threading.Lock()
+        self._snap_req: Optional[tuple] = None
+        if getattr(coord, "on_snapshot", None) is None:
+            coord.on_snapshot = self._note_snapshot
         self.stats = {
             "stale_dropped": 0, "parked_pulls": 0, "installs": 0,
             "dup_installs": 0, "spec_applied": 0, "spec_dropped": 0,
@@ -128,6 +139,11 @@ class ElasticShardServer:
             return
         if (e.lo, e.hi) == (self.lo, self.hi):
             return
+        if self.ps.wal is not None and self.hi > self.lo:
+            # WAL records are sized for the range they were applied under —
+            # they must never straddle a resize. Checkpoint (which truncates
+            # the log) so on-disk state always describes ONE range.
+            self.ps.save_checkpoint()
         new_central = np.zeros(e.size, np.float32)
         if self._init_flat is not None:
             # a known init seeds the whole range; worker installs refine it
@@ -153,10 +169,83 @@ class ElasticShardServer:
         self.ps.central = new_central
         self.stats["resizes"] += 1
 
+    # ---------------------------------------------------------- snapshots
+    def _note_snapshot(self, snapshot_id: int, map_version: int) -> None:
+        """Coord-listener-thread callback: park the barrier request for the
+        serve loop (newest request wins — re-requests are idempotent)."""
+        with self._snap_mu:
+            self._snap_req = (int(snapshot_id), int(map_version))
+
+    def _take_snapshot_request(self) -> Optional[tuple]:
+        with self._snap_mu:
+            req, self._snap_req = self._snap_req, None
+            return req
+
+    def _do_snapshot(self, snapshot_id: int, map_version: int) -> None:
+        """The shard half of the barrier: at this version boundary (the
+        serve loop sits between applied updates here), commit the WAL
+        group, checkpoint, and report. A request stamped for another map
+        version still checkpoints (never harmful) but reports THIS
+        server's version — the coordinator refuses the mixed barrier."""
+        with self._mu:
+            if map_version != self.map_version:
+                print(
+                    f"shard {self.server_id}: snapshot {snapshot_id} asks "
+                    f"map v{map_version} but this server serves "
+                    f"v{self.map_version} — reporting the truth",
+                    file=sys.stderr)
+            self.ps.commit()
+            self.ps.save_checkpoint()
+            mv, lo, hi = self.map_version, self.lo, self.hi
+            apply_seq = self.ps._apply_seq
+            push_count = self.ps._push_count
+        self.coord.snapshot_done(
+            snapshot_id, mv, lo, hi, apply_seq, push_count)
+
+    def restore_from_manifest(self, manifest) -> None:
+        """Disaster recovery (ISSUE 5): re-install this shard's range from
+        the manifest's shard map, then restore checkpoint + WAL replay.
+
+        Refuses LOUDLY when the manifest is invalid/mixed/incomplete
+        (``FleetManifest.validate``), omits this server, or the on-disk
+        state cannot reproduce at least the apply sequence the manifest
+        promises — serving zeros (or a stale clock) as restored central
+        params is the silent corruption this plane exists to prevent."""
+        from distributed_ml_pytorch_tpu.coord.manifest import ManifestError
+
+        manifest.validate()
+        entry = manifest.entry_for(self.server_id)
+        with self._mu:
+            self.lo, self.hi = entry.lo, entry.hi
+            self.map_version = manifest.map_version
+            central = np.zeros(entry.hi - entry.lo, np.float32)
+            if self._init_flat is not None:
+                central[:] = self._init_flat[entry.lo:entry.hi]
+            self.ps.central = central
+            if not self.ps.maybe_restore():
+                raise ManifestError(
+                    f"shard {self.server_id}: manifest promises a "
+                    f"checkpoint for [{entry.lo},{entry.hi}) but nothing "
+                    f"restorable exists under {self.ps.ckpt_dir!r}")
+            if self.ps._apply_seq < entry.apply_seq:
+                raise ManifestError(
+                    f"shard {self.server_id}: restored apply seq "
+                    f"{self.ps._apply_seq} is BEHIND the manifest's "
+                    f"{entry.apply_seq} — checkpoint/WAL lost acked state")
+            # a manifest restore is authoritative: nothing awaits install,
+            # and a worker's stale RangeInstall must not stomp it
+            self.pending_install = None
+        print(
+            f"shard {self.server_id}: restored [{entry.lo},{entry.hi}) at "
+            f"apply seq {self.ps._apply_seq} "
+            f"({self.ps.replayed_updates} WAL record(s) replayed)",
+            file=sys.stderr)
+
     # --------------------------------------------------------------- handle
     def handle(self, sender: int, code: MessageCode,
-               payload: np.ndarray) -> None:
+               payload: np.ndarray, envelope: Optional[tuple] = None) -> None:
         with self._mu:
+            self.ps._envelope = envelope
             self._handle_locked(sender, code, payload)
 
     def _handle_locked(self, sender: int, code: MessageCode,
@@ -232,22 +321,39 @@ class ElasticShardServer:
             m = self.coord.take_shard_map()
             if m is not None:
                 self._apply_map(m)
+            snap = self._take_snapshot_request()
+            if snap is not None:
+                self._do_snapshot(*snap)
             if self.coord.fleet.workers_done():
                 break
             msg = self.transport.recv(timeout=0.1)
             if msg is None:
+                # idle: close the open WAL group so deferred delivery acks
+                # never wait longer than one recv timeout
+                with self._mu:
+                    self.ps.commit()
                 continue
             sender, code, payload = msg
+            envelope = getattr(self.transport, "last_delivery", None)
             if code in (MessageCode.Heartbeat, MessageCode.WorkerDone):
-                continue  # worker lifecycle is the coordinator's job here
+                # worker lifecycle is the coordinator's job here, but an
+                # enveloped WorkerDone still owes its (deferred) ack
+                with self._mu:
+                    self.ps.commit()
+                continue
             try:
-                self.handle(sender, code, payload)
+                self.handle(sender, code, payload, envelope)
             except (ValueError, IndexError, OverflowError):
                 continue  # malformed frame: drop, never die
+            if (self.ps.wal is None or code != MessageCode.GradientUpdate
+                    or self.ps.wal.pending >= self.ps.wal_group_n):
+                with self._mu:
+                    self.ps.commit()
         if self._crashed:
             return  # scripted silent death: no checkpoint, no leave
         with self._mu:
             self.ps.save_checkpoint()
+            self.ps.commit()
         self.coord.close()
 
     @property

@@ -42,11 +42,13 @@ from distributed_ml_pytorch_tpu.coord.coordinator import (
     encode_join,
     encode_leave,
     encode_renew,
+    encode_snapshot_done,
 )
 from distributed_ml_pytorch_tpu.coord.shardmap import ShardMap
 from distributed_ml_pytorch_tpu.utils.messaging import (
     MessageCode,
     Transport,
+    _join16,
     _next_incarnation,
 )
 
@@ -93,6 +95,7 @@ class CoordClient:
         incarnation: Optional[int] = None,
         on_shard_map: Optional[Callable[[ShardMap], None]] = None,
         on_speculate: Optional[Callable[[int, int, int], None]] = None,
+        on_snapshot: Optional[Callable[[int, int], None]] = None,
     ):
         if kind not in _KINDS:
             raise ValueError(f"kind must be one of {sorted(_KINDS)}, got {kind!r}")
@@ -108,6 +111,12 @@ class CoordClient:
         self.coord_down = False
         self._on_shard_map = on_shard_map
         self._on_speculate = on_speculate
+        #: PUBLIC and mutable: shard servers are usually constructed AFTER
+        #: their coord client, so ElasticShardServer wires its snapshot
+        #: mailbox in by assignment (``client.on_snapshot = cb``); called
+        #: with ``(snapshot_id, map_version)`` on the listener thread,
+        #: outside any client lock
+        self.on_snapshot = on_snapshot
         self._lock = threading.Lock()
         self._latest_map: Optional[ShardMap] = None
         self._current_version = -1
@@ -160,6 +169,11 @@ class CoordClient:
             if self._on_speculate is not None and np.isfinite(payload[:3]).all():
                 self._on_speculate(
                     int(payload[0]), int(payload[1]), int(payload[2]))
+        elif code == MessageCode.SnapshotRequest and payload.size >= 4:
+            if self.on_snapshot is not None and np.isfinite(payload[:4]).all():
+                self.on_snapshot(
+                    _join16(payload[0], payload[1]),
+                    _join16(payload[2], payload[3]))
 
     def _renew_loop(self) -> None:
         tick = 0
@@ -207,6 +221,12 @@ class CoordClient:
         with self._lock:
             m, self._latest_map = self._latest_map, None
             return m
+
+    def snapshot_done(self, snapshot_id: int, map_version: int, lo: int,
+                      hi: int, apply_seq: int, push_count: int) -> None:
+        """Report this shard's completed checkpoint into the barrier."""
+        self._send(MessageCode.SnapshotDone, encode_snapshot_done(
+            snapshot_id, map_version, lo, hi, apply_seq, push_count))
 
     def leave(self) -> None:
         self._send(MessageCode.CoordLeave, encode_leave(self.incarnation))

@@ -43,11 +43,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_ml_pytorch_tpu.utils.durability import atomic_write
 from distributed_ml_pytorch_tpu.utils.messaging import (
     SERVER_RANK,
     MessageCode,
     MessageListener,
     Transport,
+    _next_incarnation,
     send_message,
 )
 from distributed_ml_pytorch_tpu.utils.serialization import (
@@ -80,6 +82,8 @@ class ParameterServer:
         ckpt_dir: Optional[str] = None,
         ckpt_every: int = 500,
         staleness_damping: float = 0.0,
+        wal: bool = False,
+        wal_group_n: int = 8,
     ):
         if params is not None:
             self.central = np.asarray(params, dtype=np.float32).copy()
@@ -100,6 +104,45 @@ class ParameterServer:
         self._push_count = 0
         self._restored = False
         self.rejected_installs = 0
+        # --- durability plane (ISSUE 5) ---------------------------------
+        #: this server LIFE's incarnation stamp (WAL records carry it so a
+        #: dead life's late-flushed tail is detectable on replay)
+        self.incarnation = _next_incarnation()
+        #: server-side apply sequence: one increment per applied
+        #: GradientUpdate, monotonic across lives (restored from the
+        #: checkpoint meta) — the WAL/checkpoint handshake key
+        self._apply_seq = 0
+        #: per-sender applied-update counts — the server half of the
+        #: drill's sequence accounting (survives restore via meta + WAL)
+        self.applied_by_sender: dict = {}
+        self.replayed_updates = 0
+        self.dropped_bad_updates = 0
+        self.wal_group_n = int(wal_group_n)
+        #: envelope identities of recent applies, persisted in the ckpt
+        #: meta: WAL truncation discards the per-record envelopes, but an
+        #: ack can be lost in flight — this tail keeps the dedup seed for
+        #: retries of updates the checkpoint already covers
+        import collections
+
+        self._recent_envelopes = collections.deque(maxlen=512)
+        #: (incarnation, seq) of the reliability envelope that delivered
+        #: the frame being handled (run() stashes transport.last_delivery
+        #: here) — recorded per WAL record for restart-time dedup seeding
+        self._envelope = None
+        self._prev_ckpt_meta = None
+        self.wal = None
+        if wal:
+            if not self.ckpt_dir:
+                raise ValueError(
+                    "wal=True needs a ckpt_dir — the write-ahead log lives "
+                    "beside the checkpoint it protects")
+            import os
+
+            from distributed_ml_pytorch_tpu.utils.wal import WriteAheadLog
+
+            self.wal = WriteAheadLog(
+                os.path.join(self.ckpt_dir, "ps_wal.log"),
+                incarnation=self.incarnation)
         #: staleness-weighted apply (arxiv 2006.02924 motivates weighting
         #: contributions by staleness): a push that raced `s` central
         #: versions since its worker last pulled applies scaled by
@@ -126,65 +169,216 @@ class ParameterServer:
         return os.path.join(self.ckpt_dir, "ps_meta.json")
 
     def save_checkpoint(self) -> None:
-        """Atomically persist the central flat params (write-then-rename, so
-        a preemption mid-write can never leave a torn checkpoint), plus a
-        sidecar with the central version / push count so a restarted server
-        resumes the staleness clock, not just the vector (ISSUE 2)."""
+        """Persist the central params + resume clock, atomically AND
+        power-loss durably (every write rides ``utils.atomic_write``:
+        fsync'd temp file, rename, directory fsync).
+
+        Vector (``ps_central.npy``) and meta (``ps_meta.json``) are BOUND by
+        a CRC so the ISSUE 5 tear window — a crash between the two renames —
+        can never pair a v+1 vector with a v clock silently: the meta is
+        written FIRST, carries the new vector's checksum, and keeps the
+        previous generation's fields under ``"prev"``; ``maybe_restore``
+        cross-checks the CRC and resolves a tear to the consistent PREVIOUS
+        generation (whose updates the WAL, when enabled, still holds — it is
+        only truncated after both renames land)."""
         if not self.ckpt_dir:
             return
+        import io
         import json
         import os
+        import zlib
 
         os.makedirs(self.ckpt_dir, exist_ok=True)
-        path = self._ckpt_path()
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.save(f, self.central)
-        os.replace(tmp, path)
-        meta_tmp = self._meta_path() + ".tmp"
-        with open(meta_tmp, "w") as f:
-            json.dump({"version": self.staleness.version,
-                       "push_count": self._push_count}, f)
-        os.replace(meta_tmp, self._meta_path())
+        if self.wal is not None:
+            self.wal.sync()  # never let the checkpoint get ahead of the log
+        buf = io.BytesIO()
+        np.save(buf, self.central)
+        blob = buf.getvalue()
+        meta = {
+            "version": self.staleness.version,
+            "push_count": self._push_count,
+            "apply_seq": self._apply_seq,
+            "applied_by_sender": {
+                str(k): int(v) for k, v in self.applied_by_sender.items()},
+            "central_crc": zlib.crc32(blob) & 0xFFFFFFFF,
+            "recent_envelopes": [list(e) for e in self._recent_envelopes],
+            "prev": self._prev_ckpt_meta,
+        }
+        atomic_write(self._meta_path(), json.dumps(meta).encode())
+        atomic_write(self._ckpt_path(), blob)
+        self._prev_ckpt_meta = {k: v for k, v in meta.items() if k != "prev"}
+        if self.wal is not None:
+            # the checkpoint just made every logged update durable: release
+            # the delivery acks deferred behind them BEFORE truncating the
+            # records (and their envelope identities) away — and since an
+            # ack can still be lost in flight, the meta's recent_envelopes
+            # tail (written above) keeps the dedup seed for retries of
+            # updates the checkpoint already covers
+            ack = getattr(self.transport, "ack_delivered", None)
+            if ack is not None:
+                ack()
+            self.wal.truncate(self._apply_seq)
 
     def maybe_restore(self) -> bool:
-        """Adopt a previously-saved central vector (and its version sidecar,
-        when present); False if none exists. A size mismatch (different
-        model) fails loudly — silently training a fresh init while claiming
-        to resume is the one wrong answer."""
+        """Adopt the saved central vector + clock and replay the WAL past
+        it; False if nothing restorable exists. Failure modes are LOUD: a
+        size mismatch (wrong model), a vector matching neither its meta's
+        CRC nor the previous generation's (real corruption), and mid-log
+        WAL damage all raise — silently training a fresh init (or a wrong
+        staleness clock) while claiming to resume is the one wrong answer."""
         if not self.ckpt_dir:
             return False
         import json
         import os
+        import zlib
 
         path = self._ckpt_path()
-        if not os.path.exists(path):
-            return False
-        arr = np.load(path)
-        if arr.shape != self.central.shape:
-            raise ValueError(
-                f"checkpoint at {path} holds {arr.shape[0]} params but the "
-                f"model ravels to {self.central.shape[0]} — wrong --model?"
-            )
-        self.central = arr.astype(np.float32)
-        if os.path.exists(self._meta_path()):
-            with open(self._meta_path()) as f:
-                meta = json.load(f)
-            self.staleness.version = int(meta.get("version", 0))
-            self._push_count = int(meta.get("push_count", 0))
-        self._restored = True
-        return True
+        restored = False
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                blob = f.read()
+            import io
+
+            arr = np.load(io.BytesIO(blob))
+            if arr.shape != self.central.shape:
+                raise ValueError(
+                    f"checkpoint at {path} holds {arr.shape[0]} params but "
+                    f"the model ravels to {self.central.shape[0]} — wrong "
+                    "--model?"
+                )
+            meta = None
+            if os.path.exists(self._meta_path()):
+                with open(self._meta_path()) as f:
+                    meta = json.load(f)
+            if meta is not None and "central_crc" in meta:
+                crc = zlib.crc32(blob) & 0xFFFFFFFF
+                if crc != int(meta["central_crc"]):
+                    prev = meta.get("prev")
+                    if prev is not None and int(prev.get("central_crc", -1)) == crc:
+                        # the tear window: the new meta landed, the vector
+                        # rename did not — the on-disk vector IS the
+                        # previous generation; adopt its matching clock
+                        # (the WAL still holds the gap's updates)
+                        _LOGGER.warning(
+                            "checkpoint meta is one generation ahead of the "
+                            "vector (crash between renames) — restoring the "
+                            "previous consistent generation")
+                        meta = prev
+                    else:
+                        raise ValueError(
+                            f"checkpoint at {path} matches neither its meta "
+                            "CRC nor the previous generation's — refusing "
+                            "to resume with an unverifiable staleness clock")
+            self.central = arr.astype(np.float32)
+            if meta is not None:
+                self.staleness.version = int(meta.get("version", 0))
+                self._push_count = int(meta.get("push_count", 0))
+                self._apply_seq = int(meta.get("apply_seq", 0))
+                self.applied_by_sender = {
+                    int(k): int(v)
+                    for k, v in meta.get("applied_by_sender", {}).items()}
+                self._recent_envelopes.extend(
+                    (int(s), int(i), int(q))
+                    for s, i, q in meta.get("recent_envelopes", []))
+                self._prev_ckpt_meta = {
+                    k: v for k, v in meta.items() if k != "prev"}
+            restored = True
+        if self.wal is not None:
+            restored = bool(self._replay_wal()) or restored
+        if restored:
+            self._restored = True
+        return restored
+
+    def _replay_wal(self) -> int:
+        """Re-apply logged updates the checkpoint does not cover; returns
+        how many replayed. Records the checkpoint already covers (``seq <=
+        apply_seq`` — a checkpoint that raced the truncation) are skipped,
+        so replay is idempotent; every surviving record's delivery envelope
+        re-seeds the transport's dedup (``ReliableTransport.seed_dedup``)
+        so a sender's retry of an applied-but-unacked frame is re-acked,
+        never re-applied."""
+        records, stats = self.wal.replay()
+        # seed sources: the ckpt meta's recent-envelope tail (covers
+        # records a truncation discarded whose acks may have been lost in
+        # flight) plus every surviving record's own envelope
+        envelopes = [tuple(e) for e in self._recent_envelopes]
+        n = 0
+        for rec in records:
+            if rec.env_inc or rec.env_seq:
+                envelopes.append((rec.sender, rec.env_inc, rec.env_seq))
+                self._recent_envelopes.append(
+                    (rec.sender, rec.env_inc, rec.env_seq))
+            if rec.seq <= self._apply_seq:
+                continue
+            if rec.payload.shape != self.central.shape:
+                raise ValueError(
+                    f"WAL record seq {rec.seq} holds {rec.payload.shape[0]} "
+                    f"params but the restored vector holds "
+                    f"{self.central.shape[0]} — log/checkpoint mismatch")
+            self.central += rec.payload
+            self._apply_seq = rec.seq
+            self._push_count += 1
+            self.staleness.version += 1
+            self.applied_by_sender[rec.sender] = (
+                self.applied_by_sender.get(rec.sender, 0) + 1)
+            n += 1
+        self.replayed_updates += n
+        if stats["stale_skipped"] or stats["torn_tail"]:
+            _LOGGER.warning(
+                "WAL replay: %d stale-incarnation record(s) skipped, torn "
+                "tail=%d", stats["stale_skipped"], stats["torn_tail"])
+        seed = getattr(self.transport, "seed_dedup", None)
+        if seed is not None and envelopes:
+            seed(envelopes)
+        return n
+
+    def commit(self) -> None:
+        """Group commit: fsync the WAL batch, then release the delivery
+        acks deferred behind it (``ReliableTransport.ack_delivered``) —
+        log-before-ack is what upgrades "acked" to "survives a crash"."""
+        if self.wal is not None:
+            self.wal.sync()
+        ack = getattr(self.transport, "ack_delivered", None)
+        if ack is not None:
+            ack()
 
     def handle(self, sender: int, code: MessageCode, payload: np.ndarray) -> None:
         _LOGGER.info("Processing message: %s", code.name)
         self.message_counts[code] = self.message_counts.get(code, 0) + 1
         if code == MessageCode.GradientUpdate:
+            if payload.shape != self.central.shape:
+                # validate BEFORE any accounting or WAL append: a wrong-size
+                # update must not inflate the apply clock, poison the log
+                # with a record replay can never fit (it would refuse every
+                # future restore), or numpy-broadcast into the vector
+                self.dropped_bad_updates += 1
+                _LOGGER.warning(
+                    "dropping GradientUpdate from %d: %d params vs central "
+                    "%d (wrong model / stale partition?)", sender,
+                    payload.shape[0], self.central.shape[0])
+                return
             # workers pre-scale by -lr (Asynchronous.py:55) → server-side add
             staleness = self.staleness.on_push(sender)
             if self.staleness_damping > 0.0 and staleness > 0:
-                self.central += payload / (1.0 + self.staleness_damping * staleness)
+                delta = (payload / (1.0 + self.staleness_damping * staleness)
+                         ).astype(np.float32)
             else:
-                self.central += payload
+                delta = payload
+            self._apply_seq += 1
+            self.applied_by_sender[sender] = (
+                self.applied_by_sender.get(sender, 0) + 1)
+            if self.wal is not None:
+                # log-before-apply(-before-ack): the APPLIED delta (post
+                # damping) is what replay must reproduce; once the record
+                # is fsync'd (commit()) the delivery ack is released and
+                # the update can never be lost
+                env_inc, env_seq = self._envelope or (0, 0)
+                self.wal.append(self._apply_seq, delta, sender=sender,
+                                env_inc=env_inc, env_seq=env_seq)
+                if env_inc or env_seq:
+                    self._recent_envelopes.append(
+                        (sender, env_inc, env_seq))
+            self.central += delta
             self._push_count += 1
             if self.ckpt_dir and self.ckpt_every and (
                 self._push_count % self.ckpt_every == 0
@@ -264,8 +458,12 @@ class ParameterServer:
                     break
             msg = self.transport.recv(timeout=0.2)
             if msg is None:
+                # idle: close out any open WAL group so deferred acks are
+                # never withheld longer than one recv timeout
+                self.commit()
                 continue
             sender, code, payload = msg
+            self._envelope = getattr(self.transport, "last_delivery", None)
             if detector is not None:
                 detector.note(sender)  # a failed rank that speaks rejoins
                 self.failed_workers = set(detector.failed)
@@ -274,6 +472,7 @@ class ParameterServer:
                 continue
             if code == MessageCode.WorkerDone:
                 done_workers.add(sender)
+                self.commit()  # its (possibly deferred) ack must not wait
                 if detector is not None:
                     detector.forget(sender)
                 # failed_workers excludes done_workers by construction: note()
@@ -284,7 +483,13 @@ class ParameterServer:
                     break
                 continue
             self.handle(sender, code, payload)
+            if (self.wal is None or code != MessageCode.GradientUpdate
+                    or self.wal.pending >= self.wal_group_n):
+                # group-fsync batching applies to the gradient stream only;
+                # everything else commits (and releases its ack) immediately
+                self.commit()
         self.save_checkpoint()  # final state survives a clean shutdown too
+        self.commit()
         line = self.staleness.report()
         if line:
             print("parameter server:", line)
@@ -939,6 +1144,7 @@ def run_server(args, transport: Transport) -> ParameterServer:
         ckpt_dir=getattr(args, "ckpt_dir", "") or None,
         ckpt_every=getattr(args, "ckpt_every", 500),
         staleness_damping=getattr(args, "staleness_damping", 0.0),
+        wal=getattr(args, "wal", False),
     )
     if getattr(args, "resume", False) and server.maybe_restore():
         print("parameter server: resumed central params from", server._ckpt_path())
@@ -960,6 +1166,7 @@ def run_ps_process(args) -> int:
 
     if args.rank is None:
         raise SystemExit("--rank is required for distributed --mode ps runs")
+    is_server = args.server or args.rank == SERVER_RANK
     transport = make_transport(
         args.rank,
         args.world_size,
@@ -967,10 +1174,14 @@ def run_ps_process(args) -> int:
         int(args.port),
         kind=getattr(args, "transport", "auto"),
         reliable=getattr(args, "reliable", False),
+        # --wal's log-before-ack guarantee: the SERVER defers delivery acks
+        # until the WAL group commit (workers keep acking on delivery —
+        # they never drive commit())
+        durable_acks=is_server and getattr(args, "wal", False),
     )
     heartbeat = None
     try:
-        if args.server or args.rank == SERVER_RANK:
+        if is_server:
             server = run_server(args, transport)
             if not server.failed_workers:
                 print("parameter server: all workers done")

@@ -75,11 +75,13 @@ def make_shard_server(
     ckpt_dir: Optional[str] = None,
     ckpt_every: int = 500,
     staleness_damping: float = 0.0,
+    wal: bool = False,
 ) -> ParameterServer:
     """A shard server: a plain ParameterServer over its contiguous slice.
 
     ``ckpt_dir`` should be per-shard (each server checkpoints only its own
-    slice) — callers typically pass ``f"{dir}/shard{shard}"``.
+    slice) — callers typically pass ``f"{dir}/shard{shard}"``; with
+    ``wal=True`` the shard's write-ahead log lives there too.
     """
     flat = (
         np.asarray(params, np.float32)
@@ -95,6 +97,7 @@ def make_shard_server(
         ckpt_dir=ckpt_dir,
         ckpt_every=ckpt_every,
         staleness_damping=staleness_damping,
+        wal=wal,
     )
 
 
@@ -477,6 +480,9 @@ def run_sharded_ps_process(args) -> int:
         transport = make_transport(
             0, n_workers + 1, args.master, int(args.port) + shard, kind=kind,
             reliable=reliable,
+            # log-before-ack: a WAL'd shard defers delivery acks until its
+            # group commit (ParameterServer.run drives ack_delivered)
+            durable_acks=getattr(args, "wal", False),
         )
         try:
             model = get_model(getattr(args, "model", "alexnet"))
@@ -495,6 +501,9 @@ def run_sharded_ps_process(args) -> int:
                 ckpt_dir=f"{ckpt_dir}/shard{shard}" if ckpt_dir else None,
                 ckpt_every=getattr(args, "ckpt_every", 500),
                 staleness_damping=getattr(args, "staleness_damping", 0.0),
+                # no ckpt_dir masking: --wal without --ckpt-dir must raise
+                # loudly (ParameterServer does), not silently run undurable
+                wal=getattr(args, "wal", False),
             )
             if getattr(args, "resume", False) and server.maybe_restore():
                 print(f"shard server {shard}: resumed central params")
@@ -614,7 +623,10 @@ def _run_elastic_ps_process(args, k, n_workers, kind, reliable,
             star = _Tcp(0, n_workers + 1, args.master,
                         int(args.port) + args.rank, wait_for=0)
             if reliable:
-                star = _Rel(star)
+                # log-before-ack when WAL'd (the elastic serve loop drives
+                # ack_delivered via ps.commit)
+                star = _Rel(star, ack_on_delivery=not getattr(
+                    args, "wal", False))
             ckpt_dir = getattr(args, "ckpt_dir", "") or None
             server = ElasticShardServer(
                 server_id=args.rank + 1, n_params=flat.shape[0],
@@ -622,7 +634,10 @@ def _run_elastic_ps_process(args, k, n_workers, kind, reliable,
                 staleness_damping=getattr(args, "staleness_damping", 0.0),
                 ckpt_dir=(f"{ckpt_dir}/shard{args.rank}" if ckpt_dir
                           else None),
-                ckpt_every=getattr(args, "ckpt_every", 500))
+                ckpt_every=getattr(args, "ckpt_every", 500),
+                # unmasked: --wal without --ckpt-dir raises loudly in the
+                # wrapped ParameterServer instead of silently dropping WAL
+                wal=getattr(args, "wal", False))
             try:
                 server.run()
                 print(f"elastic shard server {args.rank}: done "

@@ -116,6 +116,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "ps, which keeps one atomically-replaced file)")
     p.add_argument("--resume", action="store_true", default=False,
                    help="resume from the latest checkpoint in --ckpt-dir")
+    p.add_argument("--wal", action="store_true", default=False,
+                   help="PS server: write-ahead-log every applied update "
+                        "BEFORE its delivery ack (requires --ckpt-dir; "
+                        "pair with --reliable — the deferred ack rides the "
+                        "reliability envelope); recovery = restore "
+                        "checkpoint + replay the log, so no acked "
+                        "GradientUpdate can be lost to a crash")
     p.add_argument("--profile-dir", type=str, default="",
                    help="capture an xprof/TensorBoard trace of a training-step "
                         "window into this directory (reference has no tracing "

@@ -1,0 +1,59 @@
+"""Power-loss-durable file primitives (ISSUE 5 satellite).
+
+Every piece of persistent state in this repo used to be written with the
+write-tmp-then-``os.replace`` idiom. That is *atomic* (a reader never sees a
+torn file) but not *durable*: a plain rename is metadata the OS may still be
+holding in its page cache when power dies, and the data blocks of the temp
+file may not have reached the platter at all — after a power loss the rename
+can survive while the file contents do not (or vice versa). The fix is the
+classic three-fsync dance:
+
+1. write the temp file, ``flush`` + ``fsync`` it (data blocks durable);
+2. ``os.replace`` onto the destination (atomic swap);
+3. ``fsync`` the containing directory (the rename itself durable).
+
+:func:`atomic_write` is that dance as one helper, and the durability plane
+(checkpoints in ``parallel/async_ps.py``, WAL rotation in ``utils/wal.py``,
+fleet manifests in ``coord/manifest.py``) routes every persistent write
+through it. The ``distcheck`` checker DC107 (``analysis/wire.py``) flags
+modules that opted into this discipline but still hand-roll an
+``open(..., "w") + os.replace`` pair.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a rename inside it survives power loss. A
+    platform that cannot open directories (Windows) degrades to a no-op —
+    the rename is still atomic there, just not power-loss durable."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, data: bytes) -> None:
+    """Atomically AND durably replace ``path`` with ``data``.
+
+    The temp file lives next to the destination (``os.replace`` must not
+    cross filesystems) and is fsync'd before the swap; the directory is
+    fsync'd after, so neither the contents nor the rename can be lost to a
+    power cut. Readers never observe a torn file at ``path``.
+    """
+    dirname = os.path.dirname(os.path.abspath(path))
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(dirname)

@@ -99,6 +99,9 @@ class MessageCode(enum.IntEnum):
     SpeculateTask = 18
     SpeculativeUpdate = 19
     RangeInstall = 20
+    # --- durability plane (ISSUE 5): coordinator-aligned fleet snapshots ---
+    SnapshotRequest = 21
+    SnapshotDone = 22
 
 
 @dataclasses.dataclass(frozen=True)
@@ -208,6 +211,18 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
         handled_by=("coord",),
         doc="worker seeds a freshly-acquired shard range; first install "
             "wins"),
+    MessageCode.SnapshotRequest: PayloadSchema(
+        fields=("snap_lo", "snap_hi", "map_lo", "map_hi"),
+        handled_by=("coord",),
+        doc="coordinator -> shard servers: checkpoint at your next version "
+            "boundary under this snapshot id / shard-map version"),
+    MessageCode.SnapshotDone: PayloadSchema(
+        fields=("snap_lo", "snap_hi", "map_lo", "map_hi", "lo_lo", "lo_hi",
+                "hi_lo", "hi_hi", "apply_lo", "apply_hi", "push_lo",
+                "push_hi"),
+        handled_by=("coord",),
+        doc="shard -> coordinator: checkpoint taken (range + apply seq + "
+            "push count); the coordinator assembles the FleetManifest"),
 }
 
 
@@ -576,13 +591,15 @@ def _next_incarnation() -> int:
 
 
 class _Pending:
-    __slots__ = ("frame", "dst", "deadline", "attempt")
+    __slots__ = ("frame", "dst", "deadline", "attempt", "code")
 
-    def __init__(self, frame: np.ndarray, dst: int, deadline: float):
+    def __init__(self, frame: np.ndarray, dst: int, deadline: float,
+                 code: int = -1):
         self.frame = frame
         self.dst = dst
         self.deadline = deadline
         self.attempt = 1
+        self.code = code  # inner MessageCode (per-code ack accounting)
 
 
 class ReliableTransport(Transport):
@@ -628,6 +645,7 @@ class ReliableTransport(Transport):
         dedup_window: int = 4096,
         unreliable_codes: Tuple[MessageCode, ...] = (
             MessageCode.Heartbeat, MessageCode.LeaseRenew),
+        ack_on_delivery: bool = True,
     ):
         self.inner = inner
         self.rank = inner.rank
@@ -649,6 +667,17 @@ class ReliableTransport(Transport):
         self._seen: Dict[int, "collections.OrderedDict"] = {}
         self._peer_inc: Dict[int, int] = {}
         self._dead_peers: set = set()
+        #: durability hook (ISSUE 5): with ``ack_on_delivery=False`` the ack
+        #: for a DELIVERED data frame is withheld until the receiver calls
+        #: :meth:`ack_delivered` — the parameter server does so only after
+        #: the applied update is fsync'd into its WAL (log-before-ack), so
+        #: "acked" really means "survives a crash". Duplicates of a frame
+        #: whose ack is still deferred are NOT re-acked early (the retry is
+        #: the sender doing its job until durability is committed).
+        self.ack_on_delivery = bool(ack_on_delivery)
+        self._deferred_acks: "collections.OrderedDict" = collections.OrderedDict()
+        self._last_delivery: Optional[Tuple[int, int]] = None
+        self._acked_codes: Dict[Tuple[int, int], int] = {}
         self._closed = False
         self.stats = {
             "sent": 0, "retries": 0, "acked": 0, "gave_up": 0,
@@ -681,7 +710,8 @@ class ReliableTransport(Transport):
         frame = np.concatenate([header, arr])
         with self._lock:
             self._pending[(dst, seq)] = _Pending(
-                frame, dst, time.monotonic() + self.ack_timeout)
+                frame, dst, time.monotonic() + self.ack_timeout,
+                code=int(code))
             self.stats["sent"] += 1
         try:
             self.inner.send(MessageCode.ReliableFrame, frame, dst=dst)
@@ -752,12 +782,17 @@ class ReliableTransport(Transport):
                 if inc != self.incarnation:
                     return None
                 with self._lock:
-                    if self._pending.pop((sender, seq), None) is not None:
+                    p = self._pending.pop((sender, seq), None)
+                    if p is not None:
                         self.stats["acked"] += 1
+                        key = (sender, p.code)
+                        self._acked_codes[key] = \
+                            self._acked_codes.get(key, 0) + 1
             return None
         if code != MessageCode.ReliableFrame:
             with self._lock:
                 self.stats["passthrough"] += 1
+                self._last_delivery = None  # no envelope to remember
             return msg  # plain frame from an unwrapped peer
         if payload.size < 7:
             return None  # truncated envelope: unacked → sender retries
@@ -787,6 +822,47 @@ class ReliableTransport(Transport):
             # inc < known: straggler retry from the rank's previous life —
             # ack it below so the dead process stops retrying, never deliver
             stale = known is not None and inc < known
+        deliver = not stale
+        mcode: Optional[MessageCode] = None
+        if deliver:
+            try:
+                mcode = MessageCode(inner_code)
+            except ValueError:
+                deliver = False  # ack (don't retry garbage), never deliver
+        dup = False
+        if deliver:
+            with self._lock:
+                seen = self._seen.setdefault(sender, collections.OrderedDict())
+                if seq in seen:
+                    dup = True
+                    self.stats["dup_dropped"] += 1
+                else:
+                    seen[seq] = True
+                    while len(seen) > self.dedup_window:
+                        seen.popitem(last=False)
+                    self.stats["delivered"] += 1
+        key = (sender, seq, inc)
+        if deliver and not dup and not self.ack_on_delivery:
+            # log-before-ack: the receiver releases this ack via
+            # ack_delivered() once the applied update is durable
+            with self._lock:
+                self._deferred_acks[key] = True
+                self._last_delivery = (inc, seq)
+            return sender, mcode, body
+        with self._lock:
+            # a duplicate of a frame whose ack is still withheld must not
+            # be re-acked early — the retry is the sender doing its job
+            # until durability commits
+            withheld = key in self._deferred_acks
+        if not withheld:
+            self._send_ack(sender, seq, inc)
+        if deliver and not dup:
+            with self._lock:
+                self._last_delivery = (inc, seq)
+            return sender, mcode, body
+        return None
+
+    def _send_ack(self, sender: int, seq: int, inc: int) -> None:
         try:
             self.inner.send(
                 MessageCode.ReliableAck,
@@ -794,22 +870,55 @@ class ReliableTransport(Transport):
                 dst=sender)
         except (OSError, ConnectionError, KeyError):
             pass  # ack lost: the sender's retry re-triggers it
-        if stale:
-            return None
-        try:
-            mcode = MessageCode(inner_code)
-        except ValueError:
-            return None  # acked (don't retry garbage), never delivered
+
+    def ack_delivered(self) -> None:
+        """Release every withheld delivery ack — call only once the applied
+        updates behind them are durable (the WAL group commit)."""
         with self._lock:
-            seen = self._seen.setdefault(sender, collections.OrderedDict())
-            if seq in seen:
-                self.stats["dup_dropped"] += 1
-                return None
-            seen[seq] = True
-            while len(seen) > self.dedup_window:
-                seen.popitem(last=False)
-            self.stats["delivered"] += 1
-        return sender, mcode, body
+            due = list(self._deferred_acks.keys())
+            self._deferred_acks.clear()
+        for sender, seq, inc in due:
+            self._send_ack(sender, seq, inc)
+
+    @property
+    def last_delivery(self) -> Optional[Tuple[int, int]]:
+        """``(incarnation, seq)`` of the most recently DELIVERED envelope
+        (``None`` after a passthrough frame) — the identity a durable
+        receiver records per WAL record so a restart can re-seed dedup."""
+        with self._lock:
+            return self._last_delivery
+
+    def acked_count(self, dst: int, code: MessageCode) -> int:
+        """How many frames of ``code`` sent to ``dst`` were acked — the
+        sender half of the drill's sequence accounting."""
+        with self._lock:
+            return self._acked_codes.get((dst, int(code)), 0)
+
+    def seed_dedup(self, entries) -> None:
+        """Mark ``(sender, incarnation, seq)`` triples as already delivered
+        — the receiver-restart path: a restored server replays its WAL,
+        seeds the envelope identities it recorded, and a sender's retry of
+        an applied-but-unacked frame is re-acked instead of re-applied
+        (exactly-once application across receiver restarts)."""
+        with self._lock:
+            for sender, inc, seq in entries:
+                known = self._peer_inc.get(sender)
+                if known is None or inc > known:
+                    self._peer_inc[sender] = inc
+                    self._seen[sender] = collections.OrderedDict()
+                if inc == self._peer_inc.get(sender):
+                    seen = self._seen.setdefault(
+                        sender, collections.OrderedDict())
+                    seen[seq] = True
+                    while len(seen) > self.dedup_window:
+                        seen.popitem(last=False)
+
+    def detach(self) -> None:
+        """Stop this wrapper (retry thread exits, ``recv`` returns None)
+        WITHOUT closing the inner transport — for handing the endpoint to a
+        replacement wrapper (the server-restart path in ``coord/drill.py``;
+        a real restart replaces the process, here only the wrapper dies)."""
+        self._closed = True
 
     def recv(self, timeout: Optional[float] = None) -> Optional[Message]:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -865,6 +974,7 @@ def make_transport(
     kind: str = "auto",
     connect_timeout: float = 60.0,
     reliable: bool = False,
+    durable_acks: bool = False,
 ) -> Transport:
     """Transport factory for the PS control plane.
 
@@ -878,6 +988,11 @@ def make_transport(
     (seq + CRC + ack/retry + dedup). Negotiate it on every rank of a world
     (the CLI's ``--reliable``); an unwrapped peer's frames still pass
     through, it just gets no retransmit service.
+
+    ``durable_acks=True`` (WAL'd servers only — the rank must drive
+    ``ack_delivered`` via ``ParameterServer.commit``) defers delivery acks
+    until the receiver declares the applied updates durable: log-before-ack,
+    so "acked" survives a crash. Meaningless without ``reliable``.
     """
     if kind not in ("auto", "native", "python"):
         raise ValueError(f"unknown transport kind: {kind!r}")
@@ -895,7 +1010,9 @@ def make_transport(
             )
     if t is None:
         t = TCPTransport(rank, world_size, master, int(port), connect_timeout)
-    return ReliableTransport(t) if reliable else t
+    if reliable:
+        return ReliableTransport(t, ack_on_delivery=not durable_acks)
+    return t
 
 
 # --- module-level default transport -----------------------------------------

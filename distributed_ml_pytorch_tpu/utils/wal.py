@@ -1,0 +1,239 @@
+"""Per-shard write-ahead log (ISSUE 5 tentpole).
+
+The parameter server's recovery story used to be checkpoint-only: every
+``GradientUpdate`` applied since the last ``save_checkpoint()`` evaporated
+when the shard died — even ones the reliability layer had already *acked*,
+so the worker believed them delivered exactly-once while the restarted shard
+had never seen them. This module closes that window: the server appends each
+applied update to an append-only log **before** releasing its delivery ack,
+so recovery is ``restore latest checkpoint + replay the WAL`` and an acked
+update can never be lost. A successful checkpoint truncates the log.
+
+Format — one self-delimiting binary record per applied update::
+
+    magic:u32  incarnation:u32  seq:u64  sender:i32
+    env_inc:u32  env_seq:u32  nbytes:u64  crc:u32  payload[nbytes]
+
+- ``incarnation`` stamps the writing server *life* (the same second-stamped
+  monotonic counter the reliability layer uses), so a dead life's buffered
+  tail flushed late cannot masquerade as the new life's records — replay
+  skips records whose incarnation goes BACKWARD mid-log, and counts them.
+- ``seq`` is the server's apply sequence number (monotonic across lives,
+  restored from the checkpoint meta), which makes replay idempotent: a
+  record whose seq the checkpoint already covers is skipped — the exact
+  case where a crash landed between ``save_checkpoint()`` and
+  ``truncate()``.
+- ``(sender, env_inc, env_seq)`` remember the reliability envelope that
+  delivered the update, so a restarted server can re-seed its transport's
+  dedup state (``ReliableTransport.seed_dedup``) and a retry of an
+  applied-but-unacked frame is re-acked, never re-applied.
+- ``crc`` covers the whole record. A failed CRC (or unparseable bytes) at
+  the **tail** of the log is a torn final write — the expected crash
+  artifact — and is dropped with a count; a failed CRC **mid-log** (valid
+  records follow it) means the file itself is damaged, and replay fails
+  loudly (:class:`WALCorruptionError`) instead of silently skipping acked
+  state.
+
+Durability: appends are unbuffered single ``write(2)`` calls (one complete
+record per syscall, so two handles on one file — the in-process crash
+simulation — can never interleave mid-record) and :meth:`sync` fsyncs.
+``ParameterServer`` batches the fsync over small groups of updates and only
+releases the deferred delivery acks after the covering sync — group commit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import struct
+import zlib
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from distributed_ml_pytorch_tpu.utils.durability import atomic_write
+
+_MAGIC = 0x57414C31  # "WAL1"
+_HEADER = struct.Struct("<IIQiIIQI")  # magic inc seq sender env_inc env_seq nbytes crc
+
+
+class WALError(Exception):
+    """Base class for write-ahead-log failures."""
+
+
+class WALCorruptionError(WALError):
+    """A record failed its CRC (or is unparseable) with valid records after
+    it — mid-log damage that replay must not silently skip."""
+
+
+@dataclasses.dataclass(frozen=True)
+class WALRecord:
+    """One applied update: its apply seq, provenance, and the exact delta
+    that was added to the central vector (post staleness-damping, so replay
+    reproduces the applied bytes, not the wire bytes)."""
+
+    incarnation: int
+    seq: int
+    sender: int
+    env_inc: int
+    env_seq: int
+    payload: np.ndarray
+
+
+def _record_bytes(inc: int, seq: int, sender: int, env_inc: int,
+                  env_seq: int, payload: np.ndarray) -> bytes:
+    body = np.asarray(payload, np.float32).tobytes()
+    head_sans_crc = struct.pack(
+        "<IIQiIIQ", _MAGIC, inc & 0xFFFFFFFF, seq, sender,
+        env_inc & 0xFFFFFFFF, env_seq & 0xFFFFFFFF, len(body))
+    crc = zlib.crc32(body, zlib.crc32(head_sans_crc)) & 0xFFFFFFFF
+    return head_sans_crc + struct.pack("<I", crc) + body
+
+
+def _parse_one(data: bytes, off: int) -> Optional[Tuple[WALRecord, int]]:
+    """Parse the record at ``off``; None if the bytes there do not form a
+    complete, CRC-valid record (torn or corrupt — the caller decides
+    which by looking at what follows)."""
+    end = off + _HEADER.size
+    if end > len(data):
+        return None
+    magic, inc, seq, sender, env_inc, env_seq, nbytes, crc = _HEADER.unpack(
+        data[off:end])
+    if magic != _MAGIC or nbytes > len(data) - end:
+        return None
+    body = data[end:end + nbytes]
+    if zlib.crc32(body, zlib.crc32(data[off:end - 4])) & 0xFFFFFFFF != crc:
+        return None
+    if nbytes % 4:
+        return None
+    payload = np.frombuffer(body, dtype=np.float32).copy()
+    return (WALRecord(inc, seq, sender, env_inc, env_seq, payload),
+            end + nbytes)
+
+
+def _any_valid_record_after(data: bytes, off: int) -> bool:
+    """Scan forward for a complete CRC-valid record anywhere past ``off`` —
+    the torn-tail vs mid-log-corruption discriminator."""
+    probe = data.find(struct.pack("<I", _MAGIC), off)
+    while probe != -1:
+        if _parse_one(data, probe) is not None:
+            return True
+        probe = data.find(struct.pack("<I", _MAGIC), probe + 1)
+    return False
+
+
+def replay_wal(path: str) -> Tuple[List[WALRecord], dict]:
+    """Read every replayable record of the log at ``path``.
+
+    Returns ``(records, stats)``. ``stats`` counts ``torn_tail`` (0/1 — a
+    partial/corrupt FINAL write, dropped) and ``stale_skipped`` (records
+    whose incarnation went backward mid-log: a dead life's late flush,
+    skipped — applying an older life's delta over a newer life's state
+    would corrupt it). Mid-log corruption raises
+    :class:`WALCorruptionError`.
+    """
+    stats = {"records": 0, "torn_tail": 0, "stale_skipped": 0}
+    if not os.path.exists(path):
+        return [], stats
+    with open(path, "rb") as f:
+        data = f.read()
+    records: List[WALRecord] = []
+    off = 0
+    max_inc = 0
+    while off < len(data):
+        parsed = _parse_one(data, off)
+        if parsed is None:
+            if _any_valid_record_after(data, off + 1):
+                raise WALCorruptionError(
+                    f"{path}: record at byte {off} is corrupt but valid "
+                    "records follow it — the log is damaged mid-stream, "
+                    "refusing to replay past silent loss")
+            stats["torn_tail"] = 1
+            break
+        rec, off = parsed
+        if rec.incarnation < max_inc:
+            stats["stale_skipped"] += 1
+            continue
+        max_inc = rec.incarnation
+        records.append(rec)
+        stats["records"] += 1
+    return records, stats
+
+
+class WriteAheadLog:
+    """Append-only, CRC-framed, incarnation-stamped update log.
+
+    ``append`` buffers nothing in user space (one unbuffered ``write`` per
+    record) but durability still needs :meth:`sync` — the caller batches
+    that (group commit). ``pending`` counts appends since the last sync.
+    """
+
+    def __init__(self, path: str, incarnation: Optional[int] = None):
+        from distributed_ml_pytorch_tpu.utils.messaging import (
+            _next_incarnation,
+        )
+
+        self.path = path
+        self.incarnation = (
+            int(incarnation) if incarnation is not None
+            else _next_incarnation())
+        dirname = os.path.dirname(os.path.abspath(path))
+        os.makedirs(dirname, exist_ok=True)
+        self._f = open(path, "ab", buffering=0)
+        self.pending = 0
+        self.appended = 0
+        #: highest seq THIS handle appended — lets truncate() skip the
+        #: full-log re-parse in the steady state (checkpoint covers all);
+        #: 0 until the first append, so a fresh handle over a pre-existing
+        #: log still takes the parsing path
+        self._max_seq = 0
+
+    def append(self, seq: int, payload: np.ndarray, *, sender: int = 0,
+               env_inc: int = 0, env_seq: int = 0) -> None:
+        self._f.write(_record_bytes(
+            self.incarnation, int(seq), int(sender), env_inc, env_seq,
+            payload))
+        self.pending += 1
+        self.appended += 1
+        self._max_seq = max(self._max_seq, int(seq))
+
+    def sync(self) -> None:
+        """Make every appended record power-loss durable (fsync)."""
+        if self.pending:
+            os.fsync(self._f.fileno())
+            self.pending = 0
+
+    def replay(self) -> Tuple[List[WALRecord], dict]:
+        return replay_wal(self.path)
+
+    def truncate(self, upto_seq: int) -> None:
+        """Drop records a durable checkpoint now covers (``seq <=
+        upto_seq``). Records past it — appended after the checkpoint's
+        snapshot point — are kept, rewritten through the atomic+fsync
+        path."""
+        self.sync()
+        if self.appended and self._max_seq <= int(upto_seq):
+            # steady state: the checkpoint covers everything this handle
+            # ever wrote — drop the whole file without re-parsing it (the
+            # log is many model-vectors large on the hot checkpoint path)
+            keep = []
+        else:
+            records, _stats = replay_wal(self.path)
+            keep = [r for r in records if r.seq > int(upto_seq)]
+        self._f.close()
+        atomic_write(self.path, b"".join(
+            _record_bytes(r.incarnation, r.seq, r.sender, r.env_inc,
+                          r.env_seq, r.payload)
+            for r in keep))
+        self._f = open(self.path, "ab", buffering=0)
+        self.pending = 0
+
+    def close(self) -> None:
+        try:
+            self.sync()
+        except (OSError, ValueError):
+            pass
+        try:
+            self._f.close()
+        except (OSError, ValueError):
+            pass

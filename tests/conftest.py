@@ -83,6 +83,14 @@ def pytest_configure(config):
         "these — fast cases run in tier-1, the wall-clock scenario tests "
         "are additionally listed in slow_tests.txt",
     )
+    config.addinivalue_line(
+        "markers",
+        "drill: disaster-recovery drill tests (coord/drill.py + utils/"
+        "wal.py — snapshot barrier, kill-and-restore, sequence "
+        "accounting); `make drill` selects exactly these — fast cases run "
+        "in tier-1, the full kill-all scenarios are additionally measured "
+        "into slow_tests.txt",
+    )
 
 
 # Modules whose tests launch real subprocess worlds (interpreter start + jit

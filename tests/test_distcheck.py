@@ -226,6 +226,93 @@ def test_dc106_schema_table_must_be_total(tmp_path):
     assert "DC106" in _codes(active)
 
 
+_MINI_DURABILITY = """
+    import os
+
+    def atomic_write(path, data):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+"""
+
+
+def test_dc107_raw_persistence_in_durability_opted_module(tmp_path):
+    writer = """
+        import os
+        from fixturepkg.utils.durability import atomic_write
+
+        def save_meta(path, data):
+            atomic_write(path + ".meta", data)
+
+        def save_vector(path, data):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+    """
+    files = _wire_files(**{
+        "utils/durability.py": _MINI_DURABILITY,
+        "training/state.py": writer,
+    })
+    active, _ = _run(tmp_path, files)
+    assert "DC107" in _codes(active)
+    assert any("save_vector" in f.message for f in active)
+    # clean twin: every persistent write rides the helper
+    fixed = dict(files)
+    fixed["training/state.py"] = """
+        from fixturepkg.utils.durability import atomic_write
+
+        def save_meta(path, data):
+            atomic_write(path + ".meta", data)
+
+        def save_vector(path, data):
+            atomic_write(path, data)
+    """
+    active, _ = _run(tmp_path, fixed)
+    assert "DC107" not in _codes(active)
+
+
+def test_dc107_defining_module_and_unopted_module_are_exempt(tmp_path):
+    files = _wire_files(**{
+        # the helper's own open+replace IS the raw path: exempt
+        "utils/durability.py": _MINI_DURABILITY,
+        # a module that never opted in (no atomic_write reference) is
+        # out of scope for the discipline — DC107 is opt-in like DC105
+        "training/state.py": """
+            import os
+
+            def save(path, data):
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+        """,
+    })
+    active, _ = _run(tmp_path, files)
+    assert "DC107" not in _codes(active)
+
+
+def test_dc107_append_mode_wal_writes_are_exempt(tmp_path):
+    files = _wire_files(**{
+        "utils/durability.py": _MINI_DURABILITY,
+        "training/state.py": """
+            import os
+            from fixturepkg.utils.durability import atomic_write
+
+            def rotate(path, keep):
+                atomic_write(path, keep)
+                handle = open(path, "ab")  # append-only WAL style
+                os.replace(path, path + ".bak")
+                return handle
+        """,
+    })
+    active, _ = _run(tmp_path, files)
+    assert "DC107" not in _codes(active)
+
+
 # ----------------------------------------------------- DC2xx: concurrency
 
 _GUARDED_BOX = """

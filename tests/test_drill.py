@@ -1,0 +1,319 @@
+"""ISSUE 5 drill suite: snapshot barrier, fleet manifests, and the
+kill-and-recover acceptance scenario.
+
+Layers:
+
+- unit: the snapshot barrier's coordinator half under a fake clock
+  (start, mixed-version abort, rebalance abort, manifest finalization)
+  and FleetManifest validation (refuse incomplete / mixed / gapped);
+- restore: ElasticShardServer.restore_from_manifest refuses a missing
+  checkpoint or state behind the manifest's promise;
+- system: THE acceptance drill — 2 workers + 2 shards under
+  FaultyTransport + the reliability envelope, coordinator-aligned
+  snapshot, ALL shards killed silently mid-epoch, restore from
+  manifest + WAL — run 3x with identical seeds: zero acked-
+  GradientUpdate loss (sequence accounting), byte-identical chaos logs,
+  fault-free-corridor convergence; plus a subset-kill variant.
+
+``make drill`` selects this module (and tests/test_wal.py) via the
+``drill`` marker; the full scenarios get measured into slow_tests.txt.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.coord.coordinator import (
+    KIND_SHARD,
+    KIND_WORKER,
+    Coordinator,
+    encode_join,
+    encode_snapshot_done,
+)
+from distributed_ml_pytorch_tpu.coord.drill import (
+    default_drill_plan,
+    recovery_drill,
+)
+from distributed_ml_pytorch_tpu.coord.elastic import ElasticShardServer
+from distributed_ml_pytorch_tpu.coord.manifest import (
+    FleetManifest,
+    ManifestError,
+    ShardRecord,
+)
+from distributed_ml_pytorch_tpu.coord.member import CoordClient
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    InProcessTransport,
+    MessageCode,
+)
+
+pytestmark = pytest.mark.drill
+
+# the shared lock_witness fixture (tests/conftest.py) arms the acceptance
+# drill below as a concurrency validator under DISTCHECK_WITNESS=1
+
+
+# ----------------------------------------------------------- manifest unit
+
+def _manifest(**over):
+    kw = dict(
+        snapshot_id=3, map_version=5, n_params=100,
+        shards=(ShardRecord(1, 0, 50, 5, 10, 10),
+                ShardRecord(2, 50, 100, 5, 8, 8)),
+        complete=True)
+    kw.update(over)
+    return FleetManifest(**kw)
+
+
+def test_manifest_roundtrips_and_exposes_its_shard_map(tmp_path):
+    path = str(tmp_path / "m.json")
+    _manifest().write(path)
+    m = FleetManifest.load(path)
+    assert m == _manifest()
+    assert m.shard_map.version == 5
+    assert m.shard_map.ranges == [(0, 50), (50, 100)]
+    assert m.entry_for(2).apply_seq == 8
+    with pytest.raises(ManifestError, match="no record for server 9"):
+        m.entry_for(9)
+
+
+def test_manifest_refuses_incomplete_mixed_and_gapped(tmp_path):
+    with pytest.raises(ManifestError, match="incomplete"):
+        _manifest(complete=False).validate()
+    with pytest.raises(ManifestError, match="MIXED"):
+        _manifest(shards=(ShardRecord(1, 0, 50, 5, 10, 10),
+                          ShardRecord(2, 50, 100, 4, 8, 8))).validate()
+    with pytest.raises(ManifestError, match="tile"):
+        _manifest(shards=(ShardRecord(1, 0, 40, 5, 10, 10),
+                          ShardRecord(2, 50, 100, 5, 8, 8))).validate()
+    with pytest.raises(ManifestError, match="covers"):
+        _manifest(shards=(ShardRecord(1, 0, 90, 5, 10, 10),)).validate()
+    with pytest.raises(ManifestError, match="more than once"):
+        _manifest(shards=(ShardRecord(1, 0, 50, 5, 10, 10),
+                          ShardRecord(1, 50, 100, 5, 8, 8))).validate()
+    # write() refuses to publish what load() would refuse
+    with pytest.raises(ManifestError):
+        _manifest(complete=False).write(str(tmp_path / "bad.json"))
+    with pytest.raises(ManifestError, match="unreadable"):
+        FleetManifest.load(str(tmp_path / "missing.json"))
+
+
+# ------------------------------------------------- barrier unit (fake clock)
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _barrier_coordinator(tmp_path, clock):
+    c = Coordinator(None, 100, lease=10.0, clock=clock, speculation=False,
+                    manifest_dir=str(tmp_path))
+    c.handle(1, MessageCode.CoordJoin, encode_join(KIND_SHARD, 10))
+    c.handle(2, MessageCode.CoordJoin, encode_join(KIND_SHARD, 11))
+    assert c.shard_map.version == 2
+    return c
+
+
+def test_snapshot_barrier_assembles_and_publishes_manifest(tmp_path):
+    clock = _Clock()
+    c = _barrier_coordinator(tmp_path, clock)
+    c.trigger_snapshot()
+    clock.t = 0.1
+    c.tick()
+    assert c._snap is not None and c._snap["id"] == 1
+    (lo1, hi1), (lo2, hi2) = c.shard_map.ranges
+    c.handle(1, MessageCode.SnapshotDone,
+             encode_snapshot_done(1, 2, lo1, hi1, 14, 14))
+    assert c.manifests_written == 0  # half a barrier is not a manifest
+    c.handle(2, MessageCode.SnapshotDone,
+             encode_snapshot_done(1, 2, lo2, hi2, 12, 12))
+    assert c.manifests_written == 1 and c._snap is None
+    m = FleetManifest.load(c.manifest_path())
+    assert m.snapshot_id == 1 and m.map_version == 2
+    assert m.entry_for(1).apply_seq == 14 and m.entry_for(2).apply_seq == 12
+    # the next barrier gets the next id
+    c.trigger_snapshot()
+    clock.t = 0.2
+    c.tick()
+    assert c._snap["id"] == 2
+
+
+def test_snapshot_barrier_refuses_mixed_version_reports(tmp_path):
+    clock = _Clock()
+    c = _barrier_coordinator(tmp_path, clock)
+    c.trigger_snapshot()
+    c.tick()
+    (lo1, hi1), _ = c.shard_map.ranges
+    # shard 1 reports a checkpoint taken under ANOTHER map version: the
+    # barrier must abort — a manifest mixing versions is the disease
+    c.handle(1, MessageCode.SnapshotDone,
+             encode_snapshot_done(1, 1, lo1, hi1, 14, 14))
+    assert c._snap is None and c.manifests_written == 0
+    assert any("aborted" in e for e in c.events)
+
+
+def test_snapshot_barrier_aborts_on_mid_barrier_rebalance(tmp_path):
+    clock = _Clock()
+    c = _barrier_coordinator(tmp_path, clock)
+    c.trigger_snapshot()
+    c.tick()
+    assert c._snap is not None
+    c.handle(3, MessageCode.CoordJoin, encode_join(KIND_SHARD, 12))
+    assert c._snap is None  # the map moved; the frozen barrier is void
+    assert any("aborted" in e for e in c.events)
+
+
+def test_snapshot_interval_drives_periodic_barriers(tmp_path):
+    clock = _Clock()
+    c = Coordinator(None, 100, lease=10.0, clock=clock, speculation=False,
+                    manifest_dir=str(tmp_path), snapshot_interval=5.0)
+    c.handle(1, MessageCode.CoordJoin, encode_join(KIND_SHARD, 10))
+    clock.t = 5.1
+    c.tick()
+    assert c._snap is not None and c._snap["id"] == 1
+
+
+def test_coordinator_restores_map_and_snapshot_clock_from_manifest():
+    m = _manifest()
+    c = Coordinator(None, 100, speculation=False, restore_manifest=m)
+    assert c.shard_map.version == 5
+    assert c.shard_map.ranges == [(0, 50), (50, 100)]
+    assert c._snap_seq == 3  # the next snapshot will be #4
+
+
+# --------------------------------------------------------- restore refusals
+
+def test_restore_from_manifest_refuses_missing_checkpoint(tmp_path):
+    world = InProcessTransport.create_world(2)
+    client = CoordClient(world[1], "shard", renew_interval=5.0)
+    try:
+        srv = ElasticShardServer(
+            server_id=1, n_params=100, transport=world[0], coord=client,
+            ckpt_dir=str(tmp_path / "shard0"), wal=True)
+        manifest = FleetManifest(
+            snapshot_id=1, map_version=2, n_params=100,
+            shards=(ShardRecord(1, 0, 100, 2, 5, 5),))
+        with pytest.raises(ManifestError, match="nothing restorable"):
+            srv.restore_from_manifest(manifest)
+    finally:
+        client.stop()
+        for t in world.values():
+            t.close()
+
+
+def test_restore_from_manifest_refuses_state_behind_the_promise(tmp_path):
+    world = InProcessTransport.create_world(2)
+    client = CoordClient(world[1], "shard", renew_interval=5.0)
+    try:
+        ckpt_dir = str(tmp_path / "shard0")
+        srv = ElasticShardServer(
+            server_id=1, n_params=100, transport=world[0], coord=client,
+            ckpt_dir=ckpt_dir, wal=True)
+        with srv._mu:
+            srv.lo, srv.hi = 0, 100
+            srv.ps.central = np.zeros(100, np.float32)
+            srv.ps.handle(1, MessageCode.GradientUpdate,
+                          np.ones(100, np.float32))
+            srv.ps.save_checkpoint()  # on-disk apply seq: 1
+        manifest = FleetManifest(
+            snapshot_id=1, map_version=2, n_params=100,
+            shards=(ShardRecord(1, 0, 100, 2, 9, 9),))  # promises seq 9
+        srv2 = ElasticShardServer(
+            server_id=1, n_params=100, transport=world[0], coord=client,
+            ckpt_dir=ckpt_dir, wal=True)
+        with pytest.raises(ManifestError, match="BEHIND"):
+            srv2.restore_from_manifest(manifest)
+    finally:
+        client.stop()
+        for t in world.values():
+            t.close()
+
+
+# --------------------------------------------------- system: THE acceptance
+
+_STEPS = 18
+
+
+@pytest.fixture(scope="module")
+def drill_fixture():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ml_pytorch_tpu.data import load_cifar10
+    from distributed_ml_pytorch_tpu.models import LeNet
+    from distributed_ml_pytorch_tpu.training.trainer import (
+        cross_entropy_loss,
+    )
+
+    model = LeNet()
+    x, y, *_ = load_cifar10(n_train=256, n_test=32, synthetic=True)
+
+    @jax.jit
+    def grad_fn(p, bx, by, rng):
+        def loss_fn(q):
+            logits = model.apply({"params": q}, bx, train=True,
+                                 rngs={"dropout": rng})
+            return cross_entropy_loss(logits, by)
+
+        return jax.value_and_grad(loss_fn)(p)
+
+    params0 = model.init(
+        jax.random.key(0), jnp.zeros((1, 32, 32, 3)))["params"]
+    return x, y, grad_fn, params0
+
+
+def test_kill_all_shards_recover_lossfree_three_runs(
+        drill_fixture, tmp_path, lock_witness):
+    """THE acceptance drill (ISSUE 5), 3x with identical seeds: all shards
+    SIGKILL'd (scripted silent death) mid-epoch, fleet restores from
+    manifest + WAL with zero acked-GradientUpdate loss (sequence
+    accounting proves acked <= applied per worker/shard pair), the chaos
+    log is byte-identical across runs, and every run converges into the
+    fault-free corridor."""
+    clean = recovery_drill(
+        base_dir=str(tmp_path / "clean"), seed=7, steps=_STEPS,
+        snapshot_at=None, kill_at=None, fixture=drill_fixture)
+    assert clean["ok"], (clean["errors"], clean["events"])
+    clean_final = np.mean(
+        [np.mean(l[-4:]) for l in clean["losses"].values()])
+
+    logs, finals = [], []
+    for run in range(3):
+        out = recovery_drill(
+            base_dir=str(tmp_path / f"run{run}"), seed=7, steps=_STEPS,
+            plan=default_drill_plan(7), fixture=drill_fixture)
+        assert out["ok"], (out["errors"], out["events"])
+        assert out["accounting_ok"], (out["acked"], out["applied"])
+        # acked updates existed that ONLY the WALs held (post-snapshot)
+        assert out["replayed_updates"] > 0
+        assert out["manifest"] is not None and out["manifest"]["complete"]
+        assert out["mttr_s"] is not None and out["mttr_s"] < 60
+        logs.append(out["chaos_lines"])
+        for losses in out["losses"].values():
+            assert np.mean(losses[-4:]) < np.mean(losses[:4]), losses
+        finals.append(np.mean(
+            [np.mean(l[-4:]) for l in out["losses"].values()]))
+    assert logs[0] and logs[0] == logs[1] == logs[2], (
+        "chaos log not byte-identical across drill runs")
+    for final in finals:
+        assert abs(final - clean_final) < 0.5, (final, clean_final)
+
+
+def test_subset_kill_recovers_and_survivor_never_restarts(
+        drill_fixture, tmp_path):
+    """Killing an arbitrary shard SUBSET restores only the victims; the
+    survivor keeps its live state and the accounting still closes."""
+    out = recovery_drill(
+        base_dir=str(tmp_path / "subset"), seed=3, steps=_STEPS,
+        kill_shards=[1], plan=default_drill_plan(3), fixture=drill_fixture)
+    assert out["ok"], (out["errors"], out["events"])
+    assert out["accounting_ok"], (out["acked"], out["applied"])
+    assert out["mttr_s"] is not None
+    # only shard 2 (index 1) was restarted: exactly one rejoin event
+    rejoins = [e for e in out["events"] if "rejoined" in e]
+    assert len(rejoins) == 1 and "shard 2" in rejoins[0]

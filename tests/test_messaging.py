@@ -300,3 +300,73 @@ def test_reliable_stale_incarnation_ack_does_not_clear_pending():
         b.close()
         for t in world.values():
             t.close()
+
+
+# --------------------------------------------------- ISSUE 5: durable acks
+
+def test_deferred_ack_withheld_until_ack_delivered():
+    """With ack_on_delivery=False the delivery ack is released only by
+    ack_delivered() — the sender keeps retrying (and the duplicate is NOT
+    re-acked early) until the receiver declares the update durable."""
+    from distributed_ml_pytorch_tpu.utils.messaging import ReliableTransport
+
+    world = InProcessTransport.create_world(2)
+    a = ReliableTransport(world[0], ack_timeout=0.05, ack_on_delivery=False)
+    b = ReliableTransport(world[1], ack_timeout=0.05)
+    try:
+        b.send(MessageCode.GradientUpdate, np.ones(2, np.float32), dst=0)
+        msg = a.recv(timeout=2)
+        assert msg is not None and msg[1] == MessageCode.GradientUpdate
+        assert a.last_delivery is not None
+        # the retry keeps landing as a dup, and the dup is not re-acked
+        assert a.recv(timeout=0.3) is None
+        assert a.stats["dup_dropped"] >= 1
+        with b._lock:
+            assert b._pending  # still unacked: durability never committed
+        a.ack_delivered()
+        assert b.flush(timeout=5), b.stats
+        assert b.acked_count(0, MessageCode.GradientUpdate) == 1
+    finally:
+        a.close()
+        b.close()
+        for t in world.values():
+            t.close()
+
+
+def test_seed_dedup_survives_receiver_restart():
+    """The WAL restart path: a restored receiver seeds the envelope
+    identities its log recorded, so the sender's retry of an applied-but-
+    unacked frame is re-acked, never re-delivered (exactly-once across
+    receiver restarts)."""
+    from distributed_ml_pytorch_tpu.utils.messaging import ReliableTransport
+
+    world = InProcessTransport.create_world(2)
+    a = ReliableTransport(world[0], ack_timeout=0.05, ack_on_delivery=False)
+    b = ReliableTransport(world[1], ack_timeout=0.05)
+    a2 = None
+    try:
+        b.send(MessageCode.GradientUpdate, np.ones(2, np.float32), dst=0)
+        msg = a.recv(timeout=2)
+        assert msg is not None
+        inc, seq = a.last_delivery
+        a.detach()  # the crash: applied + logged, ack never released
+
+        a2 = ReliableTransport(world[0], ack_timeout=0.05)
+        a2.seed_dedup([(1, inc, seq)])
+        deadline = time.monotonic() + 5
+        redelivered = None
+        while time.monotonic() < deadline:
+            redelivered = redelivered or a2.recv(timeout=0.1)
+            with b._lock:
+                if not b._pending:
+                    break
+        assert redelivered is None, "retry was re-applied after restart"
+        assert a2.stats["dup_dropped"] >= 1
+        assert b.flush(timeout=5), b.stats
+        assert b.acked_count(0, MessageCode.GradientUpdate) == 1
+    finally:
+        if a2 is not None:
+            a2.close()
+        b.close()
+        for t in world.values():
+            t.close()

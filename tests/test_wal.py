@@ -1,0 +1,318 @@
+"""ISSUE 5 durability-plane units: atomic_write, the write-ahead log, and
+the ParameterServer's checkpoint/WAL handshake.
+
+The corrupt-state recovery matrix (ISSUE 5 satellite):
+
+- torn WAL tail (partial final record) → tolerated, counted, earlier
+  records intact;
+- CRC-corrupt record MID-log (valid records after it) → fails LOUDLY;
+- stale-incarnation records (a dead life's tail flushed after the new
+  life's records) → skipped and counted, never applied;
+- replay idempotence when a checkpoint raced the log truncation → records
+  the checkpoint covers are skipped by apply-seq;
+- the checkpoint tear window (crash between the meta and vector renames)
+  → detected by CRC, resolved to the consistent previous generation, and
+  with the WAL on, replayed back to the exact pre-crash state.
+
+All fast and in-process; they carry the ``drill`` marker so ``make drill``
+runs the whole durability surface.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.parallel.async_ps import ParameterServer
+from distributed_ml_pytorch_tpu.utils.durability import atomic_write
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    InProcessTransport,
+    MessageCode,
+)
+from distributed_ml_pytorch_tpu.utils.wal import (
+    WALCorruptionError,
+    WriteAheadLog,
+    replay_wal,
+)
+
+pytestmark = pytest.mark.drill
+
+
+# ------------------------------------------------------------ atomic_write
+
+def test_atomic_write_replaces_durably(tmp_path, monkeypatch):
+    """Content lands atomically, the temp file is gone, and BOTH the data
+    and the containing directory were fsync'd (power-loss durability —
+    plain write+rename syncs neither)."""
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync", lambda fd: (synced.append(fd),
+                                                 real_fsync(fd))[1])
+    path = str(tmp_path / "state.bin")
+    atomic_write(path, b"generation-1")
+    atomic_write(path, b"generation-2")
+    with open(path, "rb") as f:
+        assert f.read() == b"generation-2"
+    assert not os.path.exists(path + ".tmp")
+    # per write: one data fsync + one directory fsync
+    assert len(synced) >= 4
+
+
+# ---------------------------------------------------------------- WAL core
+
+def _fill(path, n=3, inc=20, start_seq=1):
+    w = WriteAheadLog(path, incarnation=inc)
+    for i in range(n):
+        w.append(start_seq + i, np.full(4, start_seq + i, np.float32),
+                 sender=1, env_inc=9, env_seq=i)
+    w.sync()
+    return w
+
+
+def test_wal_roundtrip_and_truncate(tmp_path):
+    path = str(tmp_path / "w.log")
+    w = _fill(path)
+    records, stats = replay_wal(path)
+    assert [r.seq for r in records] == [1, 2, 3]
+    assert stats == {"records": 3, "torn_tail": 0, "stale_skipped": 0}
+    assert records[1].sender == 1 and records[1].env_seq == 1
+    np.testing.assert_array_equal(records[2].payload,
+                                  np.full(4, 3, np.float32))
+    w.truncate(2)  # a checkpoint at apply seq 2 covers records 1-2
+    records, _ = replay_wal(path)
+    assert [r.seq for r in records] == [3]
+    # the log keeps appending after a truncation
+    w.append(4, np.zeros(4, np.float32))
+    w.sync()
+    assert [r.seq for r in replay_wal(path)[0]] == [3, 4]
+    w.close()
+
+
+def test_wal_torn_tail_is_tolerated(tmp_path):
+    """A partial final record is the expected crash artifact: dropped and
+    counted, with every earlier record intact."""
+    path = str(tmp_path / "w.log")
+    _fill(path).close()
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(path, "wb") as f:
+        f.write(data[:-5])  # the crash tore the last write
+    records, stats = replay_wal(path)
+    assert [r.seq for r in records] == [1, 2]
+    assert stats["torn_tail"] == 1
+
+
+def test_wal_midlog_corruption_fails_loudly(tmp_path):
+    """A CRC-corrupt record with valid records AFTER it is damage, not a
+    torn tail — replay must refuse, never skip-and-continue past silently
+    lost acked state."""
+    path = str(tmp_path / "w.log")
+    _fill(path).close()
+    with open(path, "rb") as f:
+        data = f.read()
+    record_len = len(data) // 3
+    flipped = bytearray(data)
+    flipped[record_len - 3] ^= 0x5A  # inside record #1's payload
+    with open(path, "wb") as f:
+        f.write(bytes(flipped))
+    with pytest.raises(WALCorruptionError):
+        replay_wal(path)
+
+
+def test_wal_stale_incarnation_records_skipped(tmp_path):
+    """A record whose incarnation goes BACKWARD mid-log is a dead life's
+    late-flushed tail: applying it over the newer life's state would
+    corrupt it — skipped and counted."""
+    path = str(tmp_path / "w.log")
+    _fill(path, n=2, inc=20, start_seq=1).close()
+    stale = WriteAheadLog(path, incarnation=10)  # an OLDER life appends
+    stale.append(99, np.full(4, 99, np.float32))
+    stale.sync()
+    stale.close()
+    _fill(path, n=1, inc=21, start_seq=3).close()
+    records, stats = replay_wal(path)
+    assert [r.seq for r in records] == [1, 2, 3]
+    assert stats["stale_skipped"] == 1
+
+
+# ------------------------------------------- ParameterServer + WAL handshake
+
+def _server(tmp_path, wal=True, **kw):
+    return ParameterServer(params=np.zeros(8, np.float32),
+                           ckpt_dir=str(tmp_path), ckpt_every=0, wal=wal,
+                           **kw)
+
+
+def test_ps_wal_replays_acked_updates_without_checkpoint(tmp_path):
+    """The tentpole guarantee, minimal form: updates applied + committed
+    but NEVER checkpointed survive a crash via WAL replay alone, with the
+    sequence accounting (apply seq, per-sender counts, staleness clock)
+    restored alongside the vector."""
+    ps = _server(tmp_path)
+    delta = np.arange(8, dtype=np.float32)
+    for _ in range(3):
+        ps.handle(1, MessageCode.GradientUpdate, delta)
+    ps.commit()  # the group fsync that releases the acks
+    del ps  # the crash: no save_checkpoint
+
+    ps2 = _server(tmp_path)
+    assert ps2.maybe_restore()
+    np.testing.assert_allclose(ps2.central, 3 * delta)
+    assert ps2._apply_seq == 3 and ps2._push_count == 3
+    assert ps2.applied_by_sender == {1: 3}
+    assert ps2.staleness.version == 3
+    assert ps2.replayed_updates == 3
+
+
+def test_ps_wal_replay_is_idempotent_when_checkpoint_raced_truncation(
+        tmp_path, monkeypatch):
+    """A crash between save_checkpoint() and the WAL truncation leaves
+    records the checkpoint already covers — replay must skip them by apply
+    seq, not add them twice."""
+    ps = _server(tmp_path)
+    delta = np.arange(8, dtype=np.float32)
+    for _ in range(3):
+        ps.handle(1, MessageCode.GradientUpdate, delta)
+    monkeypatch.setattr(WriteAheadLog, "truncate",
+                        lambda self, upto_seq: None)  # the crash window
+    ps.save_checkpoint()
+    records, _ = replay_wal(ps.wal.path)
+    assert len(records) == 3  # the covered records really are still there
+    del ps
+
+    ps2 = _server(tmp_path)
+    assert ps2.maybe_restore()
+    np.testing.assert_allclose(ps2.central, 3 * delta)  # NOT 6x
+    assert ps2._apply_seq == 3 and ps2.replayed_updates == 0
+
+
+def test_ckpt_tear_window_restores_consistent_previous_generation(tmp_path):
+    """THE regression (ISSUE 5 satellite): a crash between the meta rename
+    and the vector rename used to pair a v+1 vector with a v staleness
+    clock silently. Now the meta carries the vector CRC + the previous
+    generation: the tear restores the consistent OLD (vector, clock) pair,
+    and the WAL replays the difference back to the exact pre-crash state."""
+    import distributed_ml_pytorch_tpu.parallel.async_ps as async_ps
+
+    ps = _server(tmp_path)
+    delta = np.arange(8, dtype=np.float32)
+    ps.handle(1, MessageCode.GradientUpdate, delta)
+    ps.save_checkpoint()  # generation 1: vector == 1*delta, version 1
+    for _ in range(2):
+        ps.handle(1, MessageCode.GradientUpdate, delta)
+    ps.commit()
+
+    real = async_ps.atomic_write
+    calls = []
+
+    def crash_on_vector(path, data):
+        if path.endswith("ps_central.npy"):
+            calls.append(path)
+            raise OSError("simulated crash between the two renames")
+        return real(path, data)
+
+    async_ps.atomic_write = crash_on_vector
+    try:
+        with pytest.raises(OSError):
+            ps.save_checkpoint()  # meta (gen 2) lands, vector does not
+    finally:
+        async_ps.atomic_write = real
+    assert calls  # the tear really happened after the meta rename
+    del ps
+
+    ps2 = _server(tmp_path)
+    assert ps2.maybe_restore()
+    # gen-1 vector adopted with gen-1 clock (not gen-2's), then the WAL
+    # replayed updates 2..3 on top — the full pre-crash state, loss-free
+    np.testing.assert_allclose(ps2.central, 3 * delta)
+    assert ps2._apply_seq == 3 and ps2._push_count == 3
+    assert ps2.replayed_updates == 2
+
+
+def test_ckpt_vector_matching_neither_generation_fails_loudly(tmp_path):
+    ps = _server(tmp_path, wal=False)
+    ps.handle(1, MessageCode.GradientUpdate, np.ones(8, np.float32))
+    ps.save_checkpoint()
+    # real corruption: a vector that matches neither meta nor prev CRC
+    buf = io.BytesIO()
+    np.save(buf, np.full(8, 7.5, np.float32))
+    with open(ps._ckpt_path(), "wb") as f:
+        f.write(buf.getvalue())
+    ps2 = _server(tmp_path, wal=False)
+    with pytest.raises(ValueError, match="neither its meta"):
+        ps2.maybe_restore()
+
+
+def test_ps_wal_requires_ckpt_dir():
+    with pytest.raises(ValueError, match="ckpt_dir"):
+        ParameterServer(params=np.zeros(4, np.float32), wal=True)
+
+
+def test_wrong_size_update_dropped_before_wal_or_accounting(tmp_path):
+    """A wrong-size GradientUpdate must be dropped BEFORE the apply clock,
+    per-sender counts, or the WAL see it — a logged record replay can
+    never fit would refuse every future restore, and a size-1 payload
+    would otherwise numpy-broadcast into the vector silently."""
+    ps = _server(tmp_path)
+    ps.handle(1, MessageCode.GradientUpdate, np.ones(3, np.float32))
+    ps.handle(1, MessageCode.GradientUpdate, np.ones(1, np.float32))
+    assert ps.dropped_bad_updates == 2
+    assert ps._apply_seq == 0 and ps.applied_by_sender == {}
+    assert ps.wal.appended == 0
+    np.testing.assert_array_equal(ps.central, np.zeros(8, np.float32))
+
+
+def test_ckpt_meta_keeps_envelope_tail_across_truncation(tmp_path):
+    """save_checkpoint truncates the WAL (and its per-record envelopes),
+    but an ack can be lost in flight — the meta's recent_envelopes tail
+    must keep re-seeding dedup for retries of updates the checkpoint
+    already covers."""
+    seeded = []
+
+    class FakeReliable(InProcessTransport):
+        def seed_dedup(self, entries):
+            seeded.extend(entries)
+
+        def ack_delivered(self):
+            pass
+
+    world = InProcessTransport.create_world(2)
+    t = FakeReliable(0, world[0]._boxes)
+    ps = ParameterServer(params=np.zeros(8, np.float32), transport=t,
+                         ckpt_dir=str(tmp_path), ckpt_every=0, wal=True)
+    ps._envelope = (777, 3)
+    ps.handle(2, MessageCode.GradientUpdate, np.ones(8, np.float32))
+    ps.save_checkpoint()  # truncates the record away
+    assert replay_wal(ps.wal.path)[0] == []
+    del ps
+
+    ps2 = ParameterServer(params=np.zeros(8, np.float32), transport=t,
+                          ckpt_dir=str(tmp_path), ckpt_every=0, wal=True)
+    assert ps2.maybe_restore()
+    assert seeded == [(2, 777, 3)]  # the tail survived the truncation
+
+
+def test_ps_wal_records_delivery_envelope_and_reseeds_dedup(tmp_path):
+    """handle() stamps each WAL record with the reliability envelope that
+    delivered it; maybe_restore() hands those identities back to the
+    transport so a retry of an applied-but-unacked frame is deduped."""
+    seeded = []
+
+    class FakeReliable(InProcessTransport):
+        def seed_dedup(self, entries):
+            seeded.extend(entries)
+
+    world = InProcessTransport.create_world(2)
+    t = FakeReliable(0, world[0]._boxes)
+    ps = ParameterServer(params=np.zeros(8, np.float32), transport=t,
+                         ckpt_dir=str(tmp_path), ckpt_every=0, wal=True)
+    ps._envelope = (1234, 7)  # what run() stashes from last_delivery
+    ps.handle(2, MessageCode.GradientUpdate, np.ones(8, np.float32))
+    ps.commit()
+    del ps
+
+    ps2 = ParameterServer(params=np.zeros(8, np.float32), transport=t,
+                          ckpt_dir=str(tmp_path), ckpt_every=0, wal=True)
+    assert ps2.maybe_restore()
+    assert seeded == [(2, 1234, 7)]
