@@ -54,6 +54,15 @@ serve:
 serve-demo:
 	$(PY) -m distributed_ml_pytorch_tpu.serving.cli --demo 6
 
+# fleet serving (serving/fleet.py): 3 engine replicas behind a FleetRouter
+# — occupancy + session-affinity routing, stream migration across engine
+# death, overload shed/brownout. CTRL-C prints the fleet summary.
+serve-fleet:
+	$(PY) -m distributed_ml_pytorch_tpu.serving.cli --fleet 3
+
+serve-fleet-demo:
+	$(PY) -m distributed_ml_pytorch_tpu.serving.cli --fleet 2 --demo 6
+
 bench:
 	$(PY) bench.py
 
@@ -85,6 +94,18 @@ drill:
 # one-command drill demo (prints MTTR + replayed counts + accounting)
 drill-demo:
 	$(PY) -m distributed_ml_pytorch_tpu.coord.cli --drill
+
+# fleet-serving suite (serving/fleet.py): multi-engine routing, stream
+# migration across engine death (token-identical, byte-identical chaos
+# logs), overload shed/brownout, per-engine lease health
+fleet:
+	$(PY) -m pytest tests/ -q -m fleet
+
+# overload soak (slow-marked): the fleet at 2x its sustainable arrival
+# rate must shed/brownout instead of collapsing — goodput-under-SLO >= 80%
+# of the 1x value and every shed request explicitly rejected
+soak:
+	$(PY) -m pytest tests/ -q -m soak
 
 # distcheck (analysis/): protocol / concurrency / tracing-hygiene static
 # analysis over the whole package — exits non-zero on any unsuppressed
@@ -122,4 +143,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo bench bench-serving bench-all chaos coord drill drill-demo lint test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all chaos coord drill drill-demo fleet soak lint test test-all verify-real-data graph install dist

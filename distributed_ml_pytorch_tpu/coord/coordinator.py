@@ -45,7 +45,7 @@ import dataclasses
 import logging
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -96,21 +96,27 @@ def encode_snapshot_done(snapshot_id: int, map_version: int, lo: int,
 
 
 def encode_fleet(version: int, n_workers: int, n_shards: int, n_engines: int,
-                 workers_done: bool) -> np.ndarray:
+                 workers_done: bool, engine_ranks=()) -> np.ndarray:
+    """The compact fleet broadcast; the tail lists the LIVE engine members'
+    coordinator ranks, so a serving router can tell WHICH engine's lease
+    expired, not just that a count dropped (per-engine health, ISSUE 6)."""
     return np.asarray(
         [*_split16(version), float(n_workers), float(n_shards),
-         float(n_engines), 1.0 if workers_done else 0.0], np.float32)
+         float(n_engines), 1.0 if workers_done else 0.0,
+         *(float(r) for r in engine_ranks)], np.float32)
 
 
 def decode_fleet(payload: np.ndarray) -> dict:
     if payload.size < 6 or not np.isfinite(payload[:6]).all():
         raise ValueError(f"malformed FleetState frame (size {payload.size})")
+    tail = payload[6:]
     return {
         "version": _join16(payload[0], payload[1]),
         "n_workers": int(payload[2]),
         "n_shards": int(payload[3]),
         "n_engines": int(payload[4]),
         "workers_done": bool(payload[5]),
+        "engine_ranks": [int(r) for r in tail[np.isfinite(tail)]],
     }
 
 
@@ -125,6 +131,10 @@ class MemberInfo:
     push_count: int = 0
     step: int = 0
     ewma_ms: float = 0.0
+    #: at least one LeaseRenew carried this member's metrics — a fully
+    #: idle engine (0% occupancy, 0 TTFT) still counts as reporting, so
+    #: scale-down advice can fire on a genuinely idle fleet
+    reported: bool = False
 
     @property
     def kind_name(self) -> str:
@@ -148,6 +158,11 @@ class Coordinator:
         snapshot_interval: float = 0.0,
         snapshot_timeout: float = 30.0,
         restore_manifest=None,
+        engine_occ_high: float = 0.0,
+        engine_occ_low: float = 0.0,
+        engine_slo_ttft_ms: float = 0.0,
+        scale_cooldown: float = 5.0,
+        on_scale: Optional[Callable[[str, dict], None]] = None,
     ):
         self.transport = transport
         self.lease = float(lease)
@@ -176,6 +191,23 @@ class Coordinator:
             if self.snapshot_interval > 0 else None)
         self.manifests_written = 0
         self.last_manifest = None
+        # --- engine scaling advisory (ISSUE 6): replicas follow the
+        # engines' OWN reported metrics. Engine members renew leases with
+        # (occupancy%, queue depth, TTFT ms) — per-engine granularity of
+        # the old all-or-nothing fleet hook. Past ``engine_occ_high`` mean
+        # occupancy (or the TTFT SLO), the coordinator advises scale-UP;
+        # below ``engine_occ_low`` with >1 replicas it advises scale-DOWN.
+        # Advisory = a decision-log event + the ``on_scale`` callback (the
+        # harness owns actually launching/retiring a replica; readmission
+        # of an expired engine is the member's own join-retry, logged) —
+        # thresholds at 0 disable the corresponding direction.
+        self.engine_occ_high = float(engine_occ_high)
+        self.engine_occ_low = float(engine_occ_low)
+        self.engine_slo_ttft_ms = float(engine_slo_ttft_ms)
+        self.scale_cooldown = float(scale_cooldown)
+        self.on_scale = on_scale
+        self._next_scale_at = 0.0
+        self.scale_advice: List[Tuple[str, dict]] = []
         if restore_manifest is not None:
             # disaster recovery: adopt the manifest's shard map + snapshot
             # clock so rebalances and snapshot ids continue, not restart
@@ -199,11 +231,13 @@ class Coordinator:
 
     def fleet_state(self) -> dict:
         workers = self._live(KIND_WORKER)
+        engines = self._live(KIND_ENGINE)
         return {
             "version": self.shard_map.version,
             "n_workers": len(workers),
             "n_shards": len(self._live(KIND_SHARD)),
-            "n_engines": len(self._live(KIND_ENGINE)),
+            "n_engines": len(engines),
+            "engine_ranks": [m.rank for m in engines],
             # done requires at least one CLEAN leave, not just an empty
             # set: every worker lease-expiring at once (a transient stall)
             # must read as an outage, or the shard servers would all exit
@@ -223,6 +257,11 @@ class Coordinator:
     # fleet hook, and a one-poll-stale answer is within its contract
     def engine_up(self) -> bool:
         return bool(self._live(KIND_ENGINE))
+
+    def live_engine_ranks(self):
+        """The live engine members' ranks — the per-engine face of
+        :meth:`engine_up` a colocated serving router probes directly."""
+        return {m.rank for m in self._live(KIND_ENGINE)}
 
     # --------------------------------------------------------------- sends
     def _send(self, rank: int, code: MessageCode, payload: np.ndarray) -> None:
@@ -244,7 +283,7 @@ class Coordinator:
         fs = self.fleet_state()
         self._broadcast(MessageCode.FleetState, encode_fleet(
             fs["version"], fs["n_workers"], fs["n_shards"], fs["n_engines"],
-            fs["workers_done"]))
+            fs["workers_done"], fs["engine_ranks"]))
 
     # -------------------------------------------------------------- handle
     def handle(self, sender: int, code: MessageCode,
@@ -284,7 +323,8 @@ class Coordinator:
                 fs = self.fleet_state()
                 self._send(sender, MessageCode.FleetState, encode_fleet(
                     fs["version"], fs["n_workers"], fs["n_shards"],
-                    fs["n_engines"], fs["workers_done"]))
+                    fs["n_engines"], fs["workers_done"],
+                    fs["engine_ranks"]))
             return
         if member is None:
             return  # pre-join (or post-expiry) chatter: the join retry fixes it
@@ -330,6 +370,7 @@ class Coordinator:
             member.push_count = int(payload[2])
             member.step = int(payload[3])
             member.ewma_ms = float(payload[4])
+            member.reported = True
             return
         # any other frame from a known member is evidence of life
         member.last_seen = now
@@ -354,6 +395,7 @@ class Coordinator:
             self._announce()
         if self.speculation:
             self.check_stragglers()
+        self.check_engine_scaling(now)
         # --- snapshot barrier driving (serve-thread only, like the rest) ---
         due = (self._next_snap_at is not None and now >= self._next_snap_at)
         if self._snap_requested or due:
@@ -485,6 +527,55 @@ class Coordinator:
                 f"s{r.server_id}=[{r.lo},{r.hi})@{r.apply_seq}"
                 for r in manifest.shards)
             + (f" -> {path}" if path else " (in-memory only)"))
+
+    # ------------------------------------------------------- engine scaling
+    def check_engine_scaling(self, now: Optional[float] = None) -> Optional[str]:
+        """Advise replica scaling from the engines' own reported metrics
+        (see the constructor note). Returns ``"up"``/``"down"`` when advice
+        fired this call, else None — rate-limited by ``scale_cooldown``."""
+        if self.engine_occ_high <= 0 and self.engine_occ_low <= 0 \
+                and self.engine_slo_ttft_ms <= 0:
+            return None
+        now = self._clock() if now is None else now
+        if now < self._next_scale_at:
+            return None
+        engines = self._live(KIND_ENGINE)
+        # engine renewals carry (occupancy%, queue depth, TTFT ms) in the
+        # (push_count, step, ewma_ms) renewal slots; skip members that have
+        # never renewed so a just-joined replica cannot skew the mean — an
+        # IDLE renewal (all zeros) still counts, or an idle fleet could
+        # never earn scale-down advice
+        reported = [m for m in engines if m.reported]
+        if not reported:
+            return None
+        mean_occ = sum(m.push_count for m in reported) / (100.0 * len(reported))
+        mean_ttft = sum(m.ewma_ms for m in reported) / len(reported)
+        detail = {
+            "n_engines": len(engines), "mean_occupancy": round(mean_occ, 3),
+            "mean_ttft_ms": round(mean_ttft, 2),
+            "per_engine": {m.rank: {"occupancy": m.push_count / 100.0,
+                                    "queued": m.step, "ttft_ms": m.ewma_ms}
+                           for m in reported},
+        }
+        direction = None
+        if (self.engine_occ_high > 0 and mean_occ >= self.engine_occ_high) \
+                or (self.engine_slo_ttft_ms > 0
+                    and mean_ttft > self.engine_slo_ttft_ms):
+            direction = "up"
+        elif (self.engine_occ_low > 0 and mean_occ <= self.engine_occ_low
+              and len(engines) > 1):
+            direction = "down"
+        if direction is None:
+            return None
+        self._next_scale_at = now + self.scale_cooldown
+        self.scale_advice.append((direction, detail))
+        self._log(
+            f"engine scale-{direction} advised: mean occupancy "
+            f"{mean_occ:.0%}, mean TTFT {mean_ttft:.1f} ms over "
+            f"{len(reported)} reporting engine(s)")
+        if self.on_scale is not None:
+            self.on_scale(direction, detail)
+        return direction
 
     # ---------------------------------------------------------- speculation
     def check_stragglers(self) -> Optional[int]:

@@ -352,13 +352,16 @@ def recovery_drill(
     coord.stop()
     coord_thread.join(timeout=30)
 
-    # ---- sequence accounting: every acked GradientUpdate must be in the
-    # (restored) server's applied counts ---------------------------------
+    # ---- sequence accounting: every acked push must be in the (restored)
+    # server's applied counts. Elastic workers stamp their pushes with the
+    # map version (ShardPush, ISSUE 6); legacy GradientUpdate acks are
+    # counted too so the invariant is code-agnostic. --------------------
     acked: Dict[int, Dict[int, int]] = {}
     applied: Dict[int, Dict[int, int]] = {}
     for i in range(n_shards):
-        acked[i] = {j: rel_workers[i][j].acked_count(
-            0, MessageCode.GradientUpdate) for j in range(1, 1 + n_workers)}
+        acked[i] = {j: (rel_workers[i][j].acked_count(
+            0, MessageCode.ShardPush) + rel_workers[i][j].acked_count(
+            0, MessageCode.GradientUpdate)) for j in range(1, 1 + n_workers)}
         applied[i] = {j: servers[i].ps.applied_by_sender.get(j, 0)
                       for j in range(1, 1 + n_workers)}
     accounting_ok = all(

@@ -13,9 +13,13 @@ the range it owns is COORDINATOR-ASSIGNED instead of launch-time fixed:
   waits for a worker's ``RangeInstall`` (first install wins; pulls are
   parked until the range is whole, so a worker can never adopt
   uninitialized zeros as central params);
-- stale-map traffic — a push or install sized for another map version — is
-  dropped and counted, never applied (the worker's next cadence under the
-  agreed map is correct);
+- stale-map traffic is dropped and counted, never applied (the worker's
+  next cadence under the agreed map is correct): elastic pushes and pull
+  replies carry the sender's map version AND the absolute range they were
+  cut for (``ShardPush`` / ``ShardParams``, ISSUE 6), and the range is
+  the gate — equal-size ranges at moved offsets (the join+death
+  same-count rebalance) are detected, while a version bump whose ranges
+  stayed put stays compatible;
 - ``SpeculativeUpdate`` frames (Sandblaster backup-task results) apply
   exactly once per task id: the victim's late result and the backup's fast
   one race, first wins, the duplicate is counted and dropped — this is what
@@ -46,6 +50,7 @@ from distributed_ml_pytorch_tpu.utils.messaging import (
     MessageCode,
     Transport,
     _join16,
+    _split16,
 )
 
 
@@ -125,10 +130,25 @@ class ElasticShardServer:
         with self._mu:
             self._apply_map_locked(m)
 
+    def _restamp_reply_head(self) -> None:
+        """Pull replies go out as ``ShardParams`` stamped with the map
+        version AND the absolute range served — the worker's offset gate."""
+        self.ps.pull_reply_head = np.asarray(
+            [*_split16(max(0, self.map_version)), *_split16(self.lo),
+             *_split16(self.hi)], np.float32)
+
     def _apply_map_locked(self, m: ShardMap) -> None:
         if m.version <= self.map_version:
             return
         self.map_version = m.version
+        try:
+            self._apply_entry(m)
+        finally:
+            # every exit path re-stamps — including the unchanged-range
+            # case, where only the version moves
+            self._restamp_reply_head()
+
+    def _apply_entry(self, m: ShardMap) -> None:
         e = m.entry_for(self.server_id)
         if e is None:
             # dropped from the map while alive (e.g. coordinator restarted
@@ -235,6 +255,7 @@ class ElasticShardServer:
             # a manifest restore is authoritative: nothing awaits install,
             # and a worker's stale RangeInstall must not stomp it
             self.pending_install = None
+            self._restamp_reply_head()
         print(
             f"shard {self.server_id}: restored [{entry.lo},{entry.hi}) at "
             f"apply seq {self.ps._apply_seq} "
@@ -251,12 +272,28 @@ class ElasticShardServer:
     def _handle_locked(self, sender: int, code: MessageCode,
                        payload: np.ndarray) -> None:
         size = self.hi - self.lo
-        if code == MessageCode.GradientUpdate:
-            if payload.shape[0] != size:
+        if code == MessageCode.ShardPush and payload.size >= 7:
+            # the stamped elastic push: the ABSOLUTE RANGE is the
+            # correctness gate — a slice cut for other offsets can never
+            # apply, even when two maps hand this server equal-size ranges
+            # at different offsets (the old size-only check's blind spot,
+            # coord/shardmap.py), while a benign version bump that left
+            # the range in place stays compatible (no dropped gradients
+            # across a restore-rejoin)
+            lo = _join16(payload[2], payload[3])
+            hi = _join16(payload[4], payload[5])
+            values = payload[6:]
+            if (lo, hi) != (self.lo, self.hi) or values.shape[0] != size:
                 self.stats["stale_dropped"] += 1
                 return
-            self.ps.handle(sender, code, payload)
+            self.ps.handle(sender, MessageCode.GradientUpdate, values)
             self.coord.report(self.ps._push_count, 0, 0.0)
+        elif code == MessageCode.GradientUpdate:
+            # unversioned pushes no longer exist on the elastic plane
+            # (every elastic client stamps ShardPush) — one arriving means
+            # a sender that skipped the wire upgrade: drop it loudly-in-
+            # stats rather than risk the offset blind spot
+            self.stats["stale_dropped"] += 1
         elif code == MessageCode.ParameterRequest:
             if self.pending_install is not None:
                 # parking, not answering: a reply now would hand the worker
@@ -290,10 +327,12 @@ class ElasticShardServer:
             self.stats["installs"] += 1
             print(f"shard {self.server_id}: range [{lo},{hi}) installed by "
                   f"worker {sender}", file=sys.stderr)
-        elif code == MessageCode.SpeculativeUpdate and payload.size >= 2:
+        elif code == MessageCode.SpeculativeUpdate and payload.size >= 8:
             task_id = _join16(payload[0], payload[1])
-            values = payload[2:]
-            if values.shape[0] != size:
+            lo = _join16(payload[4], payload[5])
+            hi = _join16(payload[6], payload[7])
+            values = payload[8:]
+            if (lo, hi) != (self.lo, self.hi) or values.shape[0] != size:
                 self.stats["stale_dropped"] += 1
                 return
             if task_id in self._seen_tasks:
@@ -345,7 +384,9 @@ class ElasticShardServer:
                 self.handle(sender, code, payload, envelope)
             except (ValueError, IndexError, OverflowError):
                 continue  # malformed frame: drop, never die
-            if (self.ps.wal is None or code != MessageCode.GradientUpdate
+            if (self.ps.wal is None
+                    or code not in (MessageCode.GradientUpdate,
+                                    MessageCode.ShardPush)
                     or self.ps.wal.pending >= self.ps.wal_group_n):
                 with self._mu:
                     self.ps.commit()

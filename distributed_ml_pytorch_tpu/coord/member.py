@@ -78,6 +78,16 @@ class FleetView:
         s = self.state
         return s is None or s["n_engines"] > 0
 
+    def live_engine_ranks(self):
+        """The live engine coord-ranks from the latest FleetState tail, or
+        ``None`` before the first report (fail open, like engine_up) — the
+        fleet router's per-engine lease-expiry signal."""
+        s = self.state
+        if s is None:
+            return None
+        ranks = s.get("engine_ranks")
+        return None if ranks is None else set(ranks)
+
     def workers_done(self) -> bool:
         s = self.state
         return s is not None and s["workers_done"]

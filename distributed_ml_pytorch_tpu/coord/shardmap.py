@@ -5,17 +5,19 @@ parameter vector splits across the live shard servers. It is immutable and
 versioned: every membership change that affects shard servers produces a new
 map with ``version + 1``, and every consumer (workers' ``ShardedAsynchronous``
 clients, the shard servers themselves) cuts over atomically at a step
-boundary when it sees a newer version. Cross-version traffic in the cutover
-window is detected by SLICE LENGTH (the wire carries no version field — the
-DownPour frames are unchanged) and dropped. That bound is honest but not
-airtight: two versions can assign a server equal-sized ranges at different
-offsets (same shard count, moved boundaries — a join and a death landing in
-one rebalance), and such traffic applies against the wrong offsets for up
-to one pull cadence until both sides sit on the agreed map. That is a
-bounded, self-healing staleness error of the kind DownPour tolerates by
-design; a version-tagged push frame would close it at the cost of a wire
-format change, and is the noted upgrade path if rebalances ever become
-frequent relative to the cadence.
+boundary when it sees a newer version. Every elastic push, pull reply, and
+speculative update now carries a stamp — the sender's map version plus the
+ABSOLUTE ``[lo,hi)`` the slice was cut for (``MessageCode.ShardPush`` /
+``ShardParams`` / the stamped ``SpeculativeUpdate`` head — ISSUE 6's
+wire-format upgrade) — and the receiver applies only traffic cut for the
+range it currently serves, dropping+counting the rest; slice length
+remains a second-line check. In particular the one case a length check
+could not see — two versions assigning a server equal-sized ranges at
+different offsets (same shard count, moved boundaries: a join and a death
+landing in one rebalance) — is now dropped like any other stale traffic,
+while a benign version bump whose ranges stayed put (a restore-rejoin)
+remains compatible in flight (both regression-tested in
+``tests/test_coord.py``).
 
 Each entry also carries the subrange its owner NEWLY acquired in this
 version (``fresh_lo``/``fresh_hi``): the handover protocol. A server that

@@ -153,6 +153,12 @@ class ParameterServer:
         from distributed_ml_pytorch_tpu.utils.failure import StalenessAuditor
 
         self.staleness = StalenessAuditor()
+        #: version head for pull replies (ISSUE 6): when set (an np.float32
+        #: array, the ``_split16`` halves of the owner's shard-map version)
+        #: replies go out as ``ShardParams`` = ``[*head, *central]`` instead
+        #: of a bare ``ParameterUpdate`` — the elastic plane's versioned
+        #: wire. ``ElasticShardServer`` re-stamps it on every resize.
+        self.pull_reply_head: Optional[np.ndarray] = None
         self._stop = threading.Event()
 
     def stop(self) -> None:
@@ -413,9 +419,17 @@ class ParameterServer:
         """Answer one worker; a worker that died between its request and
         this reply must not take the whole server down (the send raises on
         a crashed peer — robustness, not protocol)."""
+        code = MessageCode.ParameterUpdate
+        if self.pull_reply_head is not None:
+            # versioned elastic reply: the receiver checks the stamped map
+            # version, so equal-size cross-version replies can never apply
+            code = MessageCode.ShardParams
+            payload = np.concatenate(
+                [self.pull_reply_head,
+                 np.asarray(payload, np.float32).ravel()])
         try:
             send_message(
-                MessageCode.ParameterUpdate, payload, dst=sender,
+                code, payload, dst=sender,
                 transport=self.transport,
             )
         except (OSError, ConnectionError, KeyError):
@@ -660,12 +674,22 @@ class Listener(MessageListener):
     Instead of writing into live parameters mid-step (the reference's
     lock-free race), deposits the newest flat vector into a mailbox for the
     optimizer to swap in between steps.
+
+    Elastic servers reply with ``ShardParams`` — the same vector prefixed
+    with the server's shard-map version and the absolute range it serves
+    (``[ver_lo, ver_hi, lo_lo, lo_hi, hi_lo, hi_hi, *params]``). The stamp
+    rides the mailbox so the elastic client can drop a reply cut for other
+    offsets even when the sizes coincide (the equal-size stale-map blind
+    spot, closed in ISSUE 6).
     """
 
     def __init__(self, transport: Optional[Transport] = None):
         super().__init__(transport=transport)
         self._lock = threading.Lock()
         self._latest: Optional[np.ndarray] = None
+        #: (version, lo, hi) of the newest reply; None for a legacy
+        #: unversioned ParameterUpdate
+        self._latest_stamp: Optional[Tuple[int, int, int]] = None
         self._got_update = threading.Event()
 
     def receive(self, sender: int, message_code: MessageCode, parameter: np.ndarray) -> None:
@@ -673,12 +697,36 @@ class Listener(MessageListener):
         if message_code == MessageCode.ParameterUpdate:
             with self._lock:
                 self._latest = parameter
+                self._latest_stamp = None  # legacy unversioned reply
+            self._got_update.set()
+        elif message_code == MessageCode.ShardParams:
+            if parameter.size < 7 or not np.isfinite(parameter[:6]).all():
+                return  # malformed stamped reply: drop, never die
+            from distributed_ml_pytorch_tpu.utils.messaging import _join16
+
+            with self._lock:
+                self._latest = parameter[6:]
+                self._latest_stamp = (
+                    _join16(parameter[0], parameter[1]),
+                    _join16(parameter[2], parameter[3]),
+                    _join16(parameter[4], parameter[5]))
             self._got_update.set()
 
     def take_latest(self) -> Optional[np.ndarray]:
         with self._lock:
             latest, self._latest = self._latest, None
+            self._latest_stamp = None
         return latest
+
+    def take_latest_versioned(
+            self) -> Tuple[Optional[Tuple[int, int, int]],
+                           Optional[np.ndarray]]:
+        """Newest reply with its ``(version, lo, hi)`` stamp (``None``
+        stamp for a legacy unversioned ``ParameterUpdate``)."""
+        with self._lock:
+            latest, self._latest = self._latest, None
+            stamp, self._latest_stamp = self._latest_stamp, None
+        return stamp, latest
 
     def wait_for_update(self, timeout: float) -> bool:
         """Block until at least one ParameterUpdate has ever arrived (it may

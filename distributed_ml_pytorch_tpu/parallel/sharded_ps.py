@@ -223,7 +223,26 @@ class ShardedAsynchronous:
         self._flusher = PushFlusher(self._push_all)
 
     def _push_all(self, arr: np.ndarray) -> None:
-        """Send every shard its slice of one fetched push vector."""
+        """Send every shard its slice of one fetched push vector.
+
+        Elastic mode stamps each slice with the map version AND the
+        absolute ``[lo,hi)`` it was cut for (``ShardPush``): the server
+        applies only slices cut for the range it currently serves, so
+        cross-version traffic at moved offsets is dropped even when the
+        sizes coincide (the old size-only check's blind spot), while a
+        version bump that left the range in place stays compatible. The
+        flusher drains before any cutover, so the stamp read here always
+        matches the slicing."""
+        if self.coord is not None:
+            from distributed_ml_pytorch_tpu.utils.messaging import _split16
+
+            ver = _split16(max(0, self.map_version))
+            for s, (lo, hi) in enumerate(self.ranges):
+                head = np.asarray(
+                    [*ver, *_split16(lo), *_split16(hi)], np.float32)
+                self._send(s, MessageCode.ShardPush,
+                           np.concatenate([head, arr[lo:hi]]))
+            return
         for s, (lo, hi) in enumerate(self.ranges):
             self._send(s, MessageCode.GradientUpdate, arr[lo:hi])
 
@@ -283,13 +302,27 @@ class ShardedAsynchronous:
     def _install_arrived(self, params: Pytree) -> Pytree:
         """Patch whichever shard slices have arrived into the current flat
         params — per-shard staleness is allowed by construction."""
-        latest = [listener.take_latest() for listener in self.listeners]
-        if all(l is None for l in latest):
+        latest = [listener.take_latest_versioned()
+                  for listener in self.listeners]
+        if all(l is None for _v, l in latest):
             return params
         # np.array (not asarray): a jax array exports a read-only buffer
         flat = np.array(ravel_model_params(params), dtype=np.float32)
-        for s, ((lo, hi), sl) in enumerate(zip(self.ranges, latest)):
+        for s, ((lo, hi), (stamp, sl)) in enumerate(zip(self.ranges, latest)):
             if sl is not None:
+                if stamp is not None and stamp[1:] != (lo, hi):
+                    # stamped elastic reply cut for OTHER offsets (the
+                    # join+death same-count rebalance): dropped on the
+                    # range stamp, so it can never install 50 params at
+                    # the wrong place — a version bump whose range stayed
+                    # put remains compatible
+                    print(
+                        f"worker: dropping shard {self.server_ids[s]} reply "
+                        f"for [{stamp[1]},{stamp[2]}) v{stamp[0]} (this "
+                        f"slot expects [{lo},{hi}) on v{self.map_version})",
+                        file=sys.stderr,
+                    )
+                    continue
                 if sl.shape[0] != hi - lo:
                     if self.coord is None:
                         # static fleet: ranges are launch-time constants, so
@@ -423,9 +456,14 @@ class ShardedAsynchronous:
         """
         from distributed_ml_pytorch_tpu.utils.messaging import _split16
 
-        head = np.asarray([*_split16(int(task_id))], np.float32)
+        # stamped like every elastic push: a speculative tail sliced for
+        # other offsets must never apply against the wrong range
+        task_ver = (*_split16(int(task_id)),
+                    *_split16(max(0, self.map_version)))
         flat_update = np.asarray(flat_update, np.float32).ravel()
         for s, (lo, hi) in enumerate(self.ranges):
+            head = np.asarray(
+                [*task_ver, *_split16(lo), *_split16(hi)], np.float32)
             self._send(s, MessageCode.SpeculativeUpdate,
                        np.concatenate([head, flat_update[lo:hi]]))
 

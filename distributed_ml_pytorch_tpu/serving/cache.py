@@ -96,10 +96,18 @@ def replace_cache_leaves(tree, mapping):
 
 @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
 def _admit_jit(dec, params, pool, slot, prompt, real_len, seed,
-               temperature, top_k, top_p):
+               temperature, top_k, top_p, gen_offset):
     """Prefill ``prompt`` ([1, bucket] int32, right-padded past ``real_len``)
     on a fresh lane cache, sample the request's first token, and scatter the
-    lane into ``pool`` at ``slot``. Returns ``(pool, first_token)``."""
+    lane into ``pool`` at ``slot``. Returns ``(pool, first_token)``.
+
+    ``gen_offset`` is the request's position in its own sampling-key
+    schedule: token ``g`` is always drawn with ``fold_in(key(seed), g)``,
+    so a RESUMED request (fleet migration re-prefills prompt + the tokens
+    generated so far on a surviving engine) samples its next token with
+    the same key the dead engine would have — stream migration stays
+    token-identical even for sampled requests. A fresh admission passes 0.
+    """
     lane = jax.tree.map(lambda x: jnp.zeros(x.shape[1:], x.dtype), pool)
     bucket = prompt.shape[1]
     positions = jnp.arange(bucket)[None, :]
@@ -113,7 +121,7 @@ def _admit_jit(dec, params, pool, slot, prompt, real_len, seed,
         mutated["cache"], {"cursor": real_len, "ring_base": real_len})
     last = jax.lax.dynamic_index_in_dim(logits[0], real_len - 1, keepdims=False)
     keys = jax.vmap(
-        lambda s: jax.random.fold_in(jax.random.key(s), 0))(seed[None])
+        lambda s: jax.random.fold_in(jax.random.key(s), gen_offset))(seed[None])
     tok0 = sample_tokens_dynamic(
         last[None], keys, temperature[None], top_k[None], top_p[None])[0]
     pool = jax.tree.map(
@@ -238,9 +246,11 @@ class SlotKVPool:
 
     def admit(self, slot: int, prompt: np.ndarray, real_len: int, *,
               seed: int = 0, temperature: float = 0.0, top_k: int = 0,
-              top_p: float = 1.0) -> int:
+              top_p: float = 1.0, gen_offset: int = 0) -> int:
         """Prefill a (bucketed) prompt into ``slot``; returns the request's
-        first sampled token. One compiled program per bucket length."""
+        first sampled token. One compiled program per bucket length.
+        ``gen_offset`` resumes the sampling-key schedule at that generated-
+        token index (stream migration; 0 for a fresh request)."""
         prompt = jnp.asarray(prompt, jnp.int32)[None, :]
         if prompt.shape[1] < 2:
             # s == 1 is the decode-step discriminator inside the blocked
@@ -257,7 +267,8 @@ class SlotKVPool:
             jnp.asarray(seed, jnp.uint32),
             jnp.asarray(temperature, jnp.float32),
             jnp.asarray(top_k, jnp.int32),
-            jnp.asarray(top_p, jnp.float32))
+            jnp.asarray(top_p, jnp.float32),
+            jnp.asarray(gen_offset, jnp.int32))
         return int(tok0)
 
     def decode_block_step(self, tok, n_gen, seeds, temps, top_ks, top_ps,

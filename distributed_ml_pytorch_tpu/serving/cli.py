@@ -95,16 +95,33 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--demo", type=int, default=0, metavar="N",
                    help="serve N synthetic requests from an in-process "
                         "client, print the SLO summary, exit")
+    # fleet serving (ISSUE 6): N engine replicas behind one router
+    p.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="run N engine replicas behind a FleetRouter "
+                        "(occupancy + session-affinity routing, stream "
+                        "migration across engine death, overload "
+                        "shed/brownout); 0 = single-engine frontend")
+    p.add_argument("--slo-ttft-ms", type=float, default=0.0,
+                   help="TTFT SLO in ms (0 = off): recent TTFT above it "
+                        "reads as overload and sheds lowest-priority work")
+    p.add_argument("--shed-occupancy", type=float, default=0.0,
+                   help="fleet pressure (busy+queued per slot) at which "
+                        "new work admits only by displacing lower-priority "
+                        "waiting work (0 = off); shed = explicit reject")
+    p.add_argument("--brownout-occupancy", type=float, default=0.0,
+                   help="pressure at which incoming max_new_tokens is "
+                        "capped at --brownout-max-new (degrade before "
+                        "shedding; 0 = off)")
+    p.add_argument("--brownout-max-new", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
     return p
 
 
-def _build_engine(args, parser):
+def _build_model(args, parser):
     import jax
     import jax.numpy as jnp
 
     from distributed_ml_pytorch_tpu.models import TransformerLM
-    from distributed_ml_pytorch_tpu.serving.engine import ServingEngine
 
     if args.d_model % args.n_heads:
         parser.error(f"--d-model {args.d_model} must divide by --n-heads "
@@ -139,10 +156,58 @@ def _build_engine(args, parser):
             state, step = ckpt.restore(template)
             params = state.params
             print(f"restored params from step {step} of {args.ckpt_dir}")
+    return lm, params
+
+
+def _make_engine(lm, params, args):
+    from distributed_ml_pytorch_tpu.serving.engine import ServingEngine
+
     return ServingEngine(
         lm, params, slots=args.slots, cache_size=args.cache_size,
         decode_block=args.decode_block, kv_quant=args.kv_quant,
         max_queue=args.max_queue, prefill_bucket=args.prefill_bucket)
+
+
+def _build_engine(args, parser):
+    lm, params = _build_model(args, parser)
+    return _make_engine(lm, params, args)
+
+
+def _build_fleet(args, parser, coord_factory=None):
+    """N engine replicas as started EngineMembers (one model init, shared
+    read-only params). ``coord_factory(engine_id)`` may supply a
+    CoordClient per member (lease-holding fleet membership). Engines are
+    WARMED (prefill buckets + decode block compiled) before their serve
+    threads start, so the router's liveness probe never mistakes a
+    cold-start XLA compile for a death."""
+    import numpy as np
+
+    from distributed_ml_pytorch_tpu.serving.fleet import EngineMember
+
+    lm, params = _build_model(args, parser)
+    members = []
+    for i in range(args.fleet):
+        engine = _make_engine(lm, params, args)
+        # EVERY bucket the cache can hold: a first-of-its-size prompt
+        # compiling inside the serve loop would stall heartbeats and read
+        # as a death (compiled programs are shared across same-shape
+        # replicas, so only replica 0 pays the XLA time)
+        bucket = max(2, args.prefill_bucket)
+        warmed = 0
+        while warmed < 32 and engine.pool.capacity_needed(bucket, bucket, 2) \
+                <= engine.pool.cache_size:
+            # (capped: --prefill-bucket 1 means exact-length buckets, where
+            # exhaustive warmup is unbounded — residual lazy compiles are
+            # that configuration's accepted cost)
+            w = engine.submit(np.zeros(bucket, np.int32), 2)
+            engine.run_until_idle()
+            assert w.done
+            bucket += max(1, args.prefill_bucket)
+            warmed += 1
+        engine.reset_metrics()
+        coord = coord_factory(i) if coord_factory is not None else None
+        members.append(EngineMember(i, engine, coord=coord).start())
+    return members
 
 
 def _print_summary(engine) -> None:
@@ -152,7 +217,7 @@ def _print_summary(engine) -> None:
     print("SLO summary:", json.dumps(summary, indent=2, default=float))
 
 
-def _run_demo(args, engine) -> int:
+def _run_demo(args, engine=None, members=None) -> int:
     import threading
 
     import numpy as np
@@ -164,7 +229,17 @@ def _run_demo(args, engine) -> int:
     from distributed_ml_pytorch_tpu.utils.messaging import InProcessTransport
 
     world = InProcessTransport.create_world(2)
-    frontend = ServingFrontend(engine, world[0])
+    if members is not None:
+        from distributed_ml_pytorch_tpu.serving.fleet import FleetRouter
+
+        frontend = FleetRouter(
+            world[0], members, slo_ttft_ms=args.slo_ttft_ms,
+            shed_occupancy=args.shed_occupancy,
+            brownout_occupancy=args.brownout_occupancy,
+            brownout_max_new=args.brownout_max_new)
+        engine = members[0].engine  # SLO summary target below
+    else:
+        frontend = ServingFrontend(engine, world[0])
     client = ServingClient(world[1])
     server = threading.Thread(target=frontend.serve_forever, daemon=True)
     server.start()
@@ -199,6 +274,11 @@ def _run_demo(args, engine) -> int:
         print(f"served {args.demo} demo requests "
               f"({sum(len(t) for _, t in results.values())} tokens)")
         _print_summary(engine)
+        if members is not None:
+            import json
+
+            print("fleet summary:",
+                  json.dumps(frontend.fleet_summary(), default=str))
         print("serving demo complete")
         return 0
     finally:
@@ -208,13 +288,98 @@ def _run_demo(args, engine) -> int:
             t.close()
 
 
+def _main_fleet(args, parser) -> int:
+    """N replicas behind a FleetRouter (``--fleet N``): the quickstart is
+    ``make serve-fleet``; add ``--coord host:port`` for lease-holding
+    membership + coordinator-driven scaling advice."""
+    coord_factory = None
+    coord_clients = []
+    if args.coord:
+        from distributed_ml_pytorch_tpu.coord.member import CoordClient
+        from distributed_ml_pytorch_tpu.utils.messaging import TCPTransport
+
+        host, _, cport = args.coord.partition(":")
+
+        def coord_factory(i):
+            # engines live in the high end of the coordination rank space
+            # (see the single-engine path below); co-hosted replicas offset
+            # by engine id so each holds its OWN lease
+            rank = (args.coord_rank or 50 + int(args.port) % 14) + i
+            if rank >= 64:
+                # the coordination star validates 1 <= rank < world_size
+                # (64): an overflowing derived rank would be refused at the
+                # hub's hello and the replica would silently serve without
+                # a lease — fail loudly instead
+                parser.error(
+                    f"fleet replica {i} derives coordination rank {rank} "
+                    ">= 64 — pin a lower base with --coord-rank")
+            client = CoordClient(
+                # distcheck: ignore[DC105] same advisory control star as
+                # the single-engine path — periodic, self-healing frames
+                TCPTransport(rank=rank, world_size=64,
+                             master=host or "localhost",
+                             port=int(cport or 29700)),
+                "engine")
+            coord_clients.append(client)
+            return client
+
+    members = _build_fleet(args, parser, coord_factory)
+    try:
+        if args.demo:
+            return _run_demo(args, members=members)
+
+        from distributed_ml_pytorch_tpu.serving.fleet import FleetRouter
+        from distributed_ml_pytorch_tpu.utils.messaging import (
+            ReliableTransport,
+            TCPTransport,
+        )
+
+        transport = TCPTransport(
+            rank=0, world_size=1 + args.clients, master=args.master,
+            port=int(args.port))
+        if args.reliable:
+            transport = ReliableTransport(transport)
+        router = FleetRouter(
+            transport, members,
+            client_deadline=args.client_deadline,
+            fleet=members[0].coord.fleet if members[0].coord else None,
+            slo_ttft_ms=args.slo_ttft_ms,
+            shed_occupancy=args.shed_occupancy,
+            brownout_occupancy=args.brownout_occupancy,
+            brownout_max_new=args.brownout_max_new)
+        print(f"fleet serving on {args.master}:{args.port} "
+              f"({args.fleet} engines x {args.slots} slots x "
+              f"{args.cache_size} rows, block {args.decode_block})")
+        try:
+            router.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            router.stop()
+            transport.close()
+            import json
+
+            print("fleet summary:",
+                  json.dumps(router.fleet_summary(), default=str))
+            _print_summary(members[0].engine)
+        return 0
+    finally:
+        for m in members:
+            if m.alive:
+                m.stop()
+        for c in coord_clients:
+            c.transport.close()
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
     print(args)
+    if args.fleet:
+        return _main_fleet(args, parser)
     engine = _build_engine(args, parser)
     if args.demo:
-        return _run_demo(args, engine)
+        return _run_demo(args, engine=engine)
 
     from distributed_ml_pytorch_tpu.serving.frontend import ServingFrontend
     from distributed_ml_pytorch_tpu.utils.messaging import (
@@ -250,7 +415,10 @@ def main(argv=None) -> int:
         transport = ReliableTransport(transport)
     frontend = ServingFrontend(
         engine, transport, client_deadline=args.client_deadline,
-        fleet=coord_client.fleet if coord_client is not None else None)
+        fleet=coord_client.fleet if coord_client is not None else None,
+        slo_ttft_ms=args.slo_ttft_ms, shed_occupancy=args.shed_occupancy,
+        brownout_occupancy=args.brownout_occupancy,
+        brownout_max_new=args.brownout_max_new)
     print(f"serving on {args.master}:{args.port} "
           f"({args.slots} slots x {args.cache_size} rows, "
           f"block {args.decode_block}"

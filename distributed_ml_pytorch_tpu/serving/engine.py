@@ -40,7 +40,7 @@ import dataclasses
 import itertools
 import threading
 import time
-from typing import Callable, Deque, List, Optional
+from typing import Callable, Deque, List, Optional, Tuple
 
 import numpy as np
 
@@ -78,6 +78,11 @@ class Request:
     max_new_tokens: int
     sampling: SamplingParams
     eos_token: Optional[int] = None
+    #: sampling-key schedule offset: this request's token ``g`` is drawn
+    #: with ``fold_in(key(seed), gen_offset + g)`` — nonzero only for a
+    #: RESUMED request (fleet migration re-prefills prompt + generated-so-
+    #: far on a surviving engine and continues the schedule mid-stream)
+    gen_offset: int = 0
     tokens: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
     cancelled: bool = False
@@ -161,8 +166,15 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                seed: int = 0, eos_token: Optional[int] = None,
-               request_id: Optional[int] = None) -> Request:
+               request_id: Optional[int] = None,
+               gen_offset: int = 0) -> Request:
         """Queue one request; returns its live :class:`Request` handle.
+
+        ``gen_offset`` resumes the sampling-key schedule at that generated-
+        token index — the stream-migration path passes the number of tokens
+        already emitted by a dead engine, with ``prompt`` extended by those
+        tokens and ``max_new_tokens`` reduced by the same count, and the
+        resumed stream continues token-identically.
 
         Raises :class:`QueueFullError` at ``max_queue`` waiting requests
         (admission control) and ``ValueError`` for requests the pool can
@@ -187,9 +199,14 @@ class ServingEngine:
                         else next(self._ids)),
             prompt=prompt, max_new_tokens=int(max_new_tokens),
             sampling=SamplingParams(temperature, top_k, top_p, seed),
-            eos_token=eos_token, t_submit=time.perf_counter())
+            eos_token=eos_token, gen_offset=max(0, int(gen_offset)),
+            t_submit=time.perf_counter())
         with self._lock:
-            if len(self._queue) >= self.max_queue:
+            # cancelled entries (e.g. overload-shed work awaiting its
+            # admission-pass drop) no longer hold queue room — a displacing
+            # submit must be admittable the moment its victim is shed
+            if sum(1 for r in self._queue
+                   if not r.cancelled) >= self.max_queue:
                 self._rejected += 1
                 raise QueueFullError(
                     f"queue at max_queue={self.max_queue}; retry later")
@@ -279,16 +296,24 @@ class ServingEngine:
             padded = np.zeros(bucket, np.int32)
             padded[:p] = req.prompt
             sp = req.sampling
-            tok0 = self.pool.admit(
-                slot, padded, p, seed=sp.seed, temperature=sp.temperature,
-                top_k=sp.top_k, top_p=sp.top_p)
-            req.slot = slot
+            # claim the slot BEFORE the admission dispatch: between the
+            # queue pop above and this point the request is in neither the
+            # queue count nor the slot count, and a fleet router sampling
+            # pressure() cross-thread would see a falsely idle engine and
+            # stack new work onto it (prefill dispatch is a ~ms window)
             req.active_at_admit = sum(
                 r is not None for r in self._slot_req)
-            req.t_admit = time.perf_counter()
+            req.slot = slot  # with it, "slot is None" == waiting, exactly
             self._slot_req[slot] = req
+            tok0 = self.pool.admit(
+                slot, padded, p, seed=sp.seed, temperature=sp.temperature,
+                top_k=sp.top_k, top_p=sp.top_p, gen_offset=req.gen_offset)
+            req.t_admit = time.perf_counter()
             self._tok[slot] = tok0
-            self._n_gen[slot] = 1
+            # the per-slot sampling clock continues the request's OWN
+            # schedule: a resumed request's next draw is fold_in(key,
+            # gen_offset + 1), exactly what its first life would have drawn
+            self._n_gen[slot] = req.gen_offset + 1
             self._seeds[slot] = np.uint32(sp.seed)
             self._temps[slot] = sp.temperature
             self._top_ks[slot] = sp.top_k
@@ -360,6 +385,25 @@ class ServingEngine:
         req._event.set()
 
     # ------------------------------------------------------------- metrics
+    def pressure(self) -> Tuple[int, int, int]:
+        """Cheap load sample for routers and admission control:
+        ``(busy_slots, total_slots, queued)``. Advisory — one scheduling
+        round stale at worst, which is within the overload plane's
+        contract (shed decisions are rate signals, not invariants)."""
+        with self._lock:
+            queued = len(self._queue)
+        busy = sum(r is not None for r in self._slot_req)
+        return busy, self.pool.slots, queued
+
+    def recent_ttft_ms(self, k: int = 16) -> float:
+        """Mean of the last ``k`` TTFT samples in milliseconds (0.0 when
+        nothing completed yet) — the SLO-breach signal the overload plane
+        and the coordinator's engine-scaling advisory consume."""
+        tail = self._ttft[-k:]
+        if not tail:
+            return 0.0
+        return float(np.mean(tail)) * 1e3
+
     def reset_metrics(self) -> None:
         """Drop accumulated SLO samples (e.g. after a compile warmup) while
         keeping the block timer's warmup state — mirrors

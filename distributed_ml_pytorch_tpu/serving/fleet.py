@@ -1,0 +1,434 @@
+"""Fleet serving: N engine replicas behind one router, degrading instead of
+dying (ISSUE 6 tentpole).
+
+PR 1's serving plane was one engine behind one frontend: a single engine
+death killed every in-flight stream, and overload had no answer beyond a
+bounded queue. This module is the serving analogue of the elastic PS plane
+(``coord/``): the frontend becomes a **router** over N
+:class:`EngineMember` replicas, each a lease-holding fleet member, and the
+three failure answers compose:
+
+- **Routing.** New requests go to the healthy engine with the most free
+  KV-slot capacity (occupancy = busy slots + queued, the same pressure
+  signal the overload plane sheds on); a ``session`` hint in the V2 submit
+  frame pins a session's requests to one engine while it stays healthy
+  (prefix locality — the cheapest cache-aware policy that needs no cache
+  introspection).
+- **Health.** The router probes members the way ``HeartbeatSender`` probes
+  shards: every sweep checks each member's serve-loop heartbeat, marks it
+  down after ``probe_timeout`` of silence, logs the up↔down transition,
+  and REVIVES it on the next beat — a live view, not a one-shot flag. A
+  coordinator adds the second detection path: members renew leases
+  (occupancy/TTFT ride the renewals), the ``FleetState`` broadcast carries
+  the live engine ranks, and a rank that vanishes from it (lease expiry)
+  is treated exactly like a failed probe.
+- **Migration.** The router already holds every stream's full token
+  history (PR 2's resume source). When an engine dies, each of its
+  in-flight routes is resubmitted on a survivor as ``prompt +
+  tokens-so-far`` with ``gen_offset = len(tokens)`` — the engine continues
+  the request's own sampling-key schedule (``fold_in(key(seed), g)`` is
+  position-in-stream, not position-on-engine), so the resumed stream is
+  token-identical to one the dead engine would have produced, greedy or
+  sampled. The dead attempt's engine key is retired under the route lock,
+  so a straggler callback from a not-quite-dead engine cannot corrupt the
+  stream. Clients see latency, never an error.
+
+Overload (shed/brownout/deadline) is inherited from
+:class:`~distributed_ml_pytorch_tpu.serving.frontend.ServingFrontend` with
+``_pressure()`` aggregated over healthy members only — a half-dead fleet
+sheds sooner, which is the point.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from distributed_ml_pytorch_tpu.serving.engine import (
+    QueueFullError,
+    ServingEngine,
+)
+from distributed_ml_pytorch_tpu.serving.frontend import (
+    ORPHANED_ENGINE,
+    ServingFrontend,
+    _Route,
+)
+from distributed_ml_pytorch_tpu.utils.messaging import MessageCode, Transport
+
+
+class EngineMember:
+    """One engine replica of the serving fleet.
+
+    Owns the engine's scheduling thread (``engine.step()`` loop) and,
+    optionally, a :class:`~distributed_ml_pytorch_tpu.coord.member.
+    CoordClient` lease: the member joins the coordination star as an
+    ``engine`` and piggybacks its occupancy/TTFT on every renewal
+    (``report(occupancy_pct, queue_depth, ttft_ms)`` — the coordinator's
+    engine-scaling advisory reads exactly these numbers).
+
+    ``crash()`` is the chaos hook: the serve loop halts at the next block
+    boundary and lease renewals STOP without a leave — the coordinator must
+    detect the death by lease expiry, and the router by its probe.
+    """
+
+    def __init__(self, engine_id: int, engine: ServingEngine, *,
+                 coord=None, report_interval: float = 0.25,
+                 idle_sleep: float = 0.002, throttle: float = 0.0):
+        self.engine_id = int(engine_id)
+        self.engine = engine
+        self.coord = coord
+        self.report_interval = float(report_interval)
+        self.idle_sleep = float(idle_sleep)
+        #: seconds slept after every WORKED scheduling round — a chaos/
+        #: bench hook that emulates a slower accelerator (deterministic
+        #: load shaping for overload and lease-expiry scenarios)
+        self.throttle = float(throttle)
+        self._stop = threading.Event()
+        self._crashed = False
+        #: serve-loop heartbeat the router's probe reads: monotonic stamp
+        #: of the last completed scheduling round (GIL-atomic float store)
+        self.last_beat = time.monotonic()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def coord_rank(self) -> Optional[int]:
+        return None if self.coord is None else self.coord.transport.rank
+
+    @property
+    def alive(self) -> bool:
+        return (self._thread is not None and self._thread.is_alive()
+                and not self._crashed)
+
+    def start(self) -> "EngineMember":
+        if self.coord is not None:
+            self.coord.join(timeout=5.0)
+        self._thread = threading.Thread(
+            target=self._serve, name=f"engine-{self.engine_id}", daemon=True)
+        self._thread.start()
+        return self
+
+    def _serve(self) -> None:
+        next_report = time.monotonic()
+        while not self._stop.is_set():
+            worked = self.engine.step()
+            now = time.monotonic()
+            self.last_beat = now
+            if self.coord is not None and now >= next_report:
+                busy, slots, queued = self.engine.pressure()
+                occ_pct = int(100 * (busy + queued) / max(1, slots))
+                self.coord.report(min(occ_pct, 10_000), queued,
+                                  self.engine.recent_ttft_ms())
+                next_report = now + self.report_interval
+            if not worked:
+                time.sleep(self.idle_sleep)
+            elif self.throttle:
+                time.sleep(self.throttle)
+
+    def pressure(self) -> Tuple[int, int, int]:
+        return self.engine.pressure()
+
+    def stop(self) -> None:
+        """Clean shutdown: stop serving and leave the coordination star."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self.coord is not None:
+            self.coord.close()
+
+    def crash(self) -> None:
+        """Silent scripted death (no leave, no further renewals): the
+        lease-expiry detection path, like ``ElasticShardServer.crash``."""
+        self._crashed = True
+        self._stop.set()
+        if self.coord is not None:
+            self.coord.stop()
+
+
+class FleetRouter(ServingFrontend):
+    """The frontend as a router over N :class:`EngineMember` replicas.
+
+    Same wire protocol and client as :class:`ServingFrontend` (submit/
+    stream/reject/cancel/resume, ``MessageCode`` 5-8/11-12/23); the engine
+    behind a request is a routing decision, re-made on engine death.
+
+    ``fleet`` (optional) is the control-plane view: anything with
+    ``engine_up()`` (hold-and-readmit, inherited) and optionally
+    ``live_engine_ranks()`` — the per-engine generalization: a member
+    whose coordinator rank disappears from the live set (lease expiry) is
+    marked down and its streams migrate, even if the local probe has not
+    fired yet. ``fleet=None`` fails open: the router serves on its own
+    probe alone.
+
+    ``serve_forever`` only sweeps (readmit, reap, probe, migrate) — the
+    members' own threads drive decoding, so N replicas decode in parallel.
+    """
+
+    def __init__(self, transport: Transport, members: List[EngineMember], *,
+                 probe_timeout: float = 2.0, session_affinity: bool = True,
+                 **kw):
+        if not members:
+            raise ValueError("FleetRouter needs at least one EngineMember")
+        self.members: Dict[int, EngineMember] = {}
+        for m in members:
+            if m.engine_id in self.members:
+                raise ValueError(f"duplicate engine_id {m.engine_id}")
+            if m.engine.on_tokens is not None:
+                raise ValueError(
+                    f"engine {m.engine_id} already has an on_tokens consumer")
+            self.members[m.engine_id] = m
+        self.probe_timeout = float(probe_timeout)
+        self.session_affinity = bool(session_affinity)
+        #: engine_id -> router's health verdict (True = routable); member
+        #: down-markings self-heal on the next good probe, like
+        #: ``HeartbeatSender.peer_down``
+        self._member_up: Dict[int, bool] = {
+            m.engine_id: True for m in members}
+        #: coord ranks ever seen live by the fleet view — lease expiry is
+        #: "was there, now is not", never "has not joined yet"
+        self._seen_ranks: set = set()
+        self._affinity: Dict[Tuple[int, int], int] = {}
+        self.migrations = 0          # streams moved across an engine death
+        self.migration_failures = 0  # a healthy survivor refused the stream
+        self.parked = 0              # submits parked awaiting ANY engine
+        self._mttr: List[float] = []  # per-death seconds: detect -> resumed
+        for m in members:
+            m.engine.on_tokens = self._on_tokens
+        super().__init__(None, transport, **kw)
+
+    # --------------------------------------------------------------- routing
+    def _healthy_members(self) -> List[EngineMember]:
+        return [m for eid, m in sorted(self.members.items())
+                if self._member_up.get(eid, False)]
+
+    def _pick_engine(self, route: _Route) -> Optional[EngineMember]:
+        """Most free KV-slot capacity among healthy members, with session
+        affinity when the pinned engine is healthy and has room."""
+        healthy = self._healthy_members()
+        if not healthy:
+            return None
+        scored = []
+        for m in healthy:
+            busy, slots, queued = m.pressure()
+            scored.append(((slots - busy - queued), -m.engine_id, m))
+        scored.sort(reverse=True)
+        best = scored[0][2]
+        if self.session_affinity and route.session:
+            pin = self._affinity.get((route.rank, route.session))
+            if pin is not None and self._member_up.get(pin, False):
+                m = self.members[pin]
+                busy, slots, queued = m.pressure()
+                if busy + queued < slots:  # pinned engine has a free slot
+                    return m
+            if len(self._affinity) > 65536:
+                self._affinity.clear()  # bounded: re-pinned on next use
+            self._affinity[(route.rank, route.session)] = best.engine_id
+        return best
+
+    def _submit_route(self, key: int, route: _Route) -> bool:
+        """Route a fresh OR resumed route. ``route.prompt``/``route.kwargs``
+        always hold the ORIGINAL request; the effective submission derives
+        from the tokens already streamed, so a stream can migrate any
+        number of times and the arithmetic stays anchored to the origin."""
+        member = self._pick_engine(route)
+        if member is None:
+            # no healthy engine RIGHT NOW (probe blip or fleet-wide
+            # outage): PARK instead of reject — the sweep resubmits when a
+            # member revives, so a transient blip costs latency, not the
+            # stream. (Deadline/overload shedding still applies to parked
+            # work, and a request the fleet never recovers for is reaped
+            # by the client-silence sweep — parking is bounded.)
+            route.engine_id = ORPHANED_ENGINE
+            route.req = None
+            self.parked += 1
+            return True
+        # stable without a lock: a fresh route has no engine yet, and a
+        # migrating route was RETIRED first (_take_routes_where), so no
+        # callback can be appending while this snapshot is taken
+        had = list(route.tokens)
+        kwargs = dict(route.kwargs)
+        kwargs["max_new_tokens"] = int(kwargs["max_new_tokens"]) - len(had)
+        if had:
+            kwargs["gen_offset"] = len(had)
+            prompt = np.concatenate(
+                [np.asarray(route.prompt, np.int32),
+                 np.asarray(had, np.int32)])
+        else:
+            prompt = route.prompt
+        try:
+            route.req = member.engine.submit(
+                prompt, request_id=key, **kwargs)
+        except (QueueFullError, ValueError):
+            return False
+        route.engine_id = member.engine_id
+        return True
+
+    def _cancel_route(self, key: int, route: _Route) -> None:
+        member = self.members.get(route.engine_id)
+        if member is not None:
+            member.engine.cancel(key)
+
+    # --------------------------------------------------------- overload plane
+    def _pressure(self) -> float:
+        busy = queued = slots = 0
+        for m in self._healthy_members():
+            b, s, q = m.pressure()
+            busy, slots, queued = busy + b, slots + s, queued + q
+        if slots == 0:
+            return 1.0  # no healthy engine: maximally loaded
+        return (busy + queued) / slots
+
+    def _ttft_now_ms(self) -> float:
+        samples = [m.engine.recent_ttft_ms() for m in self._healthy_members()]
+        samples = [s for s in samples if s > 0]
+        return float(np.mean(samples)) if samples else 0.0
+
+    # ------------------------------------------------------- health + probes
+    def _probe(self, now: float) -> None:
+        """HeartbeatSender-style liveness over the members: serve-loop
+        beats (local probe) + coordinator lease view (fleet probe)."""
+        lease_live = None
+        ranks = getattr(self.fleet, "live_engine_ranks", None)
+        if ranks is not None:
+            lease_live = ranks()
+            if lease_live is not None:
+                self._seen_ranks |= set(lease_live)
+        for eid, m in sorted(self.members.items()):
+            up = m.alive and (now - m.last_beat) <= self.probe_timeout
+            if up and lease_live is not None and m.coord_rank is not None \
+                    and m.coord_rank not in lease_live \
+                    and m.coord_rank in self._seen_ranks:
+                # the coordinator expired this member's lease: trust it —
+                # the probe may still see beats (e.g. a member that can
+                # compute but lost its control-plane life)
+                up = False
+            was = self._member_up.get(eid, True)
+            if up != was:
+                print(f"fleet: engine {eid} state "
+                      f"{'down->up' if up else 'up->down'}", file=sys.stderr)
+                self._member_up[eid] = up
+            if not up:
+                # EVERY sweep, not just the transition: a submit racing
+                # the up->down edge can land a route on the dead engine
+                # AFTER the transition's migration snapshot — rescuing on
+                # each sweep makes that window self-healing (idempotent:
+                # no matching routes, no work)
+                self._migrate_from(eid, now)
+
+    def _migrate_from(self, dead_id: int, now: float) -> None:
+        """Move every in-flight stream off a dead engine: retire the old
+        engine keys under the route lock (a straggler callback from a
+        not-quite-dead engine must find nothing), then resubmit each route
+        under a FRESH key — ``_submit_route`` re-prefills prompt +
+        generated-so-far with the matching ``gen_offset``."""
+        moving = self._take_routes_where(
+            lambda r: r.engine_id == dead_id and not r.done)
+        dead = self.members.get(dead_id)
+        resumed = 0
+        for old_key, route in moving:
+            if dead is not None:
+                dead.engine.cancel(old_key)  # free state if it ever revives
+            new_key = next(self._route_ids)
+            if not route.service_lost_at:
+                route.service_lost_at = now  # MTTR anchors at DETECTION
+            # retired above: the token history is frozen, no lock needed
+            n_had = len(route.tokens)
+            if n_had >= int(route.kwargs["max_new_tokens"]):
+                # everything was generated; only the done frame is owed
+                route.done = True
+                route.done_at = now
+                self._install_route(new_key, route)
+                self._send_frame(route, start=n_had, tokens=[], done=True)
+                continue
+            self._install_route(new_key, route)
+            if not self._submit_route(new_key, route):
+                # a healthy survivor refused it: explicit reject, never
+                # silence (no healthy survivor at all PARKS instead — the
+                # retry sweep resumes it and closes its MTTR sample then)
+                self.migration_failures += 1
+                self._drop_route(new_key)
+                self._send_to(route.rank, MessageCode.ServeReject,
+                              np.asarray([route.rid], np.float32))
+            elif route.engine_id != ORPHANED_ENGINE:
+                resumed += 1
+                self._note_resumed(route)
+        if resumed:
+            print(f"fleet: migrated {resumed}/{len(moving)} stream(s) off "
+                  f"engine {dead_id} in "
+                  f"{(time.monotonic() - now) * 1e3:.1f} ms",
+                  file=sys.stderr)
+
+    def _note_resumed(self, route: _Route) -> None:
+        """Close one stream's outage window: count the migration and record
+        detection -> back-in-service as its MTTR sample."""
+        self.migrations += 1
+        if route.service_lost_at:
+            self._mttr.append(time.monotonic() - route.service_lost_at)
+            route.service_lost_at = 0.0
+
+    def mttr_s(self) -> Optional[float]:
+        """Mean seconds from death detection to every stream resubmitted
+        (None until a migration happened) — the bench's migration MTTR."""
+        return float(np.mean(self._mttr)) if self._mttr else None
+
+    def _retry_parked(self) -> None:
+        """Resubmit routes parked while no engine was healthy. A park that
+        stays parked (still no healthy member) waits for the next sweep; a
+        HEALTHY engine refusing the work (queue full / unfittable) is a
+        real reject."""
+        if not self._healthy_members():
+            return
+        parked = self._routes_where(
+            lambda r: r.engine_id == ORPHANED_ENGINE and not r.done)
+        for key, route in parked:
+            in_flight = bool(route.tokens)
+            if not self._submit_route(key, route):
+                self.migration_failures += 1
+                self._drop_route(key)
+                self._send_to(route.rank, MessageCode.ServeReject,
+                              np.asarray([route.rid], np.float32))
+            elif in_flight and route.engine_id != ORPHANED_ENGINE:
+                # an in-flight stream is back in service: its MTTR sample
+                # spans the WHOLE outage (death detection -> this resume)
+                self._note_resumed(route)
+
+    # ------------------------------------------------------------------ loop
+    def _sweep(self, now: float) -> None:
+        self._probe(now)
+        self._retry_parked()
+        super()._sweep(now)
+
+    def serve_forever(self, idle_sleep: float = 0.02,
+                      sweep_every: float = 0.1) -> None:
+        """Sweep loop only — decoding runs on the members' own threads."""
+        while not self._stop.is_set():
+            self._sweep(time.monotonic())
+            time.sleep(min(idle_sleep, sweep_every))
+
+    def stop(self) -> None:
+        super().stop()
+        for m in self.members.values():
+            if m.alive:
+                m.stop()
+
+    def fleet_summary(self) -> dict:
+        """Router-level stats for benches and the CLI exit report."""
+        return {
+            "engines": {
+                eid: {"up": self._member_up.get(eid, False),
+                      "alive": m.alive,
+                      "pressure": m.pressure()}
+                for eid, m in sorted(self.members.items())
+            },
+            "migrations": self.migrations,
+            "migration_failures": self.migration_failures,
+            "parked": self.parked,
+            "mttr_s": self.mttr_s(),
+            "shed": self.shed,
+            "brownouts": self.brownouts,
+            "reaped": self.reaped,
+            "held_peak": self.held_peak,
+        }

@@ -102,6 +102,10 @@ class MessageCode(enum.IntEnum):
     # --- durability plane (ISSUE 5): coordinator-aligned fleet snapshots ---
     SnapshotRequest = 21
     SnapshotDone = 22
+    # --- fleet serving + versioned shard traffic (ISSUE 6) ---
+    SubmitRequestV2 = 23
+    ShardPush = 24
+    ShardParams = 25
 
 
 @dataclasses.dataclass(frozen=True)
@@ -196,16 +200,19 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
     MessageCode.FleetState: PayloadSchema(
         fields=("version_lo", "version_hi", "n_workers", "n_shards",
                 "n_engines", "workers_done"),
-        handled_by=("coord",),
-        doc="compact fleet broadcast the serving frontend consumes"),
+        rest="engine_ranks", handled_by=("coord",),
+        doc="compact fleet broadcast the serving frontend consumes; the "
+            "tail lists live engine coord-ranks (per-engine lease health)"),
     MessageCode.SpeculateTask: PayloadSchema(
         fields=("task_id", "victim_rank", "from_step"),
         handled_by=("coord",),
         doc="coordinator -> backup AND victim; same id for dedup"),
     MessageCode.SpeculativeUpdate: PayloadSchema(
-        fields=("task_lo", "task_hi"), rest="payload",
-        handled_by=("coord",),
-        doc="Sandblaster backup-task result; first task id wins at the PS"),
+        fields=("task_lo", "task_hi", "ver_lo", "ver_hi", "lo_lo", "lo_hi",
+                "hi_lo", "hi_hi"),
+        rest="payload", handled_by=("coord",),
+        doc="Sandblaster backup-task result stamped like ShardPush; first "
+            "task id wins at the PS, wrong-offset traffic dropped"),
     MessageCode.RangeInstall: PayloadSchema(
         fields=("lo_lo", "lo_hi", "hi_lo", "hi_hi"), rest="values",
         handled_by=("coord",),
@@ -223,6 +230,27 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
         handled_by=("coord",),
         doc="shard -> coordinator: checkpoint taken (range + apply seq + "
             "push count); the coordinator assembles the FleetManifest"),
+    MessageCode.SubmitRequestV2: PayloadSchema(
+        fields=("id", "max_new", "temperature", "top_k", "top_p", "seed",
+                "eos", "priority", "deadline_ms", "session"),
+        rest="prompt", rest_min=1, handled_by=("serving",),
+        doc="client -> engine with overload-plane metadata: priority "
+            "(higher wins admission under shed), deadline_ms (0 = none; "
+            "relative to submit) and session (affinity hint)"),
+    MessageCode.ShardPush: PayloadSchema(
+        fields=("ver_lo", "ver_hi", "lo_lo", "lo_hi", "hi_lo", "hi_hi"),
+        rest="params", rest_min=1, handled_by=("coord",),
+        doc="elastic worker -> shard server: GradientUpdate stamped with "
+            "the sender's shard-map version AND the absolute [lo,hi) it "
+            "sliced — the RANGE is the correctness gate (closes the "
+            "equal-size stale-map blind spot, coord/shardmap.py; a benign "
+            "version bump with unmoved ranges stays compatible)"),
+    MessageCode.ShardParams: PayloadSchema(
+        fields=("ver_lo", "ver_hi", "lo_lo", "lo_hi", "hi_lo", "hi_hi"),
+        rest="params", rest_min=1, handled_by=("ps",),
+        doc="elastic shard server -> worker: pull reply stamped like "
+            "ShardPush (the versioned ParameterUpdate); the worker applies "
+            "only a reply whose range matches its current expectation"),
 }
 
 

@@ -91,6 +91,19 @@ def pytest_configure(config):
         "in tier-1, the full kill-all scenarios are additionally measured "
         "into slow_tests.txt",
     )
+    config.addinivalue_line(
+        "markers",
+        "fleet: fleet-serving tests (serving/fleet.py — occupancy routing, "
+        "stream migration across engine death, overload shed/brownout); "
+        "`make fleet` selects exactly these — fast cases run in tier-1, "
+        "the acceptance scenarios are additionally in slow_tests.txt",
+    )
+    config.addinivalue_line(
+        "markers",
+        "soak: sustained-load scenarios (the 2x-overload goodput soak); "
+        "`make soak` selects exactly these — all also slow, so tier-1 "
+        "never pays for them",
+    )
 
 
 # Modules whose tests launch real subprocess worlds (interpreter start + jit

@@ -569,9 +569,207 @@ def test_frontend_holds_submits_on_engine_loss_and_readmits(lm_and_params):
 def test_fleet_view_fails_open_without_reports():
     fleet = FleetView()
     assert fleet.engine_up()  # no control plane / no report yet: admit
+    assert fleet.live_engine_ranks() is None  # per-engine view fails open too
     fleet.update({"version": 1, "n_workers": 1, "n_shards": 1,
                   "n_engines": 0, "workers_done": False})
     assert not fleet.engine_up()
     fleet.update({"version": 2, "n_workers": 1, "n_shards": 1,
                   "n_engines": 2, "workers_done": False})
     assert fleet.engine_up()
+
+
+def test_fleet_state_carries_live_engine_ranks_on_the_wire():
+    """ISSUE 6: the FleetState broadcast's tail lists the live engine
+    coord-ranks, so a router can tell WHICH engine's lease expired."""
+    from distributed_ml_pytorch_tpu.coord.coordinator import (
+        KIND_ENGINE,
+        decode_fleet,
+        encode_fleet,
+    )
+
+    frame = encode_fleet(3, 2, 2, 2, False, engine_ranks=[51, 57])
+    decoded = decode_fleet(frame)
+    assert decoded["engine_ranks"] == [51, 57]
+    # a legacy counts-only frame still decodes (empty rank list)
+    legacy = encode_fleet(3, 2, 2, 2, False)
+    assert decode_fleet(legacy)["engine_ranks"] == []
+    # and the coordinator's own state produces the same view
+    clock = _Clock()
+    c = Coordinator(None, 100, lease=2.0, clock=clock, speculation=False)
+    c.handle(51, MessageCode.CoordJoin, encode_join(KIND_ENGINE, 10))
+    c.handle(57, MessageCode.CoordJoin, encode_join(KIND_ENGINE, 11))
+    assert c.live_engine_ranks() == {51, 57}
+    assert c.fleet_state()["engine_ranks"] == [51, 57]
+
+
+# ---------------------------------------------------------------------------
+# satellite (ISSUE 6): the equal-size stale-map blind spot is CLOSED —
+# elastic push/pull frames are version-tagged on the wire
+# ---------------------------------------------------------------------------
+
+class _StubCoord:
+    """Just enough CoordClient surface for ElasticShardServer.handle."""
+
+    on_snapshot = None
+
+    def __init__(self):
+        self.reports = []
+
+    def report(self, *a):
+        self.reports.append(a)
+
+
+def _same_count_rebalance_maps(n=100):
+    """THE blind-spot construction: a join and a death landing in one
+    rebalance — server 2 keeps a 50-param range but at a MOVED offset."""
+    m1 = rebalance(ShardMap(0, n, ()), [1, 2])   # v1: s1=[0,50) s2=[50,100)
+    m2 = rebalance(m1, [2, 3])                   # v2: s2=[0,50) s3=[50,100)
+    e1, e2 = m1.entry_for(2), m2.entry_for(2)
+    assert (e1.size == e2.size == 50) and (e1.lo, e1.hi) != (e2.lo, e2.hi)
+    return m1, m2
+
+
+def _push_frame(version, lo, hi, values):
+    from distributed_ml_pytorch_tpu.utils.messaging import _split16
+
+    return np.concatenate(
+        [np.asarray([*_split16(version), *_split16(lo), *_split16(hi)],
+                    np.float32), values])
+
+
+def test_stamped_push_drops_same_size_cross_version_traffic():
+    from distributed_ml_pytorch_tpu.utils.messaging import _split16
+
+    n = 100
+    flat0 = np.arange(n, dtype=np.float32)
+    m1, m2 = _same_count_rebalance_maps(n)
+    world = InProcessTransport.create_world(2)
+    srv = ElasticShardServer(server_id=2, n_params=n, transport=world[0],
+                             coord=_StubCoord(), init_params=flat0)
+    srv._apply_map(m1)
+    assert (srv.lo, srv.hi) == (50, 100)
+    srv._apply_map(m2)
+    assert (srv.lo, srv.hi) == (0, 50)  # equal size, moved offsets
+    before = srv.central
+    delta = np.full(50, 0.5, np.float32)
+    # a worker still on v1 pushes a 50-param slice it cut for [50,100):
+    # the length check alone could NEVER catch this — the range stamp does
+    srv.handle(9, MessageCode.ShardPush,
+               _push_frame(m1.version, 50, 100, delta))
+    assert srv.stats["stale_dropped"] == 1
+    np.testing.assert_array_equal(srv.central, before)
+    # an UNSTAMPED equal-size push (the pre-upgrade wire) is refused too
+    srv.handle(9, MessageCode.GradientUpdate, delta)
+    assert srv.stats["stale_dropped"] == 2
+    np.testing.assert_array_equal(srv.central, before)
+    # the same slice cut for the agreed range applies
+    srv.handle(9, MessageCode.ShardPush,
+               _push_frame(m2.version, 0, 50, delta))
+    np.testing.assert_array_equal(srv.central, before + delta)
+    # speculative updates carry the stamp as well
+    spec_stale = np.concatenate(
+        [np.asarray([*_split16(7), *_split16(m1.version), *_split16(50),
+                     *_split16(100)], np.float32), delta])
+    srv.handle(9, MessageCode.SpeculativeUpdate, spec_stale)
+    assert srv.stats["stale_dropped"] == 3 and srv.stats["spec_applied"] == 0
+    # and the benign flip side (the drill's restore-rejoin): a version
+    # bump whose range stayed put keeps in-flight pushes COMPATIBLE — an
+    # acked gradient is never dropped for a stamp that moved nothing
+    m3 = rebalance(m2, [2, 3])
+    assert m3.entry_for(2).lo == 0 and m3.entry_for(2).hi == 50
+    srv._apply_map(m3)
+    srv.handle(9, MessageCode.ShardPush,
+               _push_frame(m2.version, 0, 50, delta))
+    np.testing.assert_array_equal(srv.central, before + 2 * delta)
+    assert srv.stats["stale_dropped"] == 3  # unchanged
+    for t in world.values():
+        t.close()
+
+
+def test_stamped_pull_reply_dropped_by_cross_version_worker():
+    """The pull direction of the same blind spot: the server's reply is
+    stamped (ShardParams) and a worker whose slot expects other offsets
+    drops it instead of installing 50 params at the wrong place."""
+    from distributed_ml_pytorch_tpu.parallel.async_ps import Listener
+    from distributed_ml_pytorch_tpu.utils.messaging import _split16
+
+    n = 100
+    flat0 = np.arange(n, dtype=np.float32)
+    m1, m2 = _same_count_rebalance_maps(n)
+    world = InProcessTransport.create_world(2)
+    srv = ElasticShardServer(server_id=2, n_params=n, transport=world[0],
+                             coord=_StubCoord(), init_params=flat0)
+    srv._apply_map(m1)
+    srv._apply_map(m2)
+    # the moved range is entirely fresh: hand over its values (first
+    # install wins) so pulls are no longer parked
+    srv.handle(1, MessageCode.RangeInstall, np.concatenate(
+        [np.asarray([*_split16(0), *_split16(50)], np.float32),
+         flat0[0:50]]))
+    assert srv.pending_install is None
+    # worker pulls: the reply must be a stamped ShardParams frame carrying
+    # (version, lo, hi)
+    srv.handle(1, MessageCode.ParameterRequest, np.zeros(0, np.float32))
+    listener = Listener(transport=world[1])
+    msg = world[1].recv(timeout=5)
+    assert msg is not None and msg[1] == MessageCode.ShardParams
+    listener.receive(*msg)
+    stamp, values = listener.take_latest_versioned()
+    assert stamp == (m2.version, 0, 50) and values.shape == (50,)
+    # a worker slot still expecting m1's [50,100) sees the range mismatch
+    # and drops the reply (ShardedAsynchronous._install_arrived's gate)
+    e1 = m1.entry_for(2)
+    assert stamp[1:] != (e1.lo, e1.hi)
+    # legacy ParameterUpdate replies still flow stamp-less (None)
+    listener.receive(1, MessageCode.ParameterUpdate, np.zeros(50, np.float32))
+    stamp2, values2 = listener.take_latest_versioned()
+    assert stamp2 is None and values2.shape == (50,)
+    for t in world.values():
+        t.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 6: the coordinator's engine-scaling advisory (per-engine metrics)
+# ---------------------------------------------------------------------------
+
+def test_engine_scaling_advisory_from_reported_metrics():
+    from distributed_ml_pytorch_tpu.coord.coordinator import KIND_ENGINE
+
+    clock = _Clock()
+    advice = []
+    c = Coordinator(None, 100, lease=10.0, clock=clock, speculation=False,
+                    engine_occ_high=0.85, engine_occ_low=0.2,
+                    engine_slo_ttft_ms=500.0, scale_cooldown=5.0,
+                    on_scale=lambda d, detail: advice.append((d, detail)))
+    c.handle(51, MessageCode.CoordJoin, encode_join(KIND_ENGINE, 10))
+    c.handle(52, MessageCode.CoordJoin, encode_join(KIND_ENGINE, 11))
+    # no reports yet: no advice (a just-joined fleet must not be scaled)
+    assert c.check_engine_scaling() is None
+    # engines renew with (occupancy%, queue depth, TTFT ms) in the renewal
+    # slots — 95% mean occupancy breaches occ_high
+    c.handle(51, MessageCode.LeaseRenew, encode_renew(10, 95, 3, 80.0))
+    c.handle(52, MessageCode.LeaseRenew, encode_renew(11, 95, 2, 90.0))
+    assert c.check_engine_scaling() == "up"
+    assert advice and advice[-1][0] == "up"
+    assert advice[-1][1]["per_engine"][51]["occupancy"] == 0.95
+    # cooldown: immediately asking again stays quiet
+    assert c.check_engine_scaling() is None
+    clock.t += 6.0
+    # healthy occupancy but TTFT SLO breached: still scale-up
+    c.handle(51, MessageCode.LeaseRenew, encode_renew(10, 50, 0, 900.0))
+    c.handle(52, MessageCode.LeaseRenew, encode_renew(11, 50, 0, 800.0))
+    assert c.check_engine_scaling() == "up"
+    clock.t += 6.0
+    # near-idle fleet with >1 replicas: scale-down advised
+    c.handle(51, MessageCode.LeaseRenew, encode_renew(10, 5, 0, 10.0))
+    c.handle(52, MessageCode.LeaseRenew, encode_renew(11, 5, 0, 12.0))
+    assert c.check_engine_scaling() == "down"
+    clock.t += 6.0
+    # a FULLY idle fleet (all-zero renewals) still earns scale-down —
+    # idle renewals count as reports, only never-renewed members don't
+    c.handle(51, MessageCode.LeaseRenew, encode_renew(10, 0, 0, 0.0))
+    c.handle(52, MessageCode.LeaseRenew, encode_renew(11, 0, 0, 0.0))
+    assert c.check_engine_scaling() == "down"
+    # the decision log carries the evidence
+    assert any("scale-up advised" in e for e in c.events)
+    assert any("scale-down advised" in e for e in c.events)

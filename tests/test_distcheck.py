@@ -935,8 +935,12 @@ def test_elastic_server_snapshot_is_lock_consistent():
     done = threading.Event()
 
     def mutate():
-        srv.handle(1, MessageCode.GradientUpdate,
-                   np.ones(12, np.float32))
+        # the stamped elastic push (ISSUE 6 wire): (version, lo, hi) head
+        from distributed_ml_pytorch_tpu.utils.messaging import _split16
+
+        srv.handle(1, MessageCode.ShardPush, np.concatenate(
+            [np.asarray([*_split16(1), *_split16(0), *_split16(12)],
+                        np.float32), np.ones(12, np.float32)]))
         done.set()
 
     with srv._mu:
