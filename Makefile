@@ -107,6 +107,19 @@ fleet:
 soak:
 	$(PY) -m pytest tests/ -q -m soak
 
+# adaptive-wire suite (ISSUE 7): RTT-driven retransmission, window/credit
+# backpressure, circuit breakers, and seeded network weather (latency /
+# jitter / bandwidth caps / one-way degradation) — the training acceptance
+# proves graceful degradation with byte-identical chaos logs
+netweather:
+	$(PY) -m pytest tests/ -q -m netweather
+
+# wire cost ladder + reliability before/after (bench_all phases): every
+# transport layer priced raw -> reliable -> batched-ack -> WAL-deferred ->
+# chaos-wrapped, plus the ack-tax recovery measurement
+bench-wire:
+	$(PY) bench_all.py --only transport_microbench --only reliability
+
 # distcheck (analysis/): protocol / concurrency / tracing-hygiene static
 # analysis over the whole package — exits non-zero on any unsuppressed
 # finding that is not in the checked-in baseline. Regenerate the baseline
@@ -143,4 +156,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all chaos coord drill drill-demo fleet soak lint test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire chaos coord drill drill-demo fleet netweather soak lint test test-all verify-real-data graph install dist

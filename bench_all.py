@@ -1306,57 +1306,207 @@ def reliability_phase() -> None:
         "from distributed_ml_pytorch_tpu.utils.messaging import (\n"
         "    ReliableTransport, make_transport)\n"
         "t = make_transport(0, 2, port=int(sys.argv[1]), kind='python')\n"
-        "if sys.argv[2] == 'on':\n"
-        "    t = ReliableTransport(t, ack_timeout=5.0, max_backoff=10.0)\n"
+        "if sys.argv[2] != 'off':\n"
+        "    t = ReliableTransport(t, ack_timeout=5.0, max_backoff=10.0,\n"
+        "                          batched_acks=(sys.argv[2] == 'on'),\n"
+        "                          legacy_envelope=(sys.argv[2] == 'legacy'))\n"
         f"for _ in range({n_iter} + 2):\n"
         "    sender, code, payload = t.recv(timeout=120)\n"
         "    t.send(code, payload, dst=sender)\n"
         "t.close()\n"
     )
-    rates = {}
-    for acks in ("off", "on"):
-        port = _free_port()
-        srv = subprocess.Popen(
-            [_sys.executable, "-c", server_src, port, acks],
-            env=cpu_platform_env(),
-        )
-        t = None
-        try:
-            t = make_transport(1, 2, port=int(port), kind="python",
-                               connect_timeout=120)
-            if acks == "on":
-                t = ReliableTransport(t, ack_timeout=5.0, max_backoff=10.0)
-            for _ in range(2):  # warm both directions
-                t.send(MessageCode.GradientUpdate, payload)
-                t.recv(timeout=120)
-            t0 = time.perf_counter()
-            for _ in range(n_iter):
-                t.send(MessageCode.GradientUpdate, payload)
-                t.recv(timeout=120)
-            dt = time.perf_counter() - t0
-            rates[acks] = rate = n_iter / dt
-            mbps = 2 * payload.nbytes * rate / 1e6
-            emit(7, f"ps_transport_roundtrip_python_acks_{acks}", rate,
-                 "roundtrips/sec", "2 processes, localhost TCP",
-                 f"9.9 MB gradient payload echo ({mbps:.0f} MB/s both ways) "
-                 f"with the reliability layer {acks} — seq+CRC envelope, "
-                 "ack frames, receiver dedup (utils/messaging."
-                 "ReliableTransport)")
-        except Exception as e:
-            log(f"reliability bench (acks {acks}) failed: {e}")
-        finally:
-            if t is not None:
-                t.close()
-            if srv.poll() is None:
-                srv.kill()
-            srv.wait()
-    if "on" in rates and "off" in rates:
-        emit(7, "ps_reliability_layer_overhead",
-             100 * (1 - rates["on"] / rates["off"]), "percent", "derived",
-             "roundtrip-rate cost of acks+CRC+dedup on the 9.9 MB PS echo "
-             "(positive = reliability slower); the exactly-once apply "
+    rates: dict = {}
+    # interleaved best-of-4: on this 2-core rig one round's rate swings
+    # 20-50% with background load, so each mode keeps its BEST round
+    # (least interference) and the derived overhead compares bests
+    for _round in range(4):
+        for acks in ("off", "legacy", "on"):
+            port = _free_port()
+            srv = subprocess.Popen(
+                [_sys.executable, "-c", server_src, port, acks],
+                env=cpu_platform_env(),
+            )
+            t = None
+            try:
+                t = make_transport(1, 2, port=int(port), kind="python",
+                                   connect_timeout=120)
+                if acks != "off":
+                    t = ReliableTransport(
+                        t, ack_timeout=5.0, max_backoff=10.0,
+                        batched_acks=(acks == "on"),
+                        legacy_envelope=(acks == "legacy"))
+                for _ in range(2):  # warm both directions
+                    t.send(MessageCode.GradientUpdate, payload)
+                    t.recv(timeout=120)
+                iters = []
+                for _ in range(n_iter):
+                    t0 = time.perf_counter()
+                    t.send(MessageCode.GradientUpdate, payload)
+                    t.recv(timeout=120)
+                    iters.append(time.perf_counter() - t0)
+                # median-per-roundtrip: this shared 2-core host injects
+                # 20-40 ms scheduler spikes into a handful of iterations;
+                # a mean (total/n) would price the SCHEDULER, not the wire
+                rates[acks] = max(rates.get(acks, 0.0),
+                                  1.0 / float(np.median(iters)))
+            except Exception as e:
+                log(f"reliability bench (acks {acks}) failed: {e}")
+            finally:
+                if t is not None:
+                    t.close()
+                if srv.poll() is None:
+                    srv.kill()
+                srv.wait()
+    for acks, rate in rates.items():
+        mbps = 2 * payload.nbytes * rate / 1e6
+        desc = {
+            "off": "no reliability layer",
+            "legacy": "the ISSUE-2 wire faithfully reproduced: full-frame "
+                      "concatenate, tobytes+crc32 checksums, one ack per "
+                      "frame (legacy_envelope=True)",
+            "on": "ISSUE 7 adaptive wire: zero-copy checksums, "
+                  "scatter/gather envelope, batched cumulative acks",
+        }[acks]
+        emit(7, f"ps_transport_roundtrip_python_acks_{acks}", rate,
+             "roundtrips/sec", "2 processes, localhost TCP",
+             f"9.9 MB gradient payload echo ({mbps:.0f} MB/s both "
+             f"ways), median roundtrip, best of 4 rounds, {desc} "
+             "(utils/messaging.ReliableTransport)")
+    if "on" in rates and "off" in rates and "legacy" in rates:
+        overhead = 100 * (1 - rates["on"] / rates["off"])
+        before = 100 * (1 - rates["legacy"] / rates["off"])
+        emit(7, "ps_reliability_layer_overhead", overhead,
+             "percent", "derived",
+             "roundtrip-rate cost of acks+checksum+dedup on the 9.9 MB PS "
+             "echo (positive = reliability slower); the exactly-once apply "
              "guarantee under drop/dup/corrupt is what it buys "
              "(tests/test_chaos.py)")
+        # ISSUE 7 acceptance: >= half of the ack tax recovered. Before =
+        # the ISSUE-2 envelope measured TODAY on this rig (the wire got
+        # ~5x faster since the 36.5% record, which makes the same absolute
+        # CPU tax a LARGER fraction — same-day legs keep the comparison
+        # honest); after = the adaptive wire.
+        emit(7, "ps_reliability_ack_tax_recovered",
+             100 * (before - overhead) / max(1e-9, before),
+             "percent of legacy overhead", "derived",
+             f"before/after on this rig today: legacy envelope costs "
+             f"{before:.1f}% of raw rt/s (ISSUE-2 record: 36.5% on the "
+             f"then-slower wire), adaptive wire costs {overhead:.1f}% — "
+             "recovered by zero-copy u64-sum bulk checksums, sendv "
+             "scatter/gather framing and batched cumulative acks")
+
+
+def transport_microbench_phase() -> None:
+    """Config 7, wire cost ladder (ISSUE 7 satellite): every layer of the
+    unified transport stack priced on the same in-process echo — raw
+    mailboxes, the reliability envelope with legacy per-frame acks, the
+    adaptive batched-cumulative-ack path, WAL-style deferred acks released
+    at a group boundary, and the chaos wrapper's bookkeeping (empty plan).
+    One JSON line per rung, so a regression in any layer's overhead is a
+    diffable number, not a feeling."""
+    import threading
+
+    from distributed_ml_pytorch_tpu.utils.chaos import ChaosPlan
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        MessageCode,
+        ReliableTransport,
+        make_world,
+    )
+
+    payload = np.zeros(2_472_266, np.float32)  # raveled AlexNet size
+    n_iter = 20
+    group_n = 8  # WAL-deferred leg: acks released every `group_n` applies
+
+    def echo_run(make):
+        """Round-trip rate through a 2-rank world built by ``make()``."""
+        world, _ = make()
+        a, b = world[0], world[1]
+        stop = threading.Event()
+
+        def server():
+            applied = 0
+            while not stop.is_set():
+                msg = a.recv(timeout=0.5)
+                if msg is None:
+                    continue
+                applied += 1
+                commit = getattr(a, "ack_delivered", None)
+                if commit is not None and not a.ack_on_delivery \
+                        and applied % group_n == 0:
+                    commit()  # the group-fsync boundary releases acks
+                a.send(msg[1], msg[2], dst=1)
+
+        t = threading.Thread(target=server, daemon=True)
+        t.start()
+        # the CLIENT defers acks too on the wal rung (both ends share
+        # reliable_opts): release them at the same group cadence, or the
+        # server's echo sends would hit their window once n_iter outgrows
+        # it and wedge the bench
+        b_commit = getattr(b, "ack_delivered", None)
+        if b_commit is not None and getattr(b, "ack_on_delivery", True):
+            b_commit = None
+        echoes = 0
+
+        def pump_once():
+            nonlocal echoes
+            assert b.recv(timeout=30) is not None
+            echoes += 1
+            if b_commit is not None and echoes % group_n == 0:
+                b_commit()
+
+        try:
+            for _ in range(2):  # warm
+                b.send(MessageCode.GradientUpdate, payload)
+                pump_once()
+            t0 = time.perf_counter()
+            for _ in range(n_iter):
+                b.send(MessageCode.GradientUpdate, payload)
+                pump_once()
+            return n_iter / (time.perf_counter() - t0)
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            for side in (a, b):
+                commit = getattr(side, "ack_delivered", None)
+                if commit is not None:
+                    commit()  # release any tail behind the group boundary
+            for tr in world.values():
+                tr.close()
+
+    ladder = [
+        ("raw", "in-process mailboxes, no wrapping",
+         lambda: make_world(2)),
+        ("reliable_per_frame_ack", "seq+checksum envelope, one ack/frame",
+         lambda: make_world(2, reliable=True, reliable_opts={
+             "ack_timeout": 5.0, "max_backoff": 10.0,
+             "batched_acks": False})),
+        ("reliable_batched_ack", "adaptive wire: cumulative acks + credit",
+         lambda: make_world(2, reliable=True, reliable_opts={
+             "ack_timeout": 5.0, "max_backoff": 10.0})),
+        ("wal_deferred_ack", "acks withheld to a group boundary "
+         f"(n={group_n}), cumulative release",
+         lambda: make_world(2, reliable=True, reliable_opts={
+             "ack_timeout": 5.0, "max_backoff": 10.0,
+             "ack_on_delivery": False})),
+        ("chaos_wrapped", "reliable+batched under FaultyTransport with an "
+         "empty plan (pure wrapper cost)",
+         lambda: make_world(2, reliable=True, plan=ChaosPlan(),
+                            reliable_opts={"ack_timeout": 5.0,
+                                           "max_backoff": 10.0})),
+    ]
+    base = None
+    for name, desc, make in ladder:
+        try:
+            rate = echo_run(make)
+        except Exception as e:  # noqa: BLE001 — one rung must not kill the rest
+            log(f"transport microbench ({name}) failed: {e}")
+            continue
+        if base is None:
+            base = rate
+        emit(7, f"wire_ladder_{name}", rate, "roundtrips/sec",
+             "1 process, in-process transport",
+             f"9.9 MB echo; {desc}; "
+             f"{100 * (1 - rate / base):.1f}% below the raw rung")
 
 
 def cpu_mesh_phase() -> None:
@@ -1512,7 +1662,36 @@ def multiprocess_psum_phase(n: int = 4, rounds: int = 20) -> None:
          "2-device row")
 
 
-def main() -> None:
+#: phases addressable via ``--only`` (``make bench-wire`` runs the wire
+#: legs without paying for the full table)
+PHASES = {
+    "tpu": lambda: tpu_phase(),
+    "ps": lambda: ps_phase(),
+    "sharded_ps": lambda: sharded_ps_phase(),
+    "elastic": lambda: elastic_phase(),
+    "recovery": lambda: recovery_phase(),
+    "ps_tpu": lambda: ps_tpu_phase(),
+    "transport": lambda: transport_phase(),
+    "reliability": lambda: reliability_phase(),
+    "transport_microbench": lambda: transport_microbench_phase(),
+    "cpu_mesh": lambda: cpu_mesh_phase(),
+    "multiprocess_psum": lambda: multiprocess_psum_phase(),
+}
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--only", action="append", choices=sorted(PHASES),
+        help="run only the named phase(s), in the given order (repeatable)")
+    args = ap.parse_args(argv)
+    if args.only:
+        for name in args.only:
+            PHASES[name]()
+        log(f"bench_all: {len(RESULTS)} measurements")
+        return
     tpu_phase()
     ps_phase()
     sharded_ps_phase()
@@ -1521,6 +1700,7 @@ def main() -> None:
     ps_tpu_phase()
     transport_phase()
     reliability_phase()
+    transport_microbench_phase()
     cpu_mesh_phase()
     # LAST: the 4 gloo subprocesses leave the 1-core host briefly saturated
     # as they tear down — running this before cpu_mesh_phase measured the

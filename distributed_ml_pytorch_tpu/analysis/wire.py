@@ -35,6 +35,13 @@ cross-checks them:
   fsync of data or rename), silently weaker than the module's own
   contract. Same opt-in style as DC105; the module that *defines*
   ``atomic_write`` is the raw path itself and is exempt.
+- **DC108** — a module that opted into the shared jittered-backoff policy
+  (it references ``utils.backoff.Backoff`` / ``jittered_backoff``) still
+  hard-codes a literal retry sleep — ``time.sleep(<constant>)`` inside a
+  loop: flat retry constants are how timed-out senders re-synchronize into
+  retry storms, exactly what the policy exists to prevent (ISSUE 7). Same
+  opt-in style as DC105/DC107; the module that *defines* ``Backoff`` is
+  the policy's own plumbing and is exempt.
 
 Send-site payload arity is resolved structurally: literal
 ``np.asarray([...])`` heads (``*_split16(x)`` counts as 2 — the documented
@@ -558,6 +565,7 @@ def check(pkg: Package) -> List[Finding]:
 
     findings.extend(_check_reliability_bypass(pkg))
     findings.extend(_check_durability_bypass(pkg))
+    findings.extend(_check_backoff_bypass(pkg))
     return findings
 
 
@@ -745,4 +753,77 @@ def _check_durability_bypass(pkg: Package) -> List[Finding]:
                     f"{fn.name}() bypasses utils.atomic_write() — atomic "
                     "but not power-loss durable (no fsync of data or "
                     "rename)"))
+    return findings
+
+
+# --------------------------------------------------------------- DC108
+
+_BACKOFF_HELPERS = ("Backoff", "jittered_backoff")
+
+
+def _backoff_aliases(src: SourceFile) -> Set[str]:
+    """Local names bound to the shared backoff policy — import aliases plus
+    bare-name CODE references (AST only: prose mentions cannot opt a module
+    in; DC105/DC107 precedent)."""
+    names: Set[str] = set()
+    referenced: Set[str] = set()
+    for node in walk_list(src.tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name in _BACKOFF_HELPERS:
+                    names.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Name) and node.id in _BACKOFF_HELPERS:
+            referenced.add(node.id)
+        elif isinstance(node, ast.Attribute) and \
+                node.attr in _BACKOFF_HELPERS:
+            referenced.add(node.attr)
+    return names | referenced
+
+
+def _defines_backoff_helper(src: SourceFile) -> bool:
+    return any(
+        isinstance(node, (ast.ClassDef, ast.FunctionDef,
+                          ast.AsyncFunctionDef))
+        and node.name in _BACKOFF_HELPERS
+        for node in walk_list(src.tree))
+
+
+def _is_literal_time_sleep(node: ast.Call) -> bool:
+    """``time.sleep(<numeric constant>)`` or bare ``sleep(<constant>)``."""
+    f = node.func
+    named = (isinstance(f, ast.Attribute) and f.attr == "sleep"
+             and isinstance(f.value, ast.Name) and f.value.id == "time")
+    bare = isinstance(f, ast.Name) and f.id == "sleep"
+    if not (named or bare):
+        return False
+    if len(node.args) != 1:
+        return False
+    arg = node.args[0]
+    return isinstance(arg, ast.Constant) and isinstance(
+        arg.value, (int, float))
+
+
+def _check_backoff_bypass(pkg: Package) -> List[Finding]:
+    """DC108: a hard-coded literal retry sleep inside a loop, in a module
+    that otherwise adopted the shared jittered-backoff policy — a flat
+    constant re-synchronizes every peer that timed out together (the retry
+    storm the policy exists to break up)."""
+    findings: List[Finding] = []
+    for src in pkg:
+        if _defines_backoff_helper(src):
+            continue  # the policy's own plumbing IS the raw path
+        if not _backoff_aliases(src):
+            continue  # not opted in to the backoff discipline
+        loops = [n for n in walk_list(src.tree)
+                 if isinstance(n, (ast.While, ast.For, ast.AsyncFor))]
+        for loop in loops:
+            for node in walk_list(loop):
+                if isinstance(node, ast.Call) and \
+                        _is_literal_time_sleep(node):
+                    findings.append(Finding(
+                        src.path, node.lineno, "DC108",
+                        "hard-coded retry sleep "
+                        "inside a loop in a module that adopted the shared "
+                        "backoff policy — use Backoff.sleep()/attempts() "
+                        "(jittered, capped) instead of a flat constant"))
     return findings

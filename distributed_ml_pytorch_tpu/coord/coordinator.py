@@ -75,10 +75,13 @@ def encode_leave(incarnation: int) -> np.ndarray:
 
 
 def encode_renew(incarnation: int, push_count: int = 0, step: int = 0,
-                 ewma_ms: float = 0.0) -> np.ndarray:
+                 ewma_ms: float = 0.0, wire_open: int = 0) -> np.ndarray:
+    """``wire_open`` (ISSUE 7) counts the member's open circuit breakers —
+    peers whose sends are timing out — so the lease view carries wire
+    health, not just liveness."""
     return np.asarray(
         [*_split16(incarnation), float(push_count), float(step),
-         float(ewma_ms)], np.float32)
+         float(ewma_ms), float(wire_open)], np.float32)
 
 
 def encode_snapshot_request(snapshot_id: int, map_version: int) -> np.ndarray:
@@ -131,6 +134,11 @@ class MemberInfo:
     push_count: int = 0
     step: int = 0
     ewma_ms: float = 0.0
+    #: how many circuit breakers this member reports open on its own wire
+    #: (ISSUE 7): a member that is ALIVE but cannot reach its peers is a
+    #: different failure mode than a silent one, and the health view must
+    #: distinguish them (a degraded link wants routing around, not eviction)
+    wire_open: int = 0
     #: at least one LeaseRenew carried this member's metrics — a fully
     #: idle engine (0% occupancy, 0 TTFT) still counts as reporting, so
     #: scale-down advice can fire on a genuinely idle fleet
@@ -246,10 +254,15 @@ class Coordinator:
             "members": {
                 m.rank: {"kind": m.kind_name, "incarnation": m.incarnation,
                          "step": m.step, "push_count": m.push_count,
-                         "ewma_ms": m.ewma_ms}
+                         "ewma_ms": m.ewma_ms, "wire_open": m.wire_open}
                 for m in self._live()
             },
         }
+
+    def wire_health(self) -> Dict[int, int]:
+        """Per-member open-breaker counts from the lease view (rank ->
+        wire_open) — the coordinator-side read of ISSUE 7's circuit state."""
+        return {m.rank: m.wire_open for m in self._live()}
 
     # distcheck: ignore[DC205] membership decisions are single-threaded by
     # design (handle/tick run on the serve thread only — module docstring);
@@ -359,8 +372,13 @@ class Coordinator:
                 apply_seq=_join16(payload[8], payload[9]),
                 push_count=_join16(payload[10], payload[11]))
             return
+        # distcheck: ignore[DC104] deliberate wire tolerance (WIRE_SCHEMAS
+        # doc): the 5-field pre-ISSUE-7 renew stays a FULL renew —
+        # wire_open is optional, and an absent field leaves the last
+        # report standing ("didn't say" is not "healthy")
         if code == MessageCode.LeaseRenew and payload.size >= 5:
-            if not np.isfinite(payload[:5]).all():
+            n = 6 if payload.size >= 6 else 5
+            if not np.isfinite(payload[:n]).all():
                 return
             inc = _join16(payload[0], payload[1])
             if inc < member.incarnation:
@@ -371,6 +389,22 @@ class Coordinator:
             member.step = int(payload[3])
             member.ewma_ms = float(payload[4])
             member.reported = True
+            if n == 6:
+                # wire-health field (ISSUE 7): log degraded<->healthy
+                # transitions so link trouble is a first-class decision-log
+                # event, like up/down membership
+                wire_open = int(payload[5])
+                if wire_open != member.wire_open:
+                    if wire_open > 0:
+                        self._log(
+                            f"{member.kind_name} {sender} reports "
+                            f"{wire_open} open circuit(s) on its wire "
+                            "(degraded links)")
+                    elif member.wire_open > 0:
+                        self._log(
+                            f"{member.kind_name} {sender} wire healthy "
+                            "again (all circuits closed)")
+                member.wire_open = wire_open
             return
         # any other frame from a known member is evidence of life
         member.last_seen = now

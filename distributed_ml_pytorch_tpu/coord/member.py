@@ -131,7 +131,9 @@ class CoordClient:
         self._latest_map: Optional[ShardMap] = None
         self._current_version = -1
         self._got_map = threading.Event()
-        self._progress = (0, 0, 0.0)  # (push_count, step, ewma_ms)
+        #: (push_count, step, ewma_ms, wire_open) — wire_open is the
+        #: member's open-circuit-breaker count (ISSUE 7 wire health)
+        self._progress = (0, 0, 0.0, 0)
         self._stop = threading.Event()
         self._listener = threading.Thread(
             target=self._pump, name="coord-listener", daemon=True)
@@ -189,9 +191,9 @@ class CoordClient:
         tick = 0
         while not self._stop.wait(self.renew_interval):
             with self._lock:
-                push_count, step, ewma_ms = self._progress
+                push_count, step, ewma_ms, wire_open = self._progress
             self._send(MessageCode.LeaseRenew, encode_renew(
-                self.incarnation, push_count, step, ewma_ms))
+                self.incarnation, push_count, step, ewma_ms, wire_open))
             tick += 1
             if tick % 4 == 0:
                 # periodic re-JOIN: the coordinator ignores frames from
@@ -215,12 +217,16 @@ class CoordClient:
                 return self.current_map()
         return self.current_map()
 
-    def report(self, push_count: int, step: int, ewma_ms: float) -> None:
+    def report(self, push_count: int, step: int, ewma_ms: float,
+               wire_open: int = 0) -> None:
         """Stash this member's latest progress; the renew thread ships it
         (written under the client lock so the renew thread never reads a
-        torn tuple — distcheck DC205)."""
+        torn tuple — distcheck DC205). ``wire_open`` is the member's open
+        circuit-breaker count (``ReliableTransport.open_breakers()``): the
+        coordinator's lease view then shows WHOSE wire is degraded."""
         with self._lock:
-            self._progress = (int(push_count), int(step), float(ewma_ms))
+            self._progress = (int(push_count), int(step), float(ewma_ms),
+                              int(wire_open))
 
     def current_map(self) -> Optional[ShardMap]:
         with self._lock:

@@ -764,12 +764,23 @@ class PushFlusher:
     #: than compute cannot pin unboundedly many device-resident snapshots
     #: (each is ~the model size) nor grow push staleness without limit; the
     #: training thread then waits at the cadence boundary exactly as the
-    #: pre-overlap code always did, just two pushes later.
+    #: pre-overlap code always did, just two pushes later. With the adaptive
+    #: wire (ISSUE 7) the chain extends one level down: a send blocked at
+    #: the reliability layer's credit window holds THIS thread, this queue
+    #: fills, and the cadence boundary stalls — receiver pressure reaches
+    #: the training loop with no unbounded buffer anywhere in between.
+    #: :attr:`wire_blocked_s` totals the time sends spent wire-blocked (the
+    #: observable for "how much is the network the bottleneck").
     MAX_IN_FLIGHT = 2
+
+    #: sends slower than this are attributed to wire backpressure in
+    #: :attr:`wire_blocked_s` (fetch+serialize is well under it on any rig)
+    _BLOCK_ATTRIB_S = 0.05
 
     def __init__(self, send_fn):
         self._send_fn = send_fn  # called with the fetched np.ndarray
         self._q: "queue.Queue" = queue.Queue(maxsize=self.MAX_IN_FLIGHT)
+        self.wire_blocked_s = 0.0
         self._thread = threading.Thread(
             target=self._run, name="downpour-push-flusher", daemon=True)
         self._thread.start()
@@ -782,7 +793,12 @@ class PushFlusher:
                     return
                 # np.asarray blocks THIS thread for device completion + the
                 # device→host transfer; the training thread keeps going
-                self._send_fn(np.asarray(item))
+                arr = np.asarray(item)
+                t0 = time.monotonic()
+                self._send_fn(arr)
+                dt = time.monotonic() - t0
+                if dt > self._BLOCK_ATTRIB_S:
+                    self.wire_blocked_s += dt
             except Exception as e:  # noqa: BLE001 — the thread must survive
                 # degrade-never-crash, matching _send: a failed fetch/send
                 # loses THIS push (accepted async staleness) instead of

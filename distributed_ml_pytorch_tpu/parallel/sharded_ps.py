@@ -431,7 +431,16 @@ class ShardedAsynchronous:
                 self._ewma_ms = (dt_ms if self._ewma_ms == 0.0
                                  else 0.7 * self._ewma_ms + 0.3 * dt_ms)
             self._last_step_t = now
-            self.coord.report(self.idx // self.n_push, self.idx, self._ewma_ms)
+            # wire health rides the lease renewal (ISSUE 7): how many of
+            # this worker's shard links have an open circuit breaker — the
+            # coordinator then sees "alive but cut off" as its own state
+            wire_open = 0
+            for t in self.transports:
+                counter = getattr(t, "open_breakers", None)
+                if counter is not None:
+                    wire_open += counter()
+            self.coord.report(self.idx // self.n_push, self.idx,
+                              self._ewma_ms, wire_open=wire_open)
         self._maybe_cutover(params)
         params = self._install_arrived(params)
         if self.idx % self.n_pull == 0:

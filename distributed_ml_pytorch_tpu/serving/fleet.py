@@ -279,7 +279,9 @@ class FleetRouter(ServingFrontend):
             busy, slots, queued = busy + b, slots + s, queued + q
         if slots == 0:
             return 1.0  # no healthy engine: maximally loaded
-        return (busy + queued) / slots
+        # wire backpressure folds in exactly as on the base frontend: a
+        # saturated client-facing transport counts against fleet capacity
+        return max((busy + queued) / slots, self._wire_pressure())
 
     def _ttft_now_ms(self) -> float:
         samples = [m.engine.recent_ttft_ms() for m in self._healthy_members()]

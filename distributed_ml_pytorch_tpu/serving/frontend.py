@@ -460,13 +460,21 @@ class ServingFrontend:
         self.engine.cancel(key)
 
     # -------------------------------------------------------- overload plane
+    def _wire_pressure(self) -> float:
+        """Transport backpressure, 0..1 (ISSUE 7): a reliable transport
+        whose send windows are saturating reports pressure even while the
+        engine itself looks idle — the wire IS part of serving capacity,
+        and brownout/shed must see a degraded link before queues explode."""
+        gauge = getattr(self.transport, "pressure", None)
+        return float(gauge()) if gauge is not None else 0.0
+
     def _pressure(self) -> float:
-        """(busy slots + queued) / total slots — the fleet router overrides
-        this with the healthy-member aggregate."""
+        """max(engine, wire) pressure — the fleet router overrides the
+        engine half with the healthy-member aggregate."""
         if self.engine is None:
-            return 0.0
+            return self._wire_pressure()
         busy, slots, queued = self.engine.pressure()
-        return (busy + queued) / max(1, slots)
+        return max((busy + queued) / max(1, slots), self._wire_pressure())
 
     def _ttft_now_ms(self) -> float:
         return self.engine.recent_ttft_ms() if self.engine is not None else 0.0

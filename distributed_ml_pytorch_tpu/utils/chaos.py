@@ -82,18 +82,78 @@ class FaultRule:
 
 
 @dataclasses.dataclass(frozen=True)
+class WeatherRule:
+    """Network weather on one direction of a link (ISSUE 7): latency with
+    jitter, and a bandwidth cap that serializes frames through the link.
+
+    Matching is directional (``src -> dst``), so a ONE-WAY degraded link —
+    the asymmetry that RTT estimators and circuit breakers must survive —
+    is just a rule on one direction. ``None`` fields are wildcards;
+    ``after``/``until`` window the rule to the channel's send indices, like
+    :class:`FaultRule`. Weather composes with fault rules: loss/dup/corrupt
+    come from the fault mix, latency/bandwidth from here.
+
+    Determinism: each frame's latency is ``latency + jitter * u`` with
+    ``u ~ U(-1, 1)`` drawn from a per-channel seeded stream SEPARATE from
+    the fault stream (``SeedSequence([seed, src, dst, code, _WEATHER_NS])``)
+    so adding weather never perturbs an existing plan's fault decisions.
+    The drawn delay is recorded in the :class:`ChaosLog` quantized to
+    milliseconds — byte-identical logs prove the DRAWS replay, not just
+    the match counts. Bandwidth queueing delay depends on wall-clock
+    arrival times and is deliberately NOT logged.
+    """
+
+    src: Optional[int] = None
+    dst: Optional[int] = None
+    code: Optional[int] = None          # MessageCode value, or None = any
+    latency: float = 0.0                # base one-way delay, seconds
+    jitter: float = 0.0                 # +/- uniform jitter, seconds
+    bandwidth: float = 0.0              # bytes/second cap; 0 = unlimited
+    after: int = 0
+    until: Optional[int] = None
+
+    def matches(self, src: int, dst: int, code: int, index: int) -> bool:
+        if self.src is not None and src != self.src:
+            return False
+        if self.dst is not None and dst != self.dst:
+            return False
+        if self.code is not None and code != int(self.code):
+            return False
+        if index < self.after:
+            return False
+        if self.until is not None and index >= self.until:
+            return False
+        return True
+
+
+#: namespace tag separating the weather RNG stream from the fault stream
+_WEATHER_NS = 0x57454154  # "WEAT"
+
+
+@dataclasses.dataclass(frozen=True)
 class ChaosPlan:
-    """An ordered fault script plus the seed every channel RNG derives from."""
+    """An ordered fault script plus the seed every channel RNG derives
+    from; ``weather`` adds link-level latency/jitter/bandwidth rules."""
 
     rules: Tuple[FaultRule, ...] = ()
     seed: int = 0
+    weather: Tuple[WeatherRule, ...] = ()
 
-    def __init__(self, rules: Sequence[FaultRule] = (), seed: int = 0):
+    def __init__(self, rules: Sequence[FaultRule] = (), seed: int = 0,
+                 weather: Sequence[WeatherRule] = ()):
         object.__setattr__(self, "rules", tuple(rules))
         object.__setattr__(self, "seed", int(seed))
+        object.__setattr__(self, "weather", tuple(weather))
 
     def rule_for(self, src: int, dst: int, code: int, index: int) -> Optional[FaultRule]:
         for rule in self.rules:
+            if rule.matches(src, dst, code, index):
+                return rule
+        return None
+
+    def weather_for(self, src: int, dst: int, code: int,
+                    index: int) -> Optional[WeatherRule]:
+        for rule in self.weather:
             if rule.matches(src, dst, code, index):
                 return rule
         return None
@@ -151,13 +211,19 @@ class _WorldState:
 
 
 class _Channel:
-    __slots__ = ("index", "rng", "held")
+    __slots__ = ("index", "rng", "weather_rng", "held")
 
     def __init__(self, seed: int, src: int, dst: int, code: int):
         self.index = 0
         self.rng = np.random.default_rng(
             np.random.SeedSequence([seed & 0xFFFFFFFF, src, dst, code]))
-        self.held: Optional[np.ndarray] = None  # reorder buffer (code is fixed)
+        #: separate stream for weather draws: adding weather to a plan must
+        #: never perturb the fault decisions an existing seed produces
+        self.weather_rng = np.random.default_rng(
+            np.random.SeedSequence(
+                [seed & 0xFFFFFFFF, src, dst, code, _WEATHER_NS]))
+        #: reorder buffer: (payload, weather_u, fault_index) of the held frame
+        self.held: Optional[tuple] = None
 
 
 class FaultyTransport(Transport):
@@ -183,6 +249,7 @@ class FaultyTransport(Transport):
         self._channels: Dict[Tuple[int, int, int], _Channel] = {}
         self._lock = threading.Lock()
         self._partitioned: set = set()  # dsts this endpoint cannot reach
+        self._link_busy: Dict[int, float] = {}  # bandwidth-cap serialization
         self._delayed: list = []        # heap of (deliver_at, tiebreak, code, frame, dst)
         self._delay_seq = 0
         self._delay_wake = threading.Event()
@@ -284,14 +351,18 @@ class FaultyTransport(Transport):
             i = chan.index
             chan.index += 1
             # fixed draw schedule: every send consumes the same number of
-            # uniforms, so decision i is independent of earlier outcomes
+            # uniforms, so decision i is independent of earlier outcomes;
+            # the weather draw rides the same critical section so frame i's
+            # latency is paired with frame i regardless of thread timing
             u = chan.rng.uniform(size=5)
+            wu = (float(chan.weather_rng.uniform(-1.0, 1.0))
+                  if self.plan.weather else 0.0)
         if dst in self._partitioned:
             self.log.record(self.rank, dst, int(code), i, "partition-drop")
             return
         rule = self.plan.rule_for(self.rank, dst, int(code), i)
         if rule is None:
-            self._forward(code, payload, dst, chan)
+            self._forward(code, payload, dst, chan, wu, i)
             return
         if u[0] < rule.drop:
             self.log.record(self.rank, dst, int(code), i, "drop")
@@ -300,6 +371,8 @@ class FaultyTransport(Transport):
             self.log.record(self.rank, dst, int(code), i, "corrupt")
             payload = self._corrupted(payload, chan)
         if u[4] < rule.delay_p and rule.delay > 0:
+            # an explicit fault delay supersedes weather for this frame
+            # (its delay is already scripted and logged)
             self.log.record(self.rank, dst, int(code), i, "delay")
             self._schedule_delayed(code, payload, dst, rule.delay)
             return
@@ -308,22 +381,52 @@ class FaultyTransport(Transport):
             # send (an adjacent swap — the minimal, deterministic reorder)
             self.log.record(self.rank, dst, int(code), i, "reorder-hold")
             with self._lock:
-                prev, chan.held = chan.held, np.array(
-                    payload, dtype=np.float32, copy=True).ravel()
+                prev, chan.held = chan.held, (np.array(
+                    payload, dtype=np.float32, copy=True).ravel(), wu, i)
             if prev is not None:
-                self.inner.send(code, prev, dst=dst)
+                self._transmit(code, prev[0], dst, prev[1], prev[2])
             return
-        self._forward(code, payload, dst, chan)
+        self._forward(code, payload, dst, chan, wu, i)
         if u[1] < rule.dup:
             self.log.record(self.rank, dst, int(code), i, "dup")
-            self.inner.send(code, payload, dst=dst)
+            # the duplicate shares frame i's weather draw (one latency per
+            # decision keeps the log a pure function of the seed)
+            self._transmit(code, payload, dst, wu, i, log_weather=False)
 
-    def _forward(self, code: MessageCode, payload, dst: int, chan: _Channel) -> None:
-        self.inner.send(code, payload, dst=dst)
+    def _forward(self, code: MessageCode, payload, dst: int, chan: _Channel,
+                 wu: float, i: int) -> None:
+        self._transmit(code, payload, dst, wu, i)
         with self._lock:
             held, chan.held = chan.held, None
         if held is not None:
-            self.inner.send(code, held, dst=dst)
+            self._transmit(code, held[0], dst, held[1], held[2])
+
+    def _transmit(self, code: MessageCode, payload, dst: int, wu: float,
+                  i: int, log_weather: bool = True) -> None:
+        """The physical link: apply any matching weather rule (latency +
+        jitter + bandwidth serialization), then hand the frame to the inner
+        transport — directly, or through the delay scheduler."""
+        w = self.plan.weather_for(self.rank, dst, int(code), i)
+        if w is None:
+            self.inner.send(code, payload, dst=dst)
+            return
+        lat = max(0.0, w.latency + w.jitter * wu)
+        if log_weather and (w.latency or w.jitter):
+            self.log.record(self.rank, dst, int(code), i,
+                            f"weather+{int(round(lat * 1000))}ms")
+        delay = lat
+        if w.bandwidth > 0:
+            arr = np.asarray(payload, np.float32)
+            xmit = arr.nbytes / float(w.bandwidth)
+            with self._lock:
+                now = time.monotonic()
+                start = max(now, self._link_busy.get(dst, 0.0))
+                self._link_busy[dst] = start + xmit
+                delay = (start + xmit) - now + lat
+        if delay <= 0:
+            self.inner.send(code, payload, dst=dst)
+            return
+        self._schedule_delayed(code, payload, dst, delay)
 
     # --------------------------------------------------------------- delay
     def _schedule_delayed(self, code, payload, dst: int, delay: float) -> None:
@@ -381,8 +484,10 @@ class FaultyTransport(Transport):
                     if chan.held is not None]
             for (_src, _dst, _code), _frame in held:
                 self._channels[(_src, _dst, _code)].held = None
-        for (_src, dst, code), frame in held:
+        for (_src, dst, code), (frame, _wu, _i) in held:
             try:
+                # straight to the inner transport: the delay scheduler is
+                # shutting down, so weather would strand the frame
                 self.inner.send(MessageCode(code), frame, dst=dst)
             except (OSError, ConnectionError, KeyError):
                 pass  # the peer is already gone; nothing left to reorder to

@@ -109,11 +109,19 @@ class HeartbeatSender(threading.Thread):
     def run(self) -> None:
         import numpy as np
 
+        from distributed_ml_pytorch_tpu.utils.messaging import SERVER_RANK
+
         empty = np.zeros(0, np.float32)
+        breaker = getattr(self.transport, "breaker_open", None)
         while not self._stop.wait(self.interval):
             try:
                 self.transport.send(self._code, empty)
-                self.peer_down = False
+                # heartbeats skip the reliability envelope, so a socket that
+                # accepts writes is not proof of life — the circuit breaker
+                # (fed by unacked DATA frames, ISSUE 7) sees a one-way or
+                # silently-dead peer the plain send cannot
+                self.peer_down = (breaker is not None
+                                  and breaker(SERVER_RANK))
             except (OSError, ConnectionError, KeyError):
                 self.peer_down = True
 

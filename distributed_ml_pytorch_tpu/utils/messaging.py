@@ -24,13 +24,25 @@ Wire format (TCP): little-endian header ``(sender:i32, code:i32, nbytes:i64)``
 followed by a float32 payload — the flat raveled model vector, fixed size per
 model, exactly the implied reference format (SURVEY.md §2.3 M2).
 
-Reliability (codes 9-10): :class:`ReliableTransport` wraps any transport with
-per-peer sequence numbers, a frame CRC, ack + capped-exponential-backoff
-retry, and receiver-side dedup — at-least-once delivery on the wire,
-exactly-once application at the receiver. The envelope rides the existing
-float32 wire (every header field < 2^16, exact in float32), so Python, TCP
-and native C++ endpoints all carry it; plain frames from a peer that did not
-negotiate reliability pass through untouched.
+Reliability (codes 9-10, 26): :class:`ReliableTransport` wraps any transport
+with per-peer sequence numbers, a frame checksum, ack + retransmission, and
+receiver-side dedup — at-least-once delivery on the wire, exactly-once
+application at the receiver. The envelope rides the existing float32 wire
+(every header field < 2^16, exact in float32), so Python, TCP and native C++
+endpoints all carry it; plain frames from a peer that did not negotiate
+reliability pass through untouched.
+
+Adaptive wire (ISSUE 7): the retransmission timer is per-peer RTT-estimated
+(Jacobson/Karels SRTT/RTTVAR -> RTO with Karn's rule, jittered capped
+backoff from ``utils/backoff.py``) instead of a fixed ``ack_timeout``;
+senders run a sliding window bounded by receiver-advertised credit (a slow
+peer exerts *backpressure* — sends block at the window instead of growing
+pending without bound); receivers batch in-order deliveries into cumulative
+``CumAck`` frames (piggybacking their credit) so the steady-state ack cost
+is one small frame per batch, pipelined with the WAL group-fsync on durable
+servers; and every peer carries a circuit breaker (closed -> open on
+consecutive RTO blowups -> half-open probe) whose state feeds the
+coordinator's lease health view and the HeartbeatSender.
 """
 
 from __future__ import annotations
@@ -106,6 +118,8 @@ class MessageCode(enum.IntEnum):
     SubmitRequestV2 = 23
     ShardPush = 24
     ShardParams = 25
+    # --- adaptive wire (ISSUE 7): batched cumulative ack + credit ---
+    CumAck = 26
 
 
 @dataclasses.dataclass(frozen=True)
@@ -189,9 +203,12 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
         fields=("inc_lo", "inc_hi"), handled_by=("coord",),
         doc="explicit leave; stale incarnations cannot evict newer lives"),
     MessageCode.LeaseRenew: PayloadSchema(
-        fields=("inc_lo", "inc_hi", "push_count", "step", "ewma_ms"),
+        fields=("inc_lo", "inc_hi", "push_count", "step", "ewma_ms",
+                "wire_open"),
         handled_by=("coord",),
-        doc="lease refresh carrying the straggler-detector progress report"),
+        doc="lease refresh carrying the straggler-detector progress report "
+            "plus the member's open-circuit-breaker count (wire health; "
+            "receivers tolerate the 5-field pre-ISSUE-7 form)"),
     MessageCode.ShardMapUpdate: PayloadSchema(
         fields=("n_entries", "version_lo", "version_hi", "n_params_lo",
                 "n_params_hi"),
@@ -251,6 +268,14 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
         doc="elastic shard server -> worker: pull reply stamped like "
             "ShardPush (the versioned ParameterUpdate); the worker applies "
             "only a reply whose range matches its current expectation"),
+    MessageCode.CumAck: PayloadSchema(
+        fields=("inc_lo", "inc_hi", "cum_lo", "cum_hi", "credit"),
+        handled_by=("transport",),
+        doc="batched cumulative ack: every seq <= cum of the echoed "
+            "incarnation is acknowledged at once, and the receiver "
+            "piggybacks its advertised send-window credit (the "
+            "backpressure signal) — one small frame per delivery batch "
+            "instead of one ReliableAck per frame"),
 }
 
 
@@ -258,12 +283,32 @@ Message = Tuple[int, MessageCode, np.ndarray]
 
 
 class Transport:
-    """Point-to-point tagged-tensor channel for one rank."""
+    """Point-to-point tagged-tensor channel for one rank.
+
+    This is THE wire abstraction every stack in the repo rides — the
+    in-process queue world, the Python TCP star, and the native C++ fast
+    path all implement it, and the reliability/chaos/durability layers wrap
+    any of them interchangeably (``make_transport`` / ``make_world`` are
+    the factories; ``bench_all.transport_microbench_phase`` prices each
+    layer of the stack).
+    """
 
     rank: int = 0
 
     def send(self, code: MessageCode, payload: np.ndarray, dst: int = SERVER_RANK) -> None:
         raise NotImplementedError
+
+    def sendv(self, code: MessageCode, parts, dst: int = SERVER_RANK) -> None:
+        """Scatter/gather send: one wire frame from several float32 parts.
+
+        The base implementation concatenates (one copy); transports that
+        can write parts sequentially (TCP ``sendall`` per part under the
+        peer's send lock) override it to make envelope framing zero-copy —
+        the reliability layer's 7-float header no longer costs a full
+        payload-sized ``np.concatenate`` per send.
+        """
+        self.send(code, np.concatenate(
+            [np.asarray(p, np.float32).ravel() for p in parts]), dst=dst)
 
     def recv(self, timeout: Optional[float] = None) -> Optional[Message]:
         """Blocking receive; returns ``None`` on timeout or closed transport."""
@@ -401,14 +446,21 @@ class TCPTransport(Transport):
         port: int = 29500,
         connect_timeout: float = 60.0,
         wait_for: Optional[int] = None,
+        handshake_timeout: float = 5.0,
     ):
         """``wait_for`` (server only) overrides how many worker connections
         the initial rendezvous blocks for — default ``world_size - 1``. An
         ELASTIC hub (the coordinator, ``coord/``) passes 0: it must serve
         the moment it is up, admitting members whenever they dial in;
-        ``world_size`` then only bounds the valid rank space."""
+        ``world_size`` then only bounds the valid rank space.
+
+        ``handshake_timeout`` bounds how long one inbound connection may
+        stall the hello handshake (ISSUE 7 satellite — previously a
+        hard-coded 5 s): a half-open or malicious connection is dropped
+        after this many seconds instead of wedging the accept loop."""
         self.rank = rank
         self.world_size = world_size
+        self.handshake_timeout = float(handshake_timeout)
         self._inbox: "queue.Queue[Message]" = queue.Queue()
         self._peers: Dict[int, socket.socket] = {}
         self._threads = []
@@ -453,16 +505,28 @@ class TCPTransport(Transport):
             # Retry refused dials until the server is listening — rendezvous
             # blocks until all ranks join, like the reference's
             # init_process_group (example/main.py:165), so worker processes
-            # may start before the server.
+            # may start before the server. The poll rides the shared
+            # jittered-backoff policy (seeded by rank+port, so N workers
+            # launched together desynchronize their dials) instead of a
+            # flat hard-coded sleep (ISSUE 7 satellite; distcheck DC108).
+            from distributed_ml_pytorch_tpu.utils.backoff import Backoff
+
             deadline = time.monotonic() + connect_timeout
-            while True:
+            policy = Backoff(0.05, 1.0, jitter=0.25,
+                             seed=(rank << 16) ^ int(port))
+            sock = None
+            err: Optional[OSError] = None
+            for _attempt in policy.attempts(deadline):
                 try:
-                    sock = socket.create_connection((master, int(port)), timeout=5)
+                    sock = socket.create_connection(
+                        (master, int(port)),
+                        timeout=min(self.handshake_timeout, connect_timeout))
                     break
-                except OSError:
-                    if time.monotonic() >= deadline:
-                        raise
-                    time.sleep(0.3)
+                except OSError as e:
+                    err = e
+            if sock is None:
+                raise err if err is not None else OSError(
+                    f"connect to {master}:{port} timed out")
             sock.settimeout(None)  # connect timeout only; reads must block indefinitely
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             _send_frame(sock, rank, int(MessageCode.ParameterRequest), np.zeros(0, np.float32))
@@ -477,8 +541,9 @@ class TCPTransport(Transport):
         (whose process died) is shut down — its reader exits — and replaced.
         """
         # bound the handshake: a half-open connection must not wedge the
-        # single-threaded accept loop (or the rendezvous) forever
-        conn.settimeout(5.0)
+        # single-threaded accept loop (or the rendezvous) forever; the
+        # deadline is configurable (handshake_timeout), not hard-coded
+        conn.settimeout(self.handshake_timeout)
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         hello = _recv_frame(conn)
         if hello is None or hello is _MALFORMED:
@@ -544,7 +609,16 @@ class TCPTransport(Transport):
         self._threads.append(t)
 
     def send(self, code: MessageCode, payload: np.ndarray, dst: int = SERVER_RANK) -> None:
-        arr = np.asarray(payload, dtype=np.float32).ravel()
+        self.sendv(code, (payload,), dst=dst)
+
+    def sendv(self, code: MessageCode, parts, dst: int = SERVER_RANK) -> None:
+        """Scatter/gather TCP send: header + each part written sequentially
+        under the peer's send lock — one wire frame, zero payload-sized
+        copies (the reliability envelope's header rides as its own tiny
+        part instead of forcing a full-vector ``np.concatenate``)."""
+        arrs = [np.ascontiguousarray(np.asarray(p, np.float32).ravel())
+                for p in parts]
+        nbytes = sum(a.nbytes for a in arrs)
         with self._send_lock_for(dst):
             # the socket lookup rides under BOTH locks: the per-peer lock
             # means no rejoin swap can land mid-send, _peers_mu means the
@@ -552,7 +626,15 @@ class TCPTransport(Transport):
             # is the documented contract, unchanged)
             with self._peers_mu:
                 sock = self._peers[dst]
-            _send_frame(sock, self.rank, int(code), arr)
+            if nbytes <= (1 << 16):
+                # small frame: one syscall/packet beats zero-copy
+                sock.sendall(b"".join(
+                    [_HEADER.pack(self.rank, int(code), nbytes)]
+                    + [a.tobytes() for a in arrs]))
+                return
+            sock.sendall(_HEADER.pack(self.rank, int(code), nbytes))
+            for a in arrs:
+                sock.sendall(memoryview(a).cast("B"))
 
     def recv(self, timeout: Optional[float] = None) -> Optional[Message]:
         # Poll in short slices so a blocking recv() still returns None once the
@@ -596,14 +678,69 @@ _INC_LOCK = threading.Lock()
 _LAST_INC = 0
 
 
-def _frame_crc(inc: int, seq: int, code: int, body_bytes: bytes) -> int:
-    """CRC over the WHOLE envelope (incarnation, seq, code, body): a wire
-    flip in any header field must fail the check, or e.g. a corrupted
+#: bodies at or above this many bytes switch from a full crc32 to the bulk
+#: digest (64-bit word sum + length, crc-mixed with the header) — see
+#: :func:`_frame_crc` for the integrity tradeoff. The choice is a pure
+#: function of the body LENGTH, so both ends always agree.
+_BULK_SUM_BYTES = 1 << 16
+
+
+def _frame_crc(inc: int, seq: int, code: int, body) -> int:
+    """Checksum over the WHOLE envelope (incarnation, seq, code, body): a
+    wire flip in any header field must fail the check, or e.g. a corrupted
     incarnation would be adopted as a 'newer life' and blackhole every
-    subsequent legitimate frame as stale."""
+    subsequent legitimate frame as stale.
+
+    ``body`` is any buffer — bytes, memoryview, or a contiguous float32
+    array — and is NEVER copied (ISSUE 7: the old ``tobytes()`` cost ~9 ms
+    per end per direction on the 9.9 MB PS frames).
+
+    Small frames (control plane, token streams) get a full crc32. Bulk
+    frames use a 64-bit little-endian word sum + exact length, crc-mixed
+    with the header — it runs at memory bandwidth (~6 GB/s vs ~1 GB/s for
+    zlib's crc32, measured), which is what recovers the ack-tax the
+    reliability layer used to charge on gradient-sized payloads. Integrity
+    tradeoff, stated honestly: the sum catches EVERY corruption that
+    changes any single 32-bit word (all single-burst flips, and exactly
+    what the chaos layer injects) and all length changes, but unlike a CRC
+    it can be fooled by multiple compensating word errors; beneath this
+    layer TCP's own checksum already screens the wire, so the residual
+    risk is compensating application-level corruption — accepted for a
+    ~4x cheaper hot path."""
     head = struct.pack("<III", inc & 0xFFFFFFFF, seq & 0xFFFFFFFF,
                        code & 0xFFFFFFFF)
-    return zlib.crc32(body_bytes, zlib.crc32(head)) & 0xFFFFFFFF
+    h = zlib.crc32(head)
+    if isinstance(body, np.ndarray):
+        mv = memoryview(np.ascontiguousarray(body)).cast("B")
+    elif isinstance(body, memoryview):
+        mv = body.cast("B")
+    else:
+        mv = memoryview(body)
+    nbytes = mv.nbytes
+    if nbytes >= _BULK_SUM_BYTES:
+        # uint64 word sum at memory bandwidth (~0.5 ms / 9.9 MB measured,
+        # vs ~10 ms for crc32); any sub-8-byte tail rides the crc
+        n8 = nbytes // 8 * 8
+        words = np.frombuffer(mv[:n8], np.uint64)
+        digest = struct.pack(
+            "<QI", int(words.sum(dtype=np.uint64)), nbytes)
+        h = zlib.crc32(digest, h)
+        if n8 != nbytes:
+            h = zlib.crc32(mv[n8:], h)
+        return h & 0xFFFFFFFF
+    return zlib.crc32(mv, h) & 0xFFFFFFFF
+
+
+def _frame_crc_legacy(inc: int, seq: int, code: int, body) -> int:
+    """The pre-ISSUE-7 envelope checksum — whole-payload crc32 over a
+    ``tobytes()`` copy. Kept ONLY as the bench's honest BEFORE
+    (``ReliableTransport(legacy_envelope=True)``); nothing on a default
+    code path uses it."""
+    head = struct.pack("<III", inc & 0xFFFFFFFF, seq & 0xFFFFFFFF,
+                       code & 0xFFFFFFFF)
+    if isinstance(body, np.ndarray):
+        body = body.tobytes()
+    return zlib.crc32(body, zlib.crc32(head)) & 0xFFFFFFFF
 
 
 def _next_incarnation() -> int:
@@ -619,15 +756,59 @@ def _next_incarnation() -> int:
 
 
 class _Pending:
-    __slots__ = ("frame", "dst", "deadline", "attempt", "code")
+    __slots__ = ("parts", "dst", "deadline", "attempt", "code",
+                 "first_sent", "retransmitted")
 
-    def __init__(self, frame: np.ndarray, dst: int, deadline: float,
-                 code: int = -1):
-        self.frame = frame
+    def __init__(self, parts, dst: int, deadline: float, code: int = -1):
+        self.parts = parts  # (header, body) — re-sent via sendv, zero-copy
         self.dst = dst
         self.deadline = deadline
         self.attempt = 1
         self.code = code  # inner MessageCode (per-code ack accounting)
+        self.first_sent = 0.0
+        #: Karn's rule: an RTT sample is only taken from a frame that was
+        #: never retransmitted (an ack for a retransmitted frame is
+        #: ambiguous about WHICH transmission it answers)
+        self.retransmitted = False
+
+
+class _PeerState:
+    """Per-peer sender-side state: the RTT estimator, the sliding-window
+    accounting, and the circuit breaker."""
+
+    __slots__ = ("srtt", "rttvar", "rto", "inflight", "credit",
+                 "consec_timeouts", "breaker", "dead", "probe_key",
+                 "probe_at", "opens", "last_ack")
+
+    def __init__(self, rto: float):
+        self.srtt: Optional[float] = None
+        self.rttvar = 0.0
+        self.rto = rto
+        self.last_ack = 0.0        # monotonic stamp of the last ack heard
+        self.inflight = 0          # pending (unacked) frames toward the peer
+        self.credit: Optional[int] = None  # receiver-advertised window
+        self.consec_timeouts = 0   # RTO blowups since the last ack
+        self.breaker = "closed"    # "closed" | "open" (probe_key => half-open)
+        self.dead = False          # terminal give-up (revived only by contact)
+        self.probe_key = None      # pending key currently serving as probe
+        self.probe_at = 0.0        # when the open breaker may half-open
+        self.opens = 0             # consecutive opens (cooldown exponent)
+
+
+class _RxState:
+    """Per-sender receiver-side state for cumulative acking."""
+
+    __slots__ = ("inc", "cum_hw", "eligible", "dirty", "last_flush")
+
+    def __init__(self, inc: int):
+        self.inc = inc
+        #: highest seq such that EVERY seq <= cum_hw has been delivered and
+        #: is ack-eligible (durably applied, for deferred-ack receivers)
+        self.cum_hw = -1
+        #: ack-eligible seqs above a gap, waiting for it to fill
+        self.eligible: set = set()
+        self.dirty = 0             # eligible deliveries since the last flush
+        self.last_flush = 0.0
 
 
 class ReliableTransport(Transport):
@@ -661,6 +842,34 @@ class ReliableTransport(Transport):
     peer pass straight through, and :attr:`unreliable_codes` (heartbeats
     and coord lease renewals by default — periodic and self-healing) skip
     the envelope entirely so a dead peer cannot trigger a retry storm.
+
+    Adaptive wire (ISSUE 7), per peer:
+
+    - **RTO** — Jacobson/Karels ``SRTT/RTTVAR`` from ack round-trips
+      (Karn's rule: never sample a retransmitted frame), clamped to
+      ``[ack_timeout, max_backoff]``; retransmit backoff is exponential
+      with seeded jitter. ``ack_timeout`` is thus the RTO *floor* and
+      initial value, not a fixed timer.
+    - **Sliding window** — at most ``min(send_window, advertised credit)``
+      unacked frames in flight; :meth:`send` BLOCKS at the window (the
+      backpressure surface: a slow receiver slows its senders instead of
+      growing their pending without bound — the flapping-peer OOM is
+      structurally impossible). A peer whose breaker opens while a sender
+      waits raises ``ConnectionError`` out of the blocked send.
+    - **Cumulative acks** — in-order deliveries are acked by one
+      ``CumAck(inc, cum, credit)`` per batch (``ack_batch_n`` frames or
+      one retry-tick, whichever first) instead of one ``ReliableAck`` per
+      frame; out-of-order frames still get immediate individual acks
+      (SACK-style), and deferred-ack receivers (``ack_on_delivery=False``)
+      advance the cumulative frontier only at :meth:`ack_delivered` — the
+      WAL group-fsync IS the ack batch boundary.
+    - **Circuit breaker** — ``breaker_fails`` consecutive RTO blowups open
+      the breaker: sends fail fast (``ConnectionError``), retransmits
+      pause, and after a growing cooldown ONE pending frame probes
+      (half-open). An ack closes the breaker; ``max_retries`` exhausted
+      attempts still declare the peer dead (terminal until it speaks).
+      Breaker state feeds the coordinator's lease view
+      (``open_breakers()``) and the HeartbeatSender (``breaker_open()``).
     """
 
     def __init__(
@@ -674,26 +883,63 @@ class ReliableTransport(Transport):
         unreliable_codes: Tuple[MessageCode, ...] = (
             MessageCode.Heartbeat, MessageCode.LeaseRenew),
         ack_on_delivery: bool = True,
+        send_window: int = 32,
+        recv_window: int = 64,
+        ack_batch_n: int = 8,
+        batched_acks: bool = True,
+        breaker_fails: int = 6,
+        breaker_cooldown: float = 0.5,
+        breaker_grace: Optional[float] = None,
+        jitter: float = 0.25,
+        legacy_envelope: bool = False,
     ):
+        """``legacy_envelope=True`` reproduces the pre-ISSUE-7 envelope
+        hot path — full-frame ``np.concatenate``, ``tobytes()`` copies and
+        a whole-payload crc32 — so the bench can price the adaptive wire
+        against its true BEFORE on the same rig (both ends of a link must
+        agree on the mode: the checksum algorithms differ)."""
+        import random
+
         self.inner = inner
         self.rank = inner.rank
-        self.ack_timeout = float(ack_timeout)
-        self.max_backoff = float(max_backoff)
+        self.ack_timeout = float(ack_timeout)   # RTO floor + initial RTO
+        self.max_backoff = float(max_backoff)   # RTO / backoff cap
         self.max_retries = int(max_retries)
         self.dedup_window = int(dedup_window)
+        self.send_window = int(send_window)
+        self.recv_window = int(recv_window)
+        self.ack_batch_n = int(ack_batch_n)
+        self.batched_acks = bool(batched_acks)
+        self.breaker_fails = int(breaker_fails)
+        self.breaker_cooldown = float(breaker_cooldown)
+        #: the breaker opens only when the peer has been ACK-SILENT this
+        #: long on top of breaker_fails timed-out ticks — a lossy-but-alive
+        #: link (acks still trickling) keeps flowing; default = max_backoff
+        self.breaker_grace = (
+            float(breaker_grace) if breaker_grace is not None
+            else self.max_backoff)
+        self.legacy_envelope = bool(legacy_envelope)
+        self.jitter = float(jitter)
         self.unreliable_codes = frozenset(
             int(c) for c in unreliable_codes
-        ) | {int(MessageCode.ReliableFrame), int(MessageCode.ReliableAck)}
+        ) | {int(MessageCode.ReliableFrame), int(MessageCode.ReliableAck),
+             int(MessageCode.CumAck)}
         self._lock = threading.Lock()
+        #: seeded per-instance jitter stream (rank-derived): retransmit
+        #: timing desynchronizes across peers, stays reproducible per rank
+        self._jrng = random.Random((self.rank << 8) ^ 0x5EED)
         #: this sender instance's incarnation: restarted processes stamp a
         #: LATER value, which tells receivers to reset dedup state for the
         #: rank instead of blackholing the fresh seq space
         self.incarnation = _next_incarnation()
         self._next_seq: Dict[int, int] = {}
         self._pending: Dict[Tuple[int, int], _Pending] = {}
+        self._peers: Dict[int, _PeerState] = {}
         self._requeue: "collections.deque[Message]" = collections.deque()
         self._seen: Dict[int, "collections.OrderedDict"] = {}
         self._peer_inc: Dict[int, int] = {}
+        self._rx: Dict[int, _RxState] = {}
+        self._credit_override: Optional[int] = None
         self._dead_peers: set = set()
         #: durability hook (ISSUE 5): with ``ack_on_delivery=False`` the ack
         #: for a DELIVERED data frame is withheld until the receiver calls
@@ -711,78 +957,272 @@ class ReliableTransport(Transport):
             "sent": 0, "retries": 0, "acked": 0, "gave_up": 0,
             "crc_dropped": 0, "dup_dropped": 0, "delivered": 0,
             "passthrough": 0,
+            # adaptive-wire telemetry (ISSUE 7)
+            "cum_acked": 0, "acks_tx": 0, "cum_acks_tx": 0,
+            "rto_expired": 0, "window_blocked": 0, "breaker_opens": 0,
+            "probes": 0,
         }
+        self._retry_wake = threading.Event()
         self._retry_thread = threading.Thread(
             target=self._retry_loop, name="reliable-retry", daemon=True)
         self._retry_thread.start()
+
+    # ---------------------------------------------------------- peer state
+    def _peer(self, dst: int) -> _PeerState:
+        """Caller holds ``_lock``."""
+        st = self._peers.get(dst)
+        if st is None:
+            st = self._peers[dst] = _PeerState(self.ack_timeout)
+            # the grace anchor starts at peer birth: a link whose first
+            # ack is merely SLOW (high-latency weather) must get the full
+            # breaker_grace before it can read as gone
+            st.last_ack = time.monotonic()
+        return st
+
+    def _rtt_sample(self, st: _PeerState, sample: float) -> None:
+        """Jacobson/Karels; caller holds ``_lock``."""
+        if sample <= 0:
+            return
+        if st.srtt is None:
+            st.srtt = sample
+            st.rttvar = sample / 2.0
+        else:
+            st.rttvar = 0.75 * st.rttvar + 0.25 * abs(st.srtt - sample)
+            st.srtt = 0.875 * st.srtt + 0.125 * sample
+        st.rto = min(max(st.srtt + max(4.0 * st.rttvar, 0.01),
+                         self.ack_timeout), self.max_backoff)
+
+    def _on_peer_ack(self, st: _PeerState) -> None:
+        """An ack arrived: the send path to this peer works. Caller holds
+        ``_lock``."""
+        st.consec_timeouts = 0
+        st.last_ack = time.monotonic()
+        if st.breaker != "closed":
+            st.breaker = "closed"
+            st.probe_key = None
+            st.opens = 0
+
+    def _revive(self, sender: int) -> None:
+        """ANY frame from a dead-declared rank is evidence of life (the
+        rejoin path). A merely-OPEN breaker is NOT closed here: on a one-way
+        degraded link the peer's data keeps arriving while our sends rot
+        unacked — only an ack may close the breaker, or the revive would
+        re-arm a retry storm every inbound frame. Caller holds ``_lock``."""
+        if sender in self._dead_peers:
+            self._dead_peers.discard(sender)
+            st = self._peer(sender)
+            st.dead = False
+            st.breaker = "closed"
+            st.probe_key = None
+            st.consec_timeouts = 0
+
+    def _backoff_delay(self, st: _PeerState, attempt: int) -> float:
+        """Jittered capped exponential backoff off the ADAPTIVE RTO (the
+        shared policy shape, ``utils/backoff.py``; inlined here because the
+        base — st.rto — moves with the link weather)."""
+        raw = st.rto * (2.0 ** max(0, attempt - 1))
+        jit = 1.0 + self.jitter * (2.0 * self._jrng.random() - 1.0)
+        return min(raw * jit, self.max_backoff)
 
     # ---------------------------------------------------------------- send
     def send(self, code: MessageCode, payload: np.ndarray, dst: int = SERVER_RANK) -> None:
         if int(code) in self.unreliable_codes:
             self.inner.send(code, payload, dst=dst)
             return
+        arr = np.ascontiguousarray(np.asarray(payload, dtype=np.float32).ravel())
+        # sliding window: block while the peer's in-flight frames fill
+        # min(send_window, advertised credit) — the backpressure that keeps
+        # a slow/jittery link from growing pending without bound. The
+        # blocked sender PUMPS the inner transport itself (like flush()):
+        # acks must clear even on a rank with no recv thread, or a pure
+        # sender would deadlock at its own window; data frames that arrive
+        # meanwhile are requeued for the next recv().
+        blocked = False
+        while True:
+            with self._lock:
+                st = self._peer(dst)
+                if st.dead or st.breaker == "open":
+                    raise ConnectionError(
+                        f"peer {dst} "
+                        + ("declared dead after "
+                           f"{self.max_retries} unacked retries" if st.dead
+                           else "circuit breaker open (consecutive RTO "
+                                "blowups)"))
+                if self._closed or st.inflight < self._window(st):
+                    seq = self._next_seq.get(dst, 0)
+                    self._next_seq[dst] = seq + 1
+                    # reserve the window slot INSIDE the admission check's
+                    # critical section: two threads sending to one peer
+                    # must not both pass the check and overshoot the
+                    # window (check-then-act); _pop_pending releases it
+                    st.inflight += 1
+                    break
+                if not blocked:
+                    blocked = True
+                    self.stats["window_blocked"] += 1
+            delivered = self._process(self.inner.recv(timeout=0.02))
+            if delivered is not None:
+                self._requeue.append(delivered)
+        try:
+            checksum = (_frame_crc_legacy if self.legacy_envelope
+                        else _frame_crc)
+            crc = checksum(self.incarnation, seq, int(code), arr)
+            header = np.asarray(
+                [*_split16(self.incarnation), *_split16(seq), *_split16(crc),
+                 float(int(code))], np.float32)
+            parts = ((np.concatenate([header, arr]),) if self.legacy_envelope
+                     else (header, arr))
+        except Exception:
+            with self._lock:
+                st = self._peer(dst)
+                st.inflight = max(0, st.inflight - 1)
+            raise
+        now = time.monotonic()
         with self._lock:
-            dead = dst in self._dead_peers
-        if dead:
-            raise ConnectionError(
-                f"peer {dst} declared dead after {self.max_retries} "
-                "unacked retries")
-        arr = np.asarray(payload, dtype=np.float32).ravel()
-        with self._lock:
-            seq = self._next_seq.get(dst, 0)
-            self._next_seq[dst] = seq + 1
-        crc = _frame_crc(self.incarnation, seq, int(code), arr.tobytes())
-        header = np.asarray(
-            [*_split16(self.incarnation), *_split16(seq), *_split16(crc),
-             float(int(code))], np.float32)
-        frame = np.concatenate([header, arr])
-        with self._lock:
-            self._pending[(dst, seq)] = _Pending(
-                frame, dst, time.monotonic() + self.ack_timeout,
-                code=int(code))
+            st = self._peer(dst)
+            p = _Pending(parts, dst, now + st.rto, code=int(code))
+            p.first_sent = now
+            self._pending[(dst, seq)] = p
             self.stats["sent"] += 1
         try:
-            self.inner.send(MessageCode.ReliableFrame, frame, dst=dst)
+            self.inner.sendv(MessageCode.ReliableFrame, parts, dst=dst)
         except (OSError, ConnectionError, KeyError):
             # the retry loop owns recovery; a transient send failure is
             # exactly what the pending buffer exists for
             pass
 
-    def _retry_loop(self) -> None:
-        while not self._closed:
-            time.sleep(min(0.02, self.ack_timeout / 2))
-            now = time.monotonic()
-            with self._lock:
-                due = [
-                    (key, p) for key, p in self._pending.items()
-                    if p.deadline <= now and p.dst not in self._dead_peers
-                ]
-            for key, p in due:
-                if p.attempt > self.max_retries:
-                    with self._lock:
-                        self._pending.pop(key, None)
-                        self.stats["gave_up"] += 1
-                        self._dead_peers.add(p.dst)
-                        dropped = [
-                            k for k in self._pending if k[0] == p.dst
-                        ]
-                        for k in dropped:
-                            del self._pending[k]
-                    _LOGGER.warning(
-                        "reliable: peer %d unacked after %d retries — "
-                        "declaring it dead (%d queued frames dropped)",
-                        p.dst, self.max_retries, len(dropped))
+    def _window(self, st: _PeerState) -> int:
+        """Effective send window; never below 1 (one probe frame must stay
+        allowed, or a zero-credit advertisement could deadlock the link —
+        acks only flow when frames do)."""
+        w = self.send_window
+        if st.credit is not None:
+            w = min(w, st.credit)
+        return max(1, w)
+
+    def _pop_pending(self, key) -> Optional[_Pending]:
+        """Caller holds ``_lock``."""
+        p = self._pending.pop(key, None)
+        if p is not None:
+            st = self._peer(p.dst)
+            st.inflight = max(0, st.inflight - 1)
+        return p
+
+    def _give_up(self, key, p: _Pending, now: float) -> None:
+        """Terminal give-up: the peer is dead until it speaks again.
+        Caller holds ``_lock``."""
+        st = self._peer(p.dst)
+        self._pop_pending(key)
+        # distcheck: ignore[DC201] caller holds _lock (documented contract)
+        self.stats["gave_up"] += 1
+        st.dead = True
+        st.breaker = "open"
+        st.probe_key = None
+        self._dead_peers.add(p.dst)
+        dropped = [k for k in self._pending if k[0] == p.dst]
+        for k in dropped:
+            self._pop_pending(k)
+        _LOGGER.warning(
+            "reliable: peer %d unacked after %d retries — declaring it "
+            "dead (%d queued frames dropped)",
+            p.dst, self.max_retries, len(dropped))
+
+    def _retry_tick(self) -> None:
+        """One pass of the adaptive retransmission machinery: RTO expiries,
+        breaker transitions, half-open probes."""
+        now = time.monotonic()
+        resend: list = []
+        timed_out: set = set()
+        with self._lock:
+            for key, p in list(self._pending.items()):
+                st = self._peer(p.dst)
+                if st.dead:
                     continue
-                backoff = min(
-                    self.ack_timeout * (2.0 ** p.attempt), self.max_backoff)
+                if st.breaker == "open":
+                    if st.probe_key is None:
+                        if now < st.probe_at:
+                            continue
+                        # half-open: exactly one pending frame probes the
+                        # link (the oldest — dict order is send order)
+                        if p.attempt > self.max_retries:
+                            self._give_up(key, p, now)
+                            continue
+                        st.probe_key = key
+                        p.attempt += 1
+                        p.retransmitted = True
+                        p.deadline = now + self._backoff_delay(st, p.attempt)
+                        self.stats["probes"] += 1
+                        resend.append(p)
+                    elif st.probe_key == key and p.deadline <= now:
+                        # probe unanswered: deepen the open state
+                        st.probe_key = None
+                        st.opens += 1
+                        st.probe_at = now + min(
+                            self.breaker_cooldown * (2.0 ** st.opens),
+                            4.0 * self.max_backoff)
+                        if p.attempt > self.max_retries:
+                            self._give_up(key, p, now)
+                    continue
+                if p.deadline > now:
+                    continue
+                if p.attempt > self.max_retries:
+                    self._give_up(key, p, now)
+                    continue
+                timed_out.add(p.dst)
+                self.stats["rto_expired"] += 1
                 p.attempt += 1
-                p.deadline = now + backoff
-                with self._lock:
-                    self.stats["retries"] += 1
-                try:
-                    self.inner.send(MessageCode.ReliableFrame, p.frame, dst=p.dst)
-                except (OSError, ConnectionError, KeyError):
-                    pass  # next pass retries or gives up
+                p.retransmitted = True
+                p.deadline = now + self._backoff_delay(st, p.attempt)
+                self.stats["retries"] += 1
+                resend.append(p)
+            # a BURST of same-tick expiries (one loss event hitting a whole
+            # window) is ONE piece of gone-ness evidence, not N: count the
+            # breaker's "consecutive RTO blowups" per peer per pass, reset
+            # by any ack — so a lossy-but-alive link keeps flowing while a
+            # genuinely silent peer opens after breaker_fails quiet ticks
+            for dst in timed_out:
+                st = self._peer(dst)
+                st.consec_timeouts += 1
+                # Karn's rule, part 2: a timeout BACKS OFF the peer's base
+                # RTO and the backed-off value persists for new frames —
+                # without this, a floor below the true RTT retransmits
+                # every frame, no frame ever yields a valid sample (part 1
+                # excludes retransmitted frames), and the estimator can
+                # never climb out of the spurious-retransmit storm. The
+                # next clean sample recomputes from SRTT/RTTVAR.
+                st.rto = min(st.rto * 2.0, self.max_backoff)
+                ack_silent = now - st.last_ack >= self.breaker_grace
+                if st.consec_timeouts >= self.breaker_fails and ack_silent \
+                        and not st.dead and st.breaker == "closed":
+                    st.breaker = "open"
+                    st.opens += 1
+                    st.probe_key = None
+                    st.probe_at = now + min(
+                        self.breaker_cooldown * (2.0 ** (st.opens - 1)),
+                        4.0 * self.max_backoff)
+                    self.stats["breaker_opens"] += 1
+                    _LOGGER.warning(
+                        "reliable: circuit to peer %d OPEN after %d "
+                        "consecutive RTO blowups (rto %.0f ms) — pausing "
+                        "retransmits, probe in %.2f s", dst,
+                        st.consec_timeouts, st.rto * 1e3,
+                        st.probe_at - now)
+        for p in resend:
+            try:
+                self.inner.sendv(MessageCode.ReliableFrame, p.parts,
+                                 dst=p.dst)
+            except (OSError, ConnectionError, KeyError):
+                pass  # next pass retries or gives up
+
+    def _retry_loop(self) -> None:
+        tick = min(0.02, self.ack_timeout / 2)
+        while not self._closed:
+            self._retry_wake.wait(tick)
+            self._retry_wake.clear()
+            if self._closed:
+                return
+            self._flush_acks()  # timed cumulative-ack flush
+            self._retry_tick()
 
     # ---------------------------------------------------------------- recv
     def _process(self, msg: Optional[Message]) -> Optional[Message]:
@@ -796,7 +1236,7 @@ class ReliableTransport(Transport):
         # (the reconnect-and-resume / rejoin paths); discard is idempotent,
         # so the membership test rides inside the lock with it
         with self._lock:
-            self._dead_peers.discard(sender)
+            self._revive(sender)
         if code == MessageCode.ReliableAck:
             # the ack echoes the FRAME's incarnation: a straggler ack for a
             # previous life's frame (same seq, old inc) must not clear the
@@ -809,13 +1249,52 @@ class ReliableTransport(Transport):
                     return None
                 if inc != self.incarnation:
                     return None
+                now = time.monotonic()
                 with self._lock:
-                    p = self._pending.pop((sender, seq), None)
+                    p = self._pop_pending((sender, seq))
                     if p is not None:
+                        st = self._peer(sender)
+                        if not p.retransmitted:
+                            self._rtt_sample(st, now - p.first_sent)
+                        self._on_peer_ack(st)
                         self.stats["acked"] += 1
                         key = (sender, p.code)
                         self._acked_codes[key] = \
                             self._acked_codes.get(key, 0) + 1
+            return None
+        if code == MessageCode.CumAck:
+            # batched cumulative ack: every seq <= cum of OUR incarnation
+            # is acknowledged, and the peer's advertised credit rides along
+            if payload.size >= 5 and np.isfinite(payload[:5]).all():
+                try:
+                    inc = _join16(payload[0], payload[1])
+                    cum = _join16(payload[2], payload[3])
+                    credit = int(payload[4])
+                except (ValueError, OverflowError):
+                    return None
+                if inc != self.incarnation:
+                    return None
+                now = time.monotonic()
+                with self._lock:
+                    st = self._peer(sender)
+                    st.credit = credit
+                    keys = [k for k in self._pending
+                            if k[0] == sender and k[1] <= cum]
+                    freshest = None
+                    for k in keys:
+                        p = self._pop_pending(k)
+                        self.stats["acked"] += 1
+                        self.stats["cum_acked"] += 1
+                        ck = (sender, p.code)
+                        self._acked_codes[ck] = \
+                            self._acked_codes.get(ck, 0) + 1
+                        if not p.retransmitted and (
+                                freshest is None
+                                or p.first_sent > freshest):
+                            freshest = p.first_sent
+                    if freshest is not None:
+                        self._rtt_sample(st, now - freshest)
+                    self._on_peer_ack(st)
             return None
         if code != MessageCode.ReliableFrame:
             with self._lock:
@@ -836,7 +1315,9 @@ class ReliableTransport(Transport):
                 self.stats["crc_dropped"] += 1
             return None
         body = payload[7:]
-        if _frame_crc(inc, seq, inner_code, body.tobytes()) != crc:
+        checksum = (_frame_crc_legacy if self.legacy_envelope
+                    else _frame_crc)
+        if checksum(inc, seq, inner_code, body) != crc:
             with self._lock:
                 self.stats["crc_dropped"] += 1
             return None  # corrupt: no ack, the retry delivers a clean copy
@@ -847,6 +1328,7 @@ class ReliableTransport(Transport):
                 # sequence space — the old dedup state would blackhole it
                 self._peer_inc[sender] = inc
                 self._seen.pop(sender, None)
+                self._rx[sender] = _RxState(inc)
             # inc < known: straggler retry from the rank's previous life —
             # ack it below so the dead process stops retrying, never deliver
             stale = known is not None and inc < known
@@ -860,8 +1342,9 @@ class ReliableTransport(Transport):
         dup = False
         if deliver:
             with self._lock:
+                rx = self._rx.setdefault(sender, _RxState(inc))
                 seen = self._seen.setdefault(sender, collections.OrderedDict())
-                if seq in seen:
+                if seq <= rx.cum_hw or seq in seen:
                     dup = True
                     self.stats["dup_dropped"] += 1
                 else:
@@ -877,20 +1360,66 @@ class ReliableTransport(Transport):
                 self._deferred_acks[key] = True
                 self._last_delivery = (inc, seq)
             return sender, mcode, body
+        send_individual = False
+        flush_now = False
         with self._lock:
             # a duplicate of a frame whose ack is still withheld must not
             # be re-acked early — the retry is the sender doing its job
             # until durability commits
             withheld = key in self._deferred_acks
-        if not withheld:
+            if not withheld:
+                rx = self._rx.get(sender)
+                if stale or not deliver or rx is None or rx.inc != inc \
+                        or not self.batched_acks:
+                    # stale-life straggler / undeliverable garbage /
+                    # legacy-mode: the individual ack path
+                    send_individual = True
+                elif dup:
+                    if seq <= rx.cum_hw:
+                        # dup below the frontier: the next cumulative ack
+                        # re-covers it — no per-frame re-ack storm
+                        rx.dirty += 1
+                        flush_now = rx.dirty >= self.ack_batch_n
+                    else:
+                        send_individual = True  # seeded/out-of-order dup
+                else:
+                    self._mark_eligible(rx, seq)
+                    if seq <= rx.cum_hw:
+                        rx.dirty += 1
+                        flush_now = rx.dirty >= self.ack_batch_n
+                    else:
+                        # out-of-order (a gap below it): SACK-style
+                        # immediate individual ack, cum catches up later
+                        send_individual = True
+        if send_individual:
             self._send_ack(sender, seq, inc)
+        if flush_now:
+            self._flush_acks()
         if deliver and not dup:
             with self._lock:
                 self._last_delivery = (inc, seq)
             return sender, mcode, body
         return None
 
+    def _mark_eligible(self, rx: _RxState, seq: int) -> None:
+        """Record one ack-eligible seq; advance the cumulative frontier
+        through any now-contiguous run. Caller holds ``_lock``."""
+        if seq == rx.cum_hw + 1:
+            rx.cum_hw = seq
+            while rx.cum_hw + 1 in rx.eligible:
+                rx.cum_hw += 1
+                rx.eligible.discard(rx.cum_hw)
+        elif seq > rx.cum_hw:
+            rx.eligible.add(seq)
+            if len(rx.eligible) > self.dedup_window:
+                # a permanent gap (frames lost to a peer death) must not
+                # grow this set forever; dropped entries were individually
+                # acked already, the frontier just can't cross the gap
+                rx.eligible.discard(min(rx.eligible))
+
     def _send_ack(self, sender: int, seq: int, inc: int) -> None:
+        with self._lock:
+            self.stats["acks_tx"] += 1
         try:
             self.inner.send(
                 MessageCode.ReliableAck,
@@ -899,14 +1428,74 @@ class ReliableTransport(Transport):
         except (OSError, ConnectionError, KeyError):
             pass  # ack lost: the sender's retry re-triggers it
 
+    def _credit_for(self, sender: int) -> int:
+        """Advertised credit: how many more frames this receiver is willing
+        to have in flight from ``sender``. Caller holds ``_lock``."""
+        if self._credit_override is not None:
+            return max(0, int(self._credit_override))
+        # distcheck: ignore[DC204] caller holds _lock (documented contract)
+        withheld = sum(1 for (s, _q, _i) in self._deferred_acks
+                       if s == sender)
+        return max(0, self.recv_window - withheld)
+
+    def _flush_acks(self) -> None:
+        """Send every dirty cumulative ack (called on batch-full, on the
+        retry tick, and at durability commits). Sends ride OUTSIDE the
+        lock."""
+        out = []
+        with self._lock:
+            for sender, rx in self._rx.items():
+                if rx.dirty <= 0 or rx.cum_hw < 0:
+                    continue
+                # a partial batch waits at most one retry tick (the timed
+                # caller), well inside any sane RTO floor
+                rx.dirty = 0
+                out.append((sender, np.asarray(
+                    [*_split16(rx.inc), *_split16(rx.cum_hw),
+                     float(self._credit_for(sender))], np.float32)))
+        for sender, frame in out:
+            with self._lock:
+                self.stats["cum_acks_tx"] += 1
+            try:
+                self.inner.send(MessageCode.CumAck, frame, dst=sender)
+            except (OSError, ConnectionError, KeyError):
+                pass  # lost ack: the sender's retransmit re-triggers it
+
     def ack_delivered(self) -> None:
         """Release every withheld delivery ack — call only once the applied
-        updates behind them are durable (the WAL group commit)."""
+        updates behind them are durable (the WAL group commit). In-order
+        runs collapse into ONE cumulative ack (the 36%-ack-tax recovery:
+        ack batching pipelined with the group fsync); out-of-order stragglers
+        keep their individual acks."""
+        individual = []
         with self._lock:
             due = list(self._deferred_acks.keys())
             self._deferred_acks.clear()
-        for sender, seq, inc in due:
+            for sender, seq, inc in due:
+                rx = self._rx.get(sender)
+                if rx is None or rx.inc != inc or not self.batched_acks:
+                    individual.append((sender, seq, inc))
+                    continue
+                self._mark_eligible(rx, seq)
+                if seq <= rx.cum_hw:
+                    rx.dirty += 1
+                else:
+                    individual.append((sender, seq, inc))
+        for sender, seq, inc in individual:
             self._send_ack(sender, seq, inc)
+        self._flush_acks()
+
+    def advertise_credit(self, credit: Optional[int]) -> None:
+        """Pin the advertised send-window credit (``None`` restores the
+        recv_window-derived default) and push it to every known sender —
+        the receiver-side shed lever (an overloaded PS/engine narrows its
+        senders' windows instead of letting queues grow)."""
+        with self._lock:
+            self._credit_override = credit
+            for rx in self._rx.values():
+                if rx.cum_hw >= 0:
+                    rx.dirty = max(rx.dirty, 1)
+        self._flush_acks()
 
     @property
     def last_delivery(self) -> Optional[Tuple[int, int]]:
@@ -940,6 +1529,59 @@ class ReliableTransport(Transport):
                     seen[seq] = True
                     while len(seen) > self.dedup_window:
                         seen.popitem(last=False)
+                    # the cumulative frontier stays below seeded entries
+                    # (they may be sparse): dups of seeded seqs take the
+                    # individual-ack path, which is exactly correct
+                    self._rx.setdefault(sender, _RxState(inc))
+
+    # -------------------------------------------------- wire-health surface
+    def breaker_state(self, dst: int) -> str:
+        """``closed`` / ``open`` / ``half-open`` / ``dead`` — the per-peer
+        circuit state the coord lease view and HeartbeatSender consume."""
+        with self._lock:
+            st = self._peers.get(dst)
+            if st is None:
+                return "closed"
+            if st.dead:
+                return "dead"
+            if st.breaker == "open":
+                return "half-open" if st.probe_key is not None else "open"
+            return "closed"
+
+    def breaker_open(self, dst: int) -> bool:
+        return self.breaker_state(dst) != "closed"
+
+    def open_breakers(self) -> int:
+        """How many peers currently have a non-closed circuit — rides the
+        member's LeaseRenew so the coordinator sees wire health."""
+        with self._lock:
+            return sum(1 for st in self._peers.values()
+                       if st.dead or st.breaker != "closed")
+
+    def pending_depth(self, dst: Optional[int] = None) -> int:
+        """Unacked frames in flight (toward ``dst``, or total) — the
+        bounded-pending acceptance metric."""
+        with self._lock:
+            if dst is None:
+                return len(self._pending)
+            st = self._peers.get(dst)
+            return 0 if st is None else st.inflight
+
+    def pressure(self) -> float:
+        """Worst-case window occupancy across peers, 0..1 — the wire
+        backpressure signal the serving frontend folds into its overload
+        pressure (a saturated window reads as a busy engine)."""
+        with self._lock:
+            worst = 0.0
+            for st in self._peers.values():
+                worst = max(worst, st.inflight / self._window(st))
+            return min(1.0, worst)
+
+    def rto(self, dst: int) -> float:
+        """The peer's current adaptive retransmission timeout (seconds)."""
+        with self._lock:
+            st = self._peers.get(dst)
+            return self.ack_timeout if st is None else st.rto
 
     def detach(self) -> None:
         """Stop this wrapper (retry thread exits, ``recv`` returns None)
@@ -947,6 +1589,7 @@ class ReliableTransport(Transport):
         replacement wrapper (the server-restart path in ``coord/drill.py``;
         a real restart replaces the process, here only the wrapper dies)."""
         self._closed = True
+        self._retry_wake.set()
 
     def recv(self, timeout: Optional[float] = None) -> Optional[Message]:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -991,6 +1634,7 @@ class ReliableTransport(Transport):
         if not self._closed:
             self.flush(timeout=min(2.0, self.max_backoff))
         self._closed = True
+        self._retry_wake.set()
         self.inner.close()
 
 
@@ -1003,6 +1647,7 @@ def make_transport(
     connect_timeout: float = 60.0,
     reliable: bool = False,
     durable_acks: bool = False,
+    reliable_opts: Optional[dict] = None,
 ) -> Transport:
     """Transport factory for the PS control plane.
 
@@ -1021,6 +1666,11 @@ def make_transport(
     ``ack_delivered`` via ``ParameterServer.commit``) defers delivery acks
     until the receiver declares the applied updates durable: log-before-ack,
     so "acked" survives a crash. Meaningless without ``reliable``.
+
+    ``reliable_opts`` forwards tuning knobs (``ack_timeout``/``max_backoff``
+    = RTO floor/cap, ``send_window``, ``ack_batch_n``, ``breaker_fails``,
+    …) to :class:`ReliableTransport` without widening this signature for
+    every one.
     """
     if kind not in ("auto", "native", "python"):
         raise ValueError(f"unknown transport kind: {kind!r}")
@@ -1039,8 +1689,38 @@ def make_transport(
     if t is None:
         t = TCPTransport(rank, world_size, master, int(port), connect_timeout)
     if reliable:
-        return ReliableTransport(t, ack_on_delivery=not durable_acks)
+        return ReliableTransport(t, ack_on_delivery=not durable_acks,
+                                 **(reliable_opts or {}))
     return t
+
+
+def make_world(
+    world_size: int,
+    *,
+    reliable: bool = False,
+    plan=None,
+    log=None,
+    reliable_opts: Optional[dict] = None,
+) -> Tuple[Dict[int, Transport], Optional[object]]:
+    """One in-process world through the SAME layer stack the TCP/native
+    paths use: raw mailboxes, optionally chaos-wrapped (``plan`` — a
+    ``utils.chaos.ChaosPlan``), optionally reliability-wrapped on every
+    rank. Returns ``(transports, chaos_log_or_None)``.
+
+    This is the unified-transport entry the microbench ladder and the
+    netweather tests build on: the wrapping ORDER (reliable over chaos over
+    raw) is fixed here once, so every test and bench prices the same stack.
+    """
+    world: Dict[int, Transport] = InProcessTransport.create_world(world_size)
+    chaos_log = None
+    if plan is not None:
+        from distributed_ml_pytorch_tpu.utils.chaos import FaultyTransport
+
+        world, chaos_log = FaultyTransport.wrap_world(world, plan, log=log)
+    if reliable:
+        world = {r: ReliableTransport(t, **(reliable_opts or {}))
+                 for r, t in world.items()}
+    return world, chaos_log
 
 
 # --- module-level default transport -----------------------------------------

@@ -104,6 +104,14 @@ def pytest_configure(config):
         "`make soak` selects exactly these — all also slow, so tier-1 "
         "never pays for them",
     )
+    config.addinivalue_line(
+        "markers",
+        "netweather: adaptive-wire tests under network weather "
+        "(utils/chaos.WeatherRule + the RTO/window/breaker machinery in "
+        "utils/messaging.ReliableTransport); `make netweather` selects "
+        "exactly these — fast units run in tier-1, the training "
+        "acceptance is additionally measured into slow_tests.txt",
+    )
 
 
 # Modules whose tests launch real subprocess worlds (interpreter start + jit

@@ -132,6 +132,35 @@ def test_membership_lease_expiry_rebalances_and_logs():
     assert c.fleet_state()["workers_done"]
 
 
+def test_five_field_pre_issue7_renew_still_renews_and_rejects_stale():
+    """WIRE_SCHEMAS tolerance contract: a 5-field pre-ISSUE-7 LeaseRenew
+    (no wire_open) is a FULL renew — lease refreshed, progress adopted,
+    stale incarnations still rejected — and leaves the last wire-health
+    report standing rather than reading absence as healthy."""
+    clock = _Clock()
+    c = Coordinator(None, 100, lease=2.0, clock=clock, speculation=False)
+    c.handle(1, MessageCode.CoordJoin, encode_join(KIND_SHARD, 10))
+    # a 6-field renew reports a degraded wire
+    c.handle(1, MessageCode.LeaseRenew, encode_renew(10, 1, 1, 5.0,
+                                                     wire_open=2))
+    assert c.members[1].wire_open == 2
+    clock.t = 1.9
+    legacy = encode_renew(10, 7, 9, 33.0)[:5]  # the pre-ISSUE-7 frame
+    assert legacy.size == 5
+    c.handle(1, MessageCode.LeaseRenew, legacy)
+    m = c.members[1]
+    assert m.last_seen == 1.9 and m.push_count == 7 and m.step == 9
+    assert m.ewma_ms == 33.0
+    assert m.wire_open == 2  # absent field != healthy
+    clock.t = 2.5
+    assert not c.tick()  # the 5-field renew refreshed the lease
+    # a stale life's 5-field renew is still rejected
+    before = m.last_seen
+    c.handle(1, MessageCode.LeaseRenew, encode_renew(9, 99, 99, 1.0)[:5])
+    assert c.members[1].last_seen == before
+    assert c.members[1].push_count != 99
+
+
 def test_workerdone_racing_join_same_rank_incarnation_bump_wins():
     """Satellite: rank 5's old life finishes (its CoordLeave is still in
     flight) while a replacement with a HIGHER incarnation joins the same

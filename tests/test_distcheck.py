@@ -313,6 +313,111 @@ def test_dc107_append_mode_wal_writes_are_exempt(tmp_path):
     assert "DC107" not in _codes(active)
 
 
+# ------------------------------------------------ DC108: retry backoff
+
+_MINI_BACKOFF = """
+    import random
+    import time
+
+    class Backoff:
+        def __init__(self, base, cap, jitter=0.25, seed=None):
+            self.base = base
+            self.cap = cap
+            self._rng = random.Random(seed)
+
+        def delay(self, attempt):
+            return min(self.base * (2 ** attempt), self.cap)
+
+        def sleep(self, attempt):
+            # the defining module's own literal sleep IS the policy plumbing
+            while attempt > 0:
+                time.sleep(0.01)
+                attempt -= 1
+
+        def attempts(self):
+            k = 0
+            while True:
+                yield k
+                self.sleep(k)
+                k += 1
+"""
+
+
+def test_dc108_literal_retry_sleep_in_backoff_opted_module(tmp_path):
+    """Seeded bug: a module imports the shared backoff policy yet still
+    hard-codes a flat retry sleep in its dial loop; the clean twin drives
+    the loop through the policy."""
+    files = _wire_files(**{
+        "utils/backoff.py": _MINI_BACKOFF,
+        "utils/net.py": """
+            import time
+            from fixturepkg.utils.backoff import Backoff
+
+            def connect(dial):
+                policy = Backoff(0.05, 1.0)
+                while True:
+                    try:
+                        return dial()
+                    except OSError:
+                        time.sleep(0.3)
+        """,
+    })
+    active, _ = _run(tmp_path, files)
+    assert "DC108" in _codes(active)
+    fixed = dict(files)
+    fixed["utils/net.py"] = """
+        from fixturepkg.utils.backoff import Backoff
+
+        def connect(dial):
+            policy = Backoff(0.05, 1.0)
+            for _attempt in policy.attempts():
+                try:
+                    return dial()
+                except OSError:
+                    pass
+    """
+    active, _ = _run(tmp_path, fixed)
+    assert "DC108" not in _codes(active)
+
+
+def test_dc108_defining_and_unopted_modules_exempt(tmp_path):
+    files = _wire_files(**{
+        # defines Backoff: its own plumbing is the raw path — exempt
+        "utils/backoff.py": _MINI_BACKOFF,
+        # never references the helper: out of scope (opt-in like DC105/107)
+        "utils/net.py": """
+            import time
+
+            def connect(dial):
+                while True:
+                    try:
+                        return dial()
+                    except OSError:
+                        time.sleep(0.3)
+        """,
+    })
+    active, _ = _run(tmp_path, files)
+    assert "DC108" not in _codes(active)
+
+
+def test_dc108_non_literal_and_non_loop_sleeps_are_clean(tmp_path):
+    files = _wire_files(**{
+        "utils/backoff.py": _MINI_BACKOFF,
+        "utils/net.py": """
+            import time
+            from fixturepkg.utils.backoff import Backoff
+
+            def settle(policy, quiet):
+                time.sleep(0.5)  # one-shot settle, not a retry loop
+                while quiet():
+                    time.sleep(policy.delay(1))  # policy-derived: fine
+                return Backoff
+        """,
+    })
+    active, _ = _run(tmp_path, files)
+    assert "DC108" not in _codes(active)
+
+
 # ----------------------------------------------------- DC2xx: concurrency
 
 _GUARDED_BOX = """
@@ -1020,6 +1125,6 @@ def test_coord_client_progress_guarded():
             assert not done.wait(0.25), "report() ignored the client lock"
         assert done.wait(2.0)
         with client._lock:
-            assert client._progress == (1, 2, 3.0)
+            assert client._progress == (1, 2, 3.0, 0)
     finally:
         client.stop()

@@ -370,3 +370,117 @@ def test_seed_dedup_survives_receiver_restart():
         b.close()
         for t in world.values():
             t.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7 satellites: handshake hardening, scatter/gather framing, backoff
+# ---------------------------------------------------------------------------
+
+def test_tcp_stalled_handshake_cannot_wedge_the_rendezvous():
+    """A connection that dials in and then STALLS mid-handshake (partial
+    hello, then silence) must be dropped after ``handshake_timeout`` — the
+    accept path may not block forever, and a real worker arriving behind
+    the staller must still be admitted."""
+    import socket
+    import struct
+
+    from distributed_ml_pytorch_tpu.utils.messaging import _HEADER
+
+    port = _free_port()
+    holder = {}
+
+    def server():
+        # world-size 2: the rendezvous blocks for exactly ONE real worker
+        holder["t"] = TCPTransport(0, 2, "localhost", port,
+                                   handshake_timeout=0.5)
+
+    st = threading.Thread(target=server)
+    st.start()
+    # the staller connects first and sends 4 bytes of a 16-byte header,
+    # then goes silent
+    staller = None
+    for _ in range(100):
+        try:
+            staller = socket.create_connection(("localhost", port),
+                                               timeout=2)
+            break
+        except OSError:
+            time.sleep(0.05)
+    assert staller is not None
+    staller.sendall(struct.pack("<i", 1))
+    t0 = time.monotonic()
+    # the real worker dials in behind the staller; the server must shed
+    # the stalled handshake within its deadline and admit this one
+    w = TCPTransport(1, 2, "localhost", port, connect_timeout=30)
+    st.join(timeout=30)
+    assert not st.is_alive(), "rendezvous wedged behind a stalled handshake"
+    assert time.monotonic() - t0 < 20
+    t = holder["t"]
+    try:
+        w.send(MessageCode.GradientUpdate, np.arange(3, dtype=np.float32))
+        msg = t.recv(timeout=10)
+        assert msg is not None and msg[1] == MessageCode.GradientUpdate
+    finally:
+        staller.close()
+        w.close()
+        t.close()
+
+
+def test_tcp_sendv_scatter_gather_matches_single_frame():
+    """sendv (zero-copy envelope framing) must produce byte-identical
+    frames to a concatenated single-part send, for both the small-frame
+    (joined) and bulk (multi-sendall) paths."""
+    port = _free_port()
+    holder = {}
+
+    def server():
+        holder["t"] = TCPTransport(0, 2, "localhost", port)
+
+    st = threading.Thread(target=server)
+    st.start()
+    w = None
+    for _ in range(100):
+        try:
+            w = TCPTransport(1, 2, "localhost", port)
+            break
+        except OSError:
+            time.sleep(0.05)
+    st.join(timeout=10)
+    t = holder["t"]
+    try:
+        head = np.asarray([1.0, 2.0, 3.0], np.float32)
+        small_tail = np.arange(5, dtype=np.float32)
+        bulk_tail = np.arange(40_000, dtype=np.float32)  # > 64 KB frame
+        for tail in (small_tail, bulk_tail):
+            w.sendv(MessageCode.GradientUpdate, (head, tail))
+            w.send(MessageCode.GradientUpdate,
+                   np.concatenate([head, tail]))
+            a = t.recv(timeout=10)
+            b = t.recv(timeout=10)
+            assert a is not None and b is not None
+            np.testing.assert_array_equal(a[2], b[2])
+    finally:
+        w.close()
+        t.close()
+
+
+def test_backoff_policy_is_seeded_capped_and_deadline_bounded():
+    from distributed_ml_pytorch_tpu.utils.backoff import Backoff
+
+    p1 = Backoff(0.1, 1.0, jitter=0.5, seed=7)
+    p2 = Backoff(0.1, 1.0, jitter=0.5, seed=7)
+    p3 = Backoff(0.1, 1.0, jitter=0.5, seed=8)
+    d1 = [p1.delay(k) for k in range(8)]
+    # pure per-seed: same seed replays, attempt k is stable on re-ask
+    assert d1 == [p2.delay(k) for k in range(8)]
+    assert d1 == [p1.delay(k) for k in range(8)]
+    # different seeds desynchronize (the anti-retry-storm property)
+    assert d1 != [p3.delay(k) for k in range(8)]
+    assert all(d <= 1.0 for d in d1)       # cap holds through jitter
+    assert d1[0] < d1[4]                    # growth is real
+    # attempts() honors its deadline without a literal sleep at the caller
+    t0 = time.monotonic()
+    fast = Backoff(0.01, 0.02, seed=1)
+    n = sum(1 for _ in fast.attempts(deadline=t0 + 0.15))
+    assert 3 <= n <= 40
+    assert time.monotonic() - t0 < 2.0
