@@ -107,6 +107,23 @@ fleet:
 soak:
 	$(PY) -m pytest tests/ -q -m soak
 
+# numerical-health suite (ISSUE 8): admission gate + UpdateNack quarantine,
+# SDC chaos (bit-perfect-on-the-wire payload corruption), worker reputation,
+# and the coordinator auto-rollback barrier — the acceptance proves >=1
+# automatic rollback under a seeded poisoned worker with byte-identical
+# chaos logs and zero poison in any WAL
+health:
+	$(PY) -m pytest tests/ -q -m health
+
+# one-command health demo (prints rollback MTTR, quarantine/nack counts,
+# reputation revocations)
+health-demo:
+	$(PY) -m distributed_ml_pytorch_tpu.coord.cli --health
+
+# health-plane bench phase: reject rate, nack round-trip, rollback MTTR
+bench-health:
+	$(PY) bench_all.py --only health
+
 # adaptive-wire suite (ISSUE 7): RTT-driven retransmission, window/credit
 # backpressure, circuit breakers, and seeded network weather (latency /
 # jitter / bandwidth caps / one-way degradation) — the training acceptance
@@ -156,4 +173,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire chaos coord drill drill-demo fleet netweather soak lint test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-health chaos coord drill drill-demo fleet health health-demo netweather soak lint test test-all verify-real-data graph install dist

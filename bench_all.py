@@ -1067,6 +1067,55 @@ def recovery_phase() -> None:
         f"{out['replayed_updates']}, chaos {out['chaos_counts']}")
 
 
+def health_phase() -> None:
+    """Config 3, numerical-health leg (ISSUE 8): the immune-system scenario
+    — 2 workers + 2 WAL'd shards behind the admission gate, one worker's
+    push channel under seeded SDC (gate-slipping scale corruption, then
+    NaN) — priced as the quarantine reject rate, the worker-observed nack
+    round-trips, and the coordinator auto-rollback MTTR (watchdog trigger
+    -> every shard restored + reported), alongside the drill's recovery
+    numbers."""
+    import tempfile
+
+    from distributed_ml_pytorch_tpu.coord.health import health_scenario
+
+    out = health_scenario(
+        base_dir=tempfile.mkdtemp(prefix="bench_health_"), seed=0)
+    if not out["ok"] or out["rollbacks"] < 1 or out["rollback_mttr_s"] is None:
+        log(f"health_phase incomplete: ok={out['ok']} "
+            f"rollbacks={out['rollbacks']} errors={out['errors']} "
+            f"events={out['events'][-5:]}")
+        return
+    applied = sum(sum(d.values()) for d in out["applied"].values())
+    quarantined = out["quarantined_total"]
+    seen = applied + quarantined
+    reject_rate = quarantined / seen if seen else 0.0
+    nacks_heard = sum(out["worker_nacks"].values())
+    emit(3, "health_reject_rate", 100.0 * reject_rate, "%",
+         "in-process fleet, 1 core",
+         f"admission gate: {quarantined} of {seen} arriving updates "
+         f"quarantined (finiteness + per-worker EWMA z-score), every one "
+         f"explicitly nacked ({out['nacks_sent_total']} UpdateNacks), zero "
+         "in any WAL; 2 workers + 2 shards, one poisoned push channel, "
+         "coord/health.health_scenario")
+    emit(3, "health_nack_roundtrips", float(nacks_heard), "nacks",
+         "in-process fleet, 1 core",
+         "UpdateNacks that completed the round trip (server reject -> "
+         "worker heard it, resynced by pulling fresh params and held its "
+         f"in-flight update); {out['revoked_workers']} worker(s) "
+         "reputation-revoked by the coordinator")
+    emit(3, "health_rollback_mttr", out["rollback_mttr_s"] * 1e3, "ms",
+         "in-process fleet, 1 core",
+         "coordinator watchdog detects fleet loss divergence -> "
+         "RollbackRequest barrier -> both shards restore the last good "
+         "FleetManifest (ckpt + WAL capped at its apply seq) -> all "
+         "RollbackDone reports in; workers drop accumulators and pull")
+    log(f"health_phase: reject rate {100 * reject_rate:.1f}%, "
+        f"{nacks_heard} nack round-trips, rollback mttr "
+        f"{out['rollback_mttr_s'] * 1e3:.0f} ms, "
+        f"revoked {out['revoked_workers']}, chaos {out['chaos_counts']}")
+
+
 def _steady_rate_from_csv(path: str, batch: int):
     """Steady-state img/s from a trainer CSV's per-iteration timestamps:
     MEAN inter-step gap over the second half of the run (warmup/compile
@@ -1670,6 +1719,7 @@ PHASES = {
     "sharded_ps": lambda: sharded_ps_phase(),
     "elastic": lambda: elastic_phase(),
     "recovery": lambda: recovery_phase(),
+    "health": lambda: health_phase(),
     "ps_tpu": lambda: ps_tpu_phase(),
     "transport": lambda: transport_phase(),
     "reliability": lambda: reliability_phase(),
@@ -1697,6 +1747,7 @@ def main(argv=None) -> None:
     sharded_ps_phase()
     elastic_phase()
     recovery_phase()
+    health_phase()
     ps_tpu_phase()
     transport_phase()
     reliability_phase()

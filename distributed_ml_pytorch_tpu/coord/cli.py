@@ -61,6 +61,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run the in-process disaster-recovery drill "
                         "(snapshot barrier, kill ALL shards, restore from "
                         "manifest + WAL, sequence-accounted) and exit")
+    p.add_argument("--health", action="store_true",
+                   help="run the in-process numerical-health scenario "
+                        "(ISSUE 8: admission gate + nacks, seeded SDC "
+                        "poisoned worker, reputation revocation, "
+                        "coordinator auto-rollback) and exit")
+    p.add_argument("--auto-rollback", action="store_true",
+                   help="TCP hub mode: watch the fleet's loss telemetry "
+                        "and drive RollbackRequest barriers to the last "
+                        "good manifest on divergence/nonfinite losses")
+    p.add_argument("--rollback-loss-factor", type=float, default=2.0,
+                   help="auto-rollback: trigger when the fleet-mean loss "
+                        "EWMA exceeds this multiple of its best")
+    p.add_argument("--reputation-nacks", type=int, default=0,
+                   help="revoke a worker's lease after this many admission "
+                        "nacks since it (re)joined (0 = off)")
     p.add_argument("--manifest-dir", type=str, default="",
                    help="directory for fleet snapshot manifests (TCP hub "
                         "mode; empty = snapshots stay in memory)")
@@ -108,6 +123,15 @@ def run_drill(args) -> int:
     return 0 if summary.get("ok") else 1
 
 
+def run_health(args) -> int:
+    """The ISSUE 8 immune-system scenario as a one-command script."""
+    from distributed_ml_pytorch_tpu.coord.health import health_demo
+
+    summary = health_demo(seed=args.seed)
+    print("health scenario:", summary)
+    return 0 if summary.get("ok") else 1
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     print(args)
@@ -115,6 +139,8 @@ def main(argv=None) -> int:
         return run_demo(args)
     if args.drill:
         return run_drill(args)
+    if args.health:
+        return run_health(args)
 
     from distributed_ml_pytorch_tpu.coord.coordinator import Coordinator
     from distributed_ml_pytorch_tpu.utils.messaging import TCPTransport
@@ -128,7 +154,10 @@ def main(argv=None) -> int:
         straggler_factor=args.straggler_factor,
         speculation=not args.no_speculation,
         manifest_dir=args.manifest_dir or None,
-        snapshot_interval=args.snapshot_interval)
+        snapshot_interval=args.snapshot_interval,
+        auto_rollback=args.auto_rollback,
+        rollback_loss_factor=args.rollback_loss_factor,
+        reputation_nacks=args.reputation_nacks)
     print(f"coordinator on {args.master}:{args.port} "
           f"({n_params} params, lease {args.lease:.1f}s)")
     try:

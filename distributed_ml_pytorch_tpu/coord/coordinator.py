@@ -75,13 +75,23 @@ def encode_leave(incarnation: int) -> np.ndarray:
 
 
 def encode_renew(incarnation: int, push_count: int = 0, step: int = 0,
-                 ewma_ms: float = 0.0, wire_open: int = 0) -> np.ndarray:
+                 ewma_ms: float = 0.0, wire_open: int = 0, nacks: int = 0,
+                 bad_loss: int = 0, loss_ewma: float = 0.0,
+                 gnorm_ewma: float = 0.0) -> np.ndarray:
     """``wire_open`` (ISSUE 7) counts the member's open circuit breakers —
     peers whose sends are timing out — so the lease view carries wire
-    health, not just liveness."""
+    health, not just liveness. The tail (ISSUE 8) is the numerical-health
+    telemetry: cumulative admission ``nacks`` received, ``bad_loss``
+    nonfinite-loss observations, and the loss / grad-norm EWMAs — the
+    reputation and rollback-watchdog inputs. All values must be finite
+    (receivers drop nonfinite renewals); the senders clamp."""
+    from distributed_ml_pytorch_tpu.utils.health import clamp_finite32
+
     return np.asarray(
         [*_split16(incarnation), float(push_count), float(step),
-         float(ewma_ms), float(wire_open)], np.float32)
+         float(ewma_ms), float(wire_open), float(nacks), float(bad_loss),
+         clamp_finite32(loss_ewma), clamp_finite32(gnorm_ewma)],
+        np.float32)
 
 
 def encode_snapshot_request(snapshot_id: int, map_version: int) -> np.ndarray:
@@ -96,6 +106,22 @@ def encode_snapshot_done(snapshot_id: int, map_version: int, lo: int,
         [*_split16(snapshot_id), *_split16(map_version), *_split16(lo),
          *_split16(hi), *_split16(apply_seq), *_split16(push_count)],
         np.float32)
+
+
+def encode_rollback_request(rollback_id: int, snapshot_id: int,
+                            map_version: int, phase: int) -> np.ndarray:
+    """Phase 0 = barrier start (shards restore, workers drop accumulators
+    and pull, frontends hold submits); phase 1 = complete/abandoned."""
+    return np.asarray(
+        [*_split16(rollback_id), *_split16(snapshot_id),
+         *_split16(map_version), float(phase)], np.float32)
+
+
+def encode_rollback_done(rollback_id: int, map_version: int, lo: int,
+                         hi: int, apply_seq: int) -> np.ndarray:
+    return np.asarray(
+        [*_split16(rollback_id), *_split16(map_version), *_split16(lo),
+         *_split16(hi), *_split16(apply_seq)], np.float32)
 
 
 def encode_fleet(version: int, n_workers: int, n_shards: int, n_engines: int,
@@ -143,6 +169,16 @@ class MemberInfo:
     #: idle engine (0% occupancy, 0 TTFT) still counts as reporting, so
     #: scale-down advice can fire on a genuinely idle fleet
     reported: bool = False
+    # --- numerical health telemetry (ISSUE 8) ---------------------------
+    #: cumulative admission nacks this member has received; ``nack_base``
+    #: anchors the offense counter at THIS life's first report, so a
+    #: readmitted worker is judged on fresh behavior, not its history
+    nacks: int = 0
+    nack_base: int = -1
+    #: nonfinite losses this member has observed (the hard rollback signal)
+    bad_loss: int = 0
+    loss_ewma: float = 0.0
+    gnorm_ewma: float = 0.0
 
     @property
     def kind_name(self) -> str:
@@ -171,6 +207,12 @@ class Coordinator:
         engine_slo_ttft_ms: float = 0.0,
         scale_cooldown: float = 5.0,
         on_scale: Optional[Callable[[str, dict], None]] = None,
+        auto_rollback: bool = False,
+        rollback_loss_factor: float = 2.0,
+        rollback_cooldown: float = 10.0,
+        rollback_timeout: float = 30.0,
+        reputation_nacks: int = 0,
+        reputation_cooldown: float = 10.0,
     ):
         self.transport = transport
         self.lease = float(lease)
@@ -216,6 +258,39 @@ class Coordinator:
         self.on_scale = on_scale
         self._next_scale_at = 0.0
         self.scale_advice: List[Tuple[str, dict]] = []
+        # --- numerical health plane (ISSUE 8) ---------------------------
+        # Worker REPUTATION: with ``reputation_nacks > 0``, a worker whose
+        # lease renewals report that many admission nacks since (re)joining
+        # gets its lease REVOKED — it rejoins with fresh params only after
+        # ``reputation_cooldown`` (the incarnation machinery handles the
+        # relife; meanwhile every poisoned push it keeps sending is nacked
+        # at the gate, so the data plane stays safe regardless).
+        # AUTO-ROLLBACK: with ``auto_rollback``, tick() watches the fleet's
+        # loss telemetry — any reported nonfinite loss, or the fleet-mean
+        # loss EWMA diverging past ``rollback_loss_factor`` x its best —
+        # and drives a RollbackRequest barrier restoring the last good
+        # FleetManifest (shards roll back in place, workers drop
+        # accumulators and pull, frontends hold submits). MTTR is the
+        # trigger -> all-shards-reported time (``rollback_mttrs``).
+        self.auto_rollback = bool(auto_rollback)
+        self.rollback_loss_factor = float(rollback_loss_factor)
+        self.rollback_cooldown = float(rollback_cooldown)
+        self.rollback_timeout = float(rollback_timeout)
+        self.reputation_nacks = int(reputation_nacks)
+        self.reputation_cooldown = float(reputation_cooldown)
+        self._roll: Optional[dict] = None  # the in-flight barrier, if any
+        self._roll_seq = 0
+        #: set by trigger_rollback() from any thread; consumed by tick()
+        self._rollback_requested = False
+        self._next_rollback_at = 0.0
+        self.rollbacks_done = 0
+        self.rollbacks_abandoned = 0
+        self.rollback_mttrs: List[float] = []
+        self._fleet_best_loss: Optional[float] = None
+        self._bad_loss_seen: Dict[int, int] = {}
+        self._reputation_block: Dict[int, float] = {}  # rank -> until
+        self._block_logged: set = set()
+        self.revoked_workers = 0
         if restore_manifest is not None:
             # disaster recovery: adopt the manifest's shard map + snapshot
             # clock so rebalances and snapshot ids continue, not restart
@@ -254,7 +329,9 @@ class Coordinator:
             "members": {
                 m.rank: {"kind": m.kind_name, "incarnation": m.incarnation,
                          "step": m.step, "push_count": m.push_count,
-                         "ewma_ms": m.ewma_ms, "wire_open": m.wire_open}
+                         "ewma_ms": m.ewma_ms, "wire_open": m.wire_open,
+                         "nacks": m.nacks, "bad_loss": m.bad_loss,
+                         "loss_ewma": m.loss_ewma}
                 for m in self._live()
             },
         }
@@ -290,6 +367,19 @@ class Coordinator:
         for m in self._live():
             self._send(m.rank, code, payload)
 
+    def _broadcast_rollback(self, payload: np.ndarray) -> None:
+        """Rollback frames reach the live fleet AND reputation-revoked
+        ranks still cooling down: a revoked worker keeps running (its
+        pushes are nacked at the gate, so the data plane is safe) and
+        still holds an in-flight accumulator computed on the pre-rollback
+        state — it must drop it and pull like everyone else, or its
+        eventual readmitted pushes ride a stale base."""
+        self._broadcast(MessageCode.RollbackRequest, payload)
+        live = {m.rank for m in self._live()}
+        for rank in self._reputation_block:
+            if rank not in live:
+                self._send(rank, MessageCode.RollbackRequest, payload)
+
     def _announce(self) -> None:
         """Push the current map + fleet state to everyone."""
         self._broadcast(MessageCode.ShardMapUpdate, self.shard_map.encode())
@@ -316,9 +406,40 @@ class Coordinator:
                 self._log(f"ignored stale join of rank {sender} "
                           f"(inc {inc} < {member.incarnation})")
                 return
+            blocked_until = self._reputation_block.get(sender)
+            if blocked_until is not None:
+                if now < blocked_until:
+                    # reputation cooldown (ISSUE 8): the revoked worker's
+                    # join retries are refused until it expires; logged
+                    # once, not per 2s retry
+                    if sender not in self._block_logged:
+                        self._block_logged.add(sender)
+                        self._log(
+                            f"join of worker {sender} refused: reputation "
+                            f"cooldown ({blocked_until - now:.1f}s left)")
+                    return
+                del self._reputation_block[sender]
+                self._block_logged.discard(sender)
+                self._log(f"worker {sender} reputation cooldown over — "
+                          "rejoin admitted (fresh params via its pull)")
             is_new = member is None or member.incarnation != inc
             rebirth = member is not None and inc > member.incarnation
-            self.members[sender] = MemberInfo(sender, kind, inc, now)
+            if is_new:
+                self.members[sender] = MemberInfo(sender, kind, inc, now)
+                # a new life's bad_loss counter restarts at 0, so the
+                # watchdog's consumed-evidence high-water mark must
+                # re-anchor with it — a stale mark would silently absorb
+                # the new life's first nonfinite-loss reports (the same
+                # cross-life reset nack_base gets via MemberInfo)
+                self._bad_loss_seen.pop(sender, None)
+            else:
+                # idempotent SAME-life re-join (members re-join every few
+                # renews as lease-expiry insurance): refresh the lease but
+                # KEEP the accumulated telemetry — recreating the record
+                # here silently zeroed nacks/wire/loss state every few
+                # seconds, which made reputation offenses (ISSUE 8)
+                # unaccumulable by construction
+                member.last_seen = now
             if kind == KIND_WORKER:
                 self.done_workers.discard(sender)
             if is_new:
@@ -372,12 +493,25 @@ class Coordinator:
                 apply_seq=_join16(payload[8], payload[9]),
                 push_count=_join16(payload[10], payload[11]))
             return
+        if code == MessageCode.RollbackDone and payload.size >= 10:
+            if not np.isfinite(payload[:10]).all():
+                return
+            member.last_seen = now
+            self._on_rollback_done(
+                sender,
+                rollback_id=_join16(payload[0], payload[1]),
+                map_version=_join16(payload[2], payload[3]),
+                lo=_join16(payload[4], payload[5]),
+                hi=_join16(payload[6], payload[7]),
+                apply_seq=_join16(payload[8], payload[9]))
+            return
         # distcheck: ignore[DC104] deliberate wire tolerance (WIRE_SCHEMAS
-        # doc): the 5-field pre-ISSUE-7 renew stays a FULL renew —
-        # wire_open is optional, and an absent field leaves the last
-        # report standing ("didn't say" is not "healthy")
+        # doc): the 5-field pre-ISSUE-7 and 6-field pre-ISSUE-8 renews stay
+        # FULL renews — the wire-health and numerical-health tails are
+        # optional, and an absent field leaves the last report standing
+        # ("didn't say" is not "healthy")
         if code == MessageCode.LeaseRenew and payload.size >= 5:
-            n = 6 if payload.size >= 6 else 5
+            n = min(int(payload.size), 10)
             if not np.isfinite(payload[:n]).all():
                 return
             inc = _join16(payload[0], payload[1])
@@ -389,7 +523,7 @@ class Coordinator:
             member.step = int(payload[3])
             member.ewma_ms = float(payload[4])
             member.reported = True
-            if n == 6:
+            if n >= 6:
                 # wire-health field (ISSUE 7): log degraded<->healthy
                 # transitions so link trouble is a first-class decision-log
                 # event, like up/down membership
@@ -405,6 +539,16 @@ class Coordinator:
                             f"{member.kind_name} {sender} wire healthy "
                             "again (all circuits closed)")
                 member.wire_open = wire_open
+            if n >= 10:
+                # numerical-health tail (ISSUE 8): nacks drive reputation,
+                # bad_loss / loss_ewma drive the rollback watchdog
+                member.nacks = int(payload[6])
+                if member.nack_base < 0:
+                    member.nack_base = member.nacks
+                member.bad_loss = int(payload[7])
+                member.loss_ewma = float(payload[8])
+                member.gnorm_ewma = float(payload[9])
+                self._check_reputation(member, now)
             return
         # any other frame from a known member is evidence of life
         member.last_seen = now
@@ -444,6 +588,26 @@ class Coordinator:
                 f"{sorted(self._snap['expected'] - set(self._snap['got']))} "
                 f"never reported within {self.snapshot_timeout:.0f}s")
             self._snap = None
+        # --- auto-rollback watchdog + barrier driving (ISSUE 8) -----------
+        self._check_numerical_health(now)
+        if self._rollback_requested:
+            self._rollback_requested = False
+            self._start_rollback(now, "explicit trigger")
+        if (self._roll is not None
+                and now - self._roll["started"] > self.rollback_timeout):
+            missing = sorted(self._roll["expected"]
+                             - set(self._roll["got"]))
+            self._log(
+                f"rollback {self._roll['id']} ABANDONED: shards {missing} "
+                f"never reported within {self.rollback_timeout:.0f}s")
+            # the completion broadcast still goes out: member-side holds
+            # (frontends, workers) must release even on an abandoned
+            # barrier — they also carry their own TTL as the fail-open
+            self._broadcast_rollback(encode_rollback_request(
+                self._roll["id"], self._roll["snapshot_id"],
+                self._roll["map_version"], 1))
+            self.rollbacks_abandoned += 1
+            self._roll = None
         return bool(expired)
 
     def _rebalance(self, why: str) -> None:
@@ -561,6 +725,164 @@ class Coordinator:
                 f"s{r.server_id}=[{r.lo},{r.hi})@{r.apply_seq}"
                 for r in manifest.shards)
             + (f" -> {path}" if path else " (in-memory only)"))
+
+    # -------------------------------------------- numerical health (ISSUE 8)
+    def _check_reputation(self, member: MemberInfo, now: float) -> None:
+        """Revoke a worker whose admission-nack count since (re)join
+        crossed the limit. Called from the renew handler, serve thread."""
+        if (self.reputation_nacks <= 0 or member.kind != KIND_WORKER
+                or member.nack_base < 0):
+            return
+        offenses = member.nacks - member.nack_base
+        if offenses < self.reputation_nacks:
+            return
+        del self.members[member.rank]
+        self.speculated.pop(member.rank, None)
+        self._reputation_block[member.rank] = now + self.reputation_cooldown
+        self.revoked_workers += 1
+        self._log(
+            f"reputation: worker {member.rank} lease REVOKED after "
+            f"{offenses} quarantined update(s) this life — cooldown "
+            f"{self.reputation_cooldown:.1f}s, then it rejoins and pulls "
+            "fresh params")
+        self._announce()
+
+    def trigger_rollback(self) -> None:
+        """Request a fleet rollback to the last good manifest; the serve
+        thread's next tick starts the barrier. Safe from any thread."""
+        self._rollback_requested = True
+
+    def _check_numerical_health(self, now: float) -> None:
+        """The rollback watchdog: fire the barrier when any worker reports
+        nonfinite losses, or the fleet-mean loss EWMA diverges past
+        ``rollback_loss_factor`` x the best fleet-mean seen. The gate
+        (utils/health.py) stops what it can SEE; this watchdog exists for
+        the poison it cannot — norm-preserving SDC, slow divergence.
+
+        Runs every tick regardless of ``auto_rollback`` so the best-loss
+        baseline tracks the whole run's telemetry; the flag gates only the
+        FIRING. A deployment that arms the watchdog mid-run (or a scenario
+        that scripts the arming point) therefore judges divergence against
+        the true healthy baseline, not against whatever already-diverged
+        mean the first armed tick happened to see."""
+        if now < self._next_rollback_at or self._roll is not None:
+            return
+        workers = [m for m in self._live(KIND_WORKER) if m.reported]
+        if not workers:
+            return
+        why = None
+        bad = [m.rank for m in workers
+               if m.bad_loss > self._bad_loss_seen.get(m.rank, 0)]
+        if bad:
+            why = f"worker(s) {bad} report nonfinite losses"
+        else:
+            cur = [m.loss_ewma for m in workers if m.loss_ewma > 0]
+            if cur:
+                mean_loss = sum(cur) / len(cur)
+                if (self._fleet_best_loss is None
+                        or mean_loss < self._fleet_best_loss):
+                    self._fleet_best_loss = mean_loss
+                elif (self.rollback_loss_factor > 0
+                      and mean_loss > self.rollback_loss_factor
+                      * self._fleet_best_loss):
+                    why = (f"fleet loss EWMA {mean_loss:.4g} diverged past "
+                           f"{self.rollback_loss_factor:.2f}x best "
+                           f"{self._fleet_best_loss:.4g}")
+        if why is not None and self.auto_rollback:
+            self._start_rollback(now, why)
+
+    def _start_rollback(self, now: float, why: str) -> None:
+        if self._roll is not None:
+            self._log(
+                f"rollback request ignored: rollback {self._roll['id']} "
+                "still in flight")
+            return
+        manifest = self.last_manifest
+        if manifest is None:
+            self._log(f"rollback wanted ({why}) but no FleetManifest "
+                      "exists yet — nothing good to restore")
+            self._next_rollback_at = now + self.rollback_cooldown
+            return
+        if manifest.map_version != self.shard_map.version:
+            self._log(
+                f"rollback wanted ({why}) but the manifest is for map "
+                f"v{manifest.map_version}, fleet is at "
+                f"v{self.shard_map.version} — take a fresh snapshot first")
+            self._next_rollback_at = now + self.rollback_cooldown
+            return
+        shards = self._live(KIND_SHARD)
+        if not shards:
+            self._log(f"rollback wanted ({why}) but no live shard servers")
+            return
+        if self._snap is not None:
+            # a snapshot mid-rollback would capture the very state being
+            # discarded — the barrier in flight loses
+            self._log(
+                f"snapshot {self._snap['id']} aborted: rollback supersedes")
+            self._snap = None
+        self._roll_seq += 1
+        self._roll = {
+            "id": self._roll_seq,
+            "snapshot_id": int(manifest.snapshot_id),
+            "map_version": int(manifest.map_version),
+            "expected": {m.rank for m in shards},
+            "got": set(),
+            "started": now,
+        }
+        self._next_rollback_at = now + self.rollback_cooldown
+        # consume the evidence that fired this barrier: divergence must be
+        # re-established on POST-restore telemetry, not refire on echoes
+        for m in self.members.values():
+            self._bad_loss_seen[m.rank] = m.bad_loss
+            m.loss_ewma = 0.0
+        self._fleet_best_loss = None
+        self._log(
+            f"ROLLBACK {self._roll_seq} started ({why}): restoring "
+            f"snapshot {manifest.snapshot_id} / map "
+            f"v{manifest.map_version}, awaiting shards "
+            f"{sorted(self._roll['expected'])}")
+        self._broadcast_rollback(encode_rollback_request(
+            self._roll_seq, manifest.snapshot_id, manifest.map_version, 0))
+
+    def _on_rollback_done(self, sender: int, *, rollback_id: int,
+                          map_version: int, lo: int, hi: int,
+                          apply_seq: int) -> None:
+        roll = self._roll
+        if roll is None or rollback_id != roll["id"]:
+            self._log(f"stale RollbackDone from shard {sender} "
+                      f"(rollback {rollback_id})")
+            return
+        if map_version != roll["map_version"]:
+            self._log(
+                f"rollback {roll['id']}: shard {sender} reported map "
+                f"v{map_version}, barrier is at v{roll['map_version']} — "
+                "ignoring (the timeout abandons a barrier that cannot "
+                "complete)")
+            return
+        entry = self.shard_map.entry_for(sender)
+        if entry is None or (entry.lo, entry.hi) != (lo, hi):
+            self._log(
+                f"rollback {roll['id']}: shard {sender} reported range "
+                f"[{lo},{hi}) but the map assigns "
+                f"{None if entry is None else (entry.lo, entry.hi)} — "
+                "ignoring")
+            return
+        roll["got"].add(sender)
+        self._log(
+            f"rollback {roll['id']}: shard {sender} restored "
+            f"[{lo},{hi}) at apply seq {apply_seq}")
+        if roll["expected"] <= roll["got"]:
+            now = self._clock()
+            mttr = now - roll["started"]
+            self.rollbacks_done += 1
+            self.rollback_mttrs.append(mttr)
+            self._log(
+                f"ROLLBACK {roll['id']} complete in {mttr * 1e3:.0f} ms: "
+                f"fleet restored to snapshot {roll['snapshot_id']} — "
+                "workers resync by pull, frontends re-admit")
+            self._broadcast_rollback(encode_rollback_request(
+                roll["id"], roll["snapshot_id"], roll["map_version"], 1))
+            self._roll = None
 
     # ------------------------------------------------------- engine scaling
     def check_engine_scaling(self, now: Optional[float] = None) -> Optional[str]:

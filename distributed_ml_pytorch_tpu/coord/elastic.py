@@ -36,6 +36,7 @@ accumulated update under that task id.
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 import time
@@ -70,6 +71,8 @@ class ElasticShardServer:
         ckpt_every: int = 500,
         wal: bool = False,
         wal_group_n: int = 8,
+        admission=None,
+        manifest_path: Optional[str] = None,
     ):
         self.server_id = int(server_id)
         self.n_params = int(n_params)
@@ -91,7 +94,10 @@ class ElasticShardServer:
             params=np.zeros(1, np.float32), transport=transport,
             ckpt_dir=ckpt_dir, ckpt_every=ckpt_every,
             staleness_damping=staleness_damping, wal=wal,
-            wal_group_n=wal_group_n)
+            wal_group_n=wal_group_n, admission=admission)
+        #: where the coordinator publishes its FleetManifest — the rollback
+        #: barrier (ISSUE 8) needs it to restore the last good snapshot
+        self.manifest_path = manifest_path
         self._seen_tasks: set = set()
         #: snapshot-barrier mailbox: the coord listener thread deposits the
         #: (snapshot_id, map_version) request here; the serve loop takes it
@@ -99,12 +105,18 @@ class ElasticShardServer:
         #: barrier's "checkpoint at your next boundary" semantics
         self._snap_mu = threading.Lock()
         self._snap_req: Optional[tuple] = None
+        #: rollback-barrier mailbox (ISSUE 8), same discipline as the
+        #: snapshot mailbox: the coord listener parks the request, the
+        #: serve loop executes it at its next version boundary
+        self._roll_req: Optional[int] = None
         if getattr(coord, "on_snapshot", None) is None:
             coord.on_snapshot = self._note_snapshot
+        if getattr(coord, "on_rollback", None) is None:
+            coord.on_rollback = self._note_rollback
         self.stats = {
             "stale_dropped": 0, "parked_pulls": 0, "installs": 0,
             "dup_installs": 0, "spec_applied": 0, "spec_dropped": 0,
-            "resizes": 0,
+            "resizes": 0, "rollbacks": 0, "rolled_back_updates": 0,
         }
         #: guards the served state (range bounds, ps.central, stats) —
         #: the serve loop resizes and applies on its thread while demos,
@@ -221,6 +233,82 @@ class ElasticShardServer:
             push_count = self.ps._push_count
         self.coord.snapshot_done(
             snapshot_id, mv, lo, hi, apply_seq, push_count)
+
+    def _note_rollback(self, rollback_id: int, phase: int) -> None:
+        """Coord-listener-thread callback: park a phase-0 barrier request
+        for the serve loop (newest wins; phase 1 is informational here —
+        this server either restored and reported, or deliberately did
+        not)."""
+        if phase != 0:
+            return
+        with self._snap_mu:
+            self._roll_req = int(rollback_id)
+
+    def _take_rollback_request(self) -> Optional[int]:
+        with self._snap_mu:
+            req, self._roll_req = self._roll_req, None
+            return req
+
+    def _do_rollback(self, rollback_id: int) -> None:
+        """The shard half of the rollback barrier (ISSUE 8): load the last
+        good FleetManifest, restore this range to its snapshot IN PLACE
+        (checkpoint + WAL replay capped at the promised apply seq, WAL tail
+        dropped), and report. Mismatches and missing prerequisites are
+        LOUD no-ops — the coordinator's barrier timeout owns abandoning a
+        rollback this server cannot honor."""
+        from distributed_ml_pytorch_tpu.coord.manifest import (
+            FleetManifest,
+            ManifestError,
+        )
+
+        if not self.manifest_path or not os.path.exists(self.manifest_path):
+            print(
+                f"shard {self.server_id}: rollback {rollback_id} refused — "
+                f"no manifest at {self.manifest_path!r}", file=sys.stderr)
+            return
+        try:
+            manifest = FleetManifest.load(self.manifest_path)
+        except (ManifestError, ValueError, OSError) as e:
+            print(
+                f"shard {self.server_id}: rollback {rollback_id} refused — "
+                f"manifest unusable: {e}", file=sys.stderr)
+            return
+        with self._mu:
+            entry = manifest.entry_for(self.server_id)
+            if entry is None:
+                print(
+                    f"shard {self.server_id}: rollback {rollback_id} "
+                    "refused — manifest has no entry for this server",
+                    file=sys.stderr)
+                return
+            if (manifest.map_version != self.map_version
+                    or (entry.lo, entry.hi) != (self.lo, self.hi)):
+                print(
+                    f"shard {self.server_id}: rollback {rollback_id} "
+                    f"refused — manifest is map v{manifest.map_version} "
+                    f"[{entry.lo},{entry.hi}), this server serves "
+                    f"v{self.map_version} [{self.lo},{self.hi})",
+                    file=sys.stderr)
+                return
+            try:
+                discarded = self.ps.rollback_restore(entry.apply_seq)
+            except (ValueError, OSError) as e:
+                print(
+                    f"shard {self.server_id}: rollback {rollback_id} "
+                    f"FAILED: {e}", file=sys.stderr)
+                return
+            self.stats["rollbacks"] += 1
+            self.stats["rolled_back_updates"] += discarded
+            # a rollback is authoritative like a manifest restore: nothing
+            # awaits install, and a stale RangeInstall must not stomp it
+            self.pending_install = None
+            mv, lo, hi = self.map_version, self.lo, self.hi
+            apply_seq = self.ps._apply_seq
+        print(
+            f"shard {self.server_id}: rolled back [{lo},{hi}) to snapshot "
+            f"{manifest.snapshot_id} (apply seq {apply_seq}, {discarded} "
+            "update(s) discarded)", file=sys.stderr)
+        self.coord.rollback_done(rollback_id, mv, lo, hi, apply_seq)
 
     def restore_from_manifest(self, manifest) -> None:
         """Disaster recovery (ISSUE 5): re-install this shard's range from
@@ -360,6 +448,17 @@ class ElasticShardServer:
             m = self.coord.take_shard_map()
             if m is not None:
                 self._apply_map(m)
+            roll = self._take_rollback_request()
+            if roll is not None:
+                # a parked snapshot loses to a parked rollback — the shard
+                # half of the coordinator's supersede rule ("snapshot
+                # aborted: rollback supersedes"). Running the snapshot
+                # first would checkpoint the very state being discarded
+                # at an apply seq AHEAD of the rollback target, and
+                # rollback_restore would (correctly) refuse — the barrier
+                # could then never complete on this shard.
+                self._take_snapshot_request()
+                self._do_rollback(roll)
             snap = self._take_snapshot_request()
             if snap is not None:
                 self._do_snapshot(*snap)

@@ -42,6 +42,7 @@ from distributed_ml_pytorch_tpu.coord.coordinator import (
     encode_join,
     encode_leave,
     encode_renew,
+    encode_rollback_done,
     encode_snapshot_done,
 )
 from distributed_ml_pytorch_tpu.coord.shardmap import ShardMap
@@ -61,6 +62,11 @@ class FleetView:
     def __init__(self):
         self._lock = threading.Lock()
         self._state: Optional[dict] = None
+        #: monotonic instant until which a rollback barrier holds (ISSUE 8):
+        #: set on RollbackRequest phase 0, cleared on phase 1 — and bounded
+        #: by a TTL either way, so a LOST completion broadcast fails OPEN
+        #: (admission resumes) instead of wedging the frontend forever
+        self._rollback_until = 0.0
 
     def update(self, state: dict) -> None:
         with self._lock:
@@ -92,6 +98,21 @@ class FleetView:
         s = self.state
         return s is not None and s["workers_done"]
 
+    def note_rollback(self, active: bool, ttl: float = 15.0) -> None:
+        """Record a rollback-barrier phase transition (ISSUE 8). ``active``
+        holds admission for at most ``ttl`` seconds — the fail-open bound
+        for a completion frame that never arrives."""
+        with self._lock:
+            self._rollback_until = (time.monotonic() + float(ttl)
+                                    if active else 0.0)
+
+    def rollback_active(self) -> bool:
+        """True while a PS-fleet rollback barrier is in flight — serving
+        frontends hold new submits through the same hold-and-readmit path
+        they use for engine loss (``serving/frontend.py``)."""
+        with self._lock:
+            return time.monotonic() < self._rollback_until
+
 
 class CoordClient:
     """One member's connection to the coordinator (see module docstring)."""
@@ -106,6 +127,8 @@ class CoordClient:
         on_shard_map: Optional[Callable[[ShardMap], None]] = None,
         on_speculate: Optional[Callable[[int, int, int], None]] = None,
         on_snapshot: Optional[Callable[[int, int], None]] = None,
+        on_rollback: Optional[Callable[[int, int], None]] = None,
+        rollback_hold_ttl: float = 15.0,
     ):
         if kind not in _KINDS:
             raise ValueError(f"kind must be one of {sorted(_KINDS)}, got {kind!r}")
@@ -127,13 +150,21 @@ class CoordClient:
         #: with ``(snapshot_id, map_version)`` on the listener thread,
         #: outside any client lock
         self.on_snapshot = on_snapshot
+        #: PUBLIC and mutable like on_snapshot: the data-plane consumer
+        #: (ShardedAsynchronous, ElasticShardServer) wires its rollback
+        #: mailbox in by assignment; called with ``(rollback_id, phase)``
+        #: on the listener thread (phase 0 = start, 1 = complete/abandoned)
+        self.on_rollback = on_rollback
+        self.rollback_hold_ttl = float(rollback_hold_ttl)
         self._lock = threading.Lock()
         self._latest_map: Optional[ShardMap] = None
         self._current_version = -1
         self._got_map = threading.Event()
-        #: (push_count, step, ewma_ms, wire_open) — wire_open is the
-        #: member's open-circuit-breaker count (ISSUE 7 wire health)
-        self._progress = (0, 0, 0.0, 0)
+        #: (push_count, step, ewma_ms, wire_open, nacks, bad_loss,
+        #: loss_ewma, gnorm_ewma) — wire_open is the member's open-circuit-
+        #: breaker count (ISSUE 7); the last four are the numerical-health
+        #: telemetry (ISSUE 8)
+        self._progress = (0, 0, 0.0, 0, 0, 0, 0.0, 0.0)
         self._stop = threading.Event()
         self._listener = threading.Thread(
             target=self._pump, name="coord-listener", daemon=True)
@@ -186,14 +217,27 @@ class CoordClient:
                 self.on_snapshot(
                     _join16(payload[0], payload[1]),
                     _join16(payload[2], payload[3]))
+        elif code == MessageCode.RollbackRequest and payload.size >= 7:
+            if not np.isfinite(payload[:7]).all():
+                return
+            rollback_id = _join16(payload[0], payload[1])
+            phase = int(payload[6])
+            # the fleet view carries the hold for serving frontends; the
+            # data-plane consumer (shard server / worker) reacts via its
+            # own mailbox callback
+            self.fleet.note_rollback(phase == 0, ttl=self.rollback_hold_ttl)
+            if self.on_rollback is not None:
+                self.on_rollback(rollback_id, phase)
 
     def _renew_loop(self) -> None:
         tick = 0
         while not self._stop.wait(self.renew_interval):
             with self._lock:
-                push_count, step, ewma_ms, wire_open = self._progress
+                (push_count, step, ewma_ms, wire_open, nacks, bad_loss,
+                 loss_ewma, gnorm_ewma) = self._progress
             self._send(MessageCode.LeaseRenew, encode_renew(
-                self.incarnation, push_count, step, ewma_ms, wire_open))
+                self.incarnation, push_count, step, ewma_ms, wire_open,
+                nacks, bad_loss, loss_ewma, gnorm_ewma))
             tick += 1
             if tick % 4 == 0:
                 # periodic re-JOIN: the coordinator ignores frames from
@@ -218,15 +262,20 @@ class CoordClient:
         return self.current_map()
 
     def report(self, push_count: int, step: int, ewma_ms: float,
-               wire_open: int = 0) -> None:
+               wire_open: int = 0, nacks: int = 0, bad_loss: int = 0,
+               loss_ewma: float = 0.0, gnorm_ewma: float = 0.0) -> None:
         """Stash this member's latest progress; the renew thread ships it
         (written under the client lock so the renew thread never reads a
         torn tuple — distcheck DC205). ``wire_open`` is the member's open
         circuit-breaker count (``ReliableTransport.open_breakers()``): the
-        coordinator's lease view then shows WHOSE wire is degraded."""
+        coordinator's lease view then shows WHOSE wire is degraded. The
+        numerical-health tail (ISSUE 8): cumulative admission ``nacks``
+        received, ``bad_loss`` nonfinite-loss observations, and the loss /
+        grad-norm EWMAs — the reputation + rollback-watchdog inputs."""
         with self._lock:
             self._progress = (int(push_count), int(step), float(ewma_ms),
-                              int(wire_open))
+                              int(wire_open), int(nacks), int(bad_loss),
+                              float(loss_ewma), float(gnorm_ewma))
 
     def current_map(self) -> Optional[ShardMap]:
         with self._lock:
@@ -243,6 +292,12 @@ class CoordClient:
         """Report this shard's completed checkpoint into the barrier."""
         self._send(MessageCode.SnapshotDone, encode_snapshot_done(
             snapshot_id, map_version, lo, hi, apply_seq, push_count))
+
+    def rollback_done(self, rollback_id: int, map_version: int, lo: int,
+                      hi: int, apply_seq: int) -> None:
+        """Report this shard's completed in-place rollback (ISSUE 8)."""
+        self._send(MessageCode.RollbackDone, encode_rollback_done(
+            rollback_id, map_version, lo, hi, apply_seq))
 
     def leave(self) -> None:
         self._send(MessageCode.CoordLeave, encode_leave(self.incarnation))

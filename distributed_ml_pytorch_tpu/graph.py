@@ -35,7 +35,7 @@ def _load_runs(log_dir: str):
     return runs
 
 
-def make_graphs(log_dir: str = "log", out_dir: str = ".") -> list:
+def make_graphs(log_dir: str = "runs", out_dir: str = ".") -> list:
     import matplotlib
 
     matplotlib.use("Agg")
@@ -84,7 +84,7 @@ def make_graphs(log_dir: str = "log", out_dir: str = ".") -> list:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="Plot train/test curves from CSV logs")
-    p.add_argument("--log-dir", default="log")
+    p.add_argument("--log-dir", default="runs")
     p.add_argument("--out-dir", default=".")
     args = p.parse_args(argv)
     for path in make_graphs(args.log_dir, args.out_dir):

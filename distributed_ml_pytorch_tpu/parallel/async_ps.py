@@ -44,6 +44,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_ml_pytorch_tpu.utils.durability import atomic_write
+from distributed_ml_pytorch_tpu.utils.health import (
+    admission_from_args as _admission_from_args,
+)
 from distributed_ml_pytorch_tpu.utils.messaging import (
     SERVER_RANK,
     MessageCode,
@@ -84,6 +87,7 @@ class ParameterServer:
         staleness_damping: float = 0.0,
         wal: bool = False,
         wal_group_n: int = 8,
+        admission=None,
     ):
         if params is not None:
             self.central = np.asarray(params, dtype=np.float32).copy()
@@ -104,6 +108,21 @@ class ParameterServer:
         self._push_count = 0
         self._restored = False
         self.rejected_installs = 0
+        # --- numerical health plane (ISSUE 8) ---------------------------
+        #: admission gate (``utils/health.GradientAdmission`` or None):
+        #: every GradientUpdate passes finiteness + per-worker norm-outlier
+        #: checks BEFORE any accounting or WAL append; rejects are
+        #: quarantined with an explicit UpdateNack — never a silent drop,
+        #: never a WAL record (a logged poisoned record would be replayed
+        #: on every recovery, forever)
+        self.admission = admission
+        self.quarantined = 0
+        self.quarantined_by_sender: dict = {}
+        self.nacks_sent = 0
+        #: most recent quarantine verdicts (sender, reason, norm, z)
+        self.quarantine: "collections.deque" = None  # set below (needs import)
+        #: applied updates discarded by coordinator-driven rollbacks
+        self.rolled_back_updates = 0
         # --- durability plane (ISSUE 5) ---------------------------------
         #: this server LIFE's incarnation stamp (WAL records carry it so a
         #: dead life's late-flushed tail is detectable on replay)
@@ -125,6 +144,7 @@ class ParameterServer:
         import collections
 
         self._recent_envelopes = collections.deque(maxlen=512)
+        self.quarantine = collections.deque(maxlen=64)
         #: (incarnation, seq) of the reliability envelope that delivered
         #: the frame being handled (run() stashes transport.last_delivery
         #: here) — recorded per WAL record for restart-time dedup seeding
@@ -225,6 +245,51 @@ class ParameterServer:
                 ack()
             self.wal.truncate(self._apply_seq)
 
+    def _read_checkpoint(self):
+        """Load the on-disk (vector, meta) pair with the full tear-window
+        resolution and CRC cross-check (shared by :meth:`maybe_restore` and
+        :meth:`rollback_restore`). Raises on size mismatch or real
+        corruption; the caller owns adopting the result."""
+        import io
+        import json
+        import os
+        import zlib
+
+        path = self._ckpt_path()
+        with open(path, "rb") as f:
+            blob = f.read()
+        arr = np.load(io.BytesIO(blob))
+        if arr.shape != self.central.shape:
+            raise ValueError(
+                f"checkpoint at {path} holds {arr.shape[0]} params but "
+                f"the model ravels to {self.central.shape[0]} — wrong "
+                "--model?"
+            )
+        meta = None
+        if os.path.exists(self._meta_path()):
+            with open(self._meta_path()) as f:
+                meta = json.load(f)
+        if meta is not None and "central_crc" in meta:
+            crc = zlib.crc32(blob) & 0xFFFFFFFF
+            if crc != int(meta["central_crc"]):
+                prev = meta.get("prev")
+                if prev is not None and int(prev.get("central_crc", -1)) == crc:
+                    # the tear window: the new meta landed, the vector
+                    # rename did not — the on-disk vector IS the
+                    # previous generation; adopt its matching clock
+                    # (the WAL still holds the gap's updates)
+                    _LOGGER.warning(
+                        "checkpoint meta is one generation ahead of the "
+                        "vector (crash between renames) — restoring the "
+                        "previous consistent generation")
+                    meta = prev
+                else:
+                    raise ValueError(
+                        f"checkpoint at {path} matches neither its meta "
+                        "CRC nor the previous generation's — refusing "
+                        "to resume with an unverifiable staleness clock")
+        return arr.astype(np.float32), meta
+
     def maybe_restore(self) -> bool:
         """Adopt the saved central vector + clock and replay the WAL past
         it; False if nothing restorable exists. Failure modes are LOUD: a
@@ -234,48 +299,13 @@ class ParameterServer:
         staleness clock) while claiming to resume is the one wrong answer."""
         if not self.ckpt_dir:
             return False
-        import json
         import os
-        import zlib
 
         path = self._ckpt_path()
         restored = False
         if os.path.exists(path):
-            with open(path, "rb") as f:
-                blob = f.read()
-            import io
-
-            arr = np.load(io.BytesIO(blob))
-            if arr.shape != self.central.shape:
-                raise ValueError(
-                    f"checkpoint at {path} holds {arr.shape[0]} params but "
-                    f"the model ravels to {self.central.shape[0]} — wrong "
-                    "--model?"
-                )
-            meta = None
-            if os.path.exists(self._meta_path()):
-                with open(self._meta_path()) as f:
-                    meta = json.load(f)
-            if meta is not None and "central_crc" in meta:
-                crc = zlib.crc32(blob) & 0xFFFFFFFF
-                if crc != int(meta["central_crc"]):
-                    prev = meta.get("prev")
-                    if prev is not None and int(prev.get("central_crc", -1)) == crc:
-                        # the tear window: the new meta landed, the vector
-                        # rename did not — the on-disk vector IS the
-                        # previous generation; adopt its matching clock
-                        # (the WAL still holds the gap's updates)
-                        _LOGGER.warning(
-                            "checkpoint meta is one generation ahead of the "
-                            "vector (crash between renames) — restoring the "
-                            "previous consistent generation")
-                        meta = prev
-                    else:
-                        raise ValueError(
-                            f"checkpoint at {path} matches neither its meta "
-                            "CRC nor the previous generation's — refusing "
-                            "to resume with an unverifiable staleness clock")
-            self.central = arr.astype(np.float32)
+            arr, meta = self._read_checkpoint()
+            self.central = arr
             if meta is not None:
                 self.staleness.version = int(meta.get("version", 0))
                 self._push_count = int(meta.get("push_count", 0))
@@ -338,6 +368,80 @@ class ParameterServer:
             seed(envelopes)
         return n
 
+    def rollback_restore(self, target_seq: int) -> int:
+        """In-place rollback (ISSUE 8): discard the live state and rebuild
+        it as *checkpoint + WAL replay capped at* ``target_seq`` — the
+        apply seq the coordinator's last good :class:`FleetManifest`
+        promises. Returns how many applied updates were discarded.
+
+        Unlike the drill's restore path this runs on a LIVE server (no
+        process death): the transport and its dedup/ack state survive, so
+        no reseeding happens. Deferred delivery acks are released first —
+        delivery DID happen; the discard below is the explicit,
+        coordinator-logged decision, not a loss. The WAL tail past the
+        target is dropped (``WriteAheadLog.drop_after``) so the rolled-back
+        updates cannot resurrect on a later crash-restore.
+
+        Refuses LOUDLY when the on-disk checkpoint is already AHEAD of the
+        target (a later generation overwrote the barrier's state — rolling
+        "back" to it would silently keep the suspect updates)."""
+        if not self.ckpt_dir:
+            raise ValueError("rollback_restore needs a ckpt_dir")
+        import os
+
+        target_seq = int(target_seq)
+        self.commit()  # release withheld acks before discarding their state
+        if not os.path.exists(self._ckpt_path()):
+            raise ValueError(
+                f"rollback to apply seq {target_seq} impossible: no "
+                f"checkpoint under {self.ckpt_dir!r}")
+        before_seq = self._apply_seq
+        arr, meta = self._read_checkpoint()
+        ckpt_seq = int(meta.get("apply_seq", 0)) if meta is not None else 0
+        if ckpt_seq > target_seq:
+            raise ValueError(
+                f"rollback target apply seq {target_seq} is BEHIND the "
+                f"on-disk checkpoint ({ckpt_seq}) — a later checkpoint "
+                "overwrote the snapshot generation; refusing to fake a "
+                "rollback that keeps the suspect updates")
+        self.central = arr
+        if meta is not None:
+            self.staleness.version = int(meta.get("version", 0))
+            self._push_count = int(meta.get("push_count", 0))
+            self._apply_seq = ckpt_seq
+            self.applied_by_sender = {
+                int(k): int(v)
+                for k, v in meta.get("applied_by_sender", {}).items()}
+        else:
+            self._apply_seq = 0
+        replayed = 0
+        if self.wal is not None:
+            records, _stats = self.wal.replay()
+            for rec in records:
+                if rec.seq <= self._apply_seq or rec.seq > target_seq:
+                    continue
+                if rec.payload.shape != self.central.shape:
+                    raise ValueError(
+                        f"WAL record seq {rec.seq} holds "
+                        f"{rec.payload.shape[0]} params but the restored "
+                        f"vector holds {self.central.shape[0]}")
+                self.central += rec.payload
+                self._apply_seq = rec.seq
+                self._push_count += 1
+                self.staleness.version += 1
+                self.applied_by_sender[rec.sender] = (
+                    self.applied_by_sender.get(rec.sender, 0) + 1)
+                replayed += 1
+            self.wal.drop_after(target_seq)
+        discarded = max(0, before_seq - self._apply_seq)
+        self.rolled_back_updates += discarded
+        self._restored = True
+        _LOGGER.warning(
+            "rollback: restored apply seq %d (ckpt %d + %d WAL records), "
+            "DISCARDED %d applied update(s) past the good snapshot",
+            self._apply_seq, ckpt_seq, replayed, discarded)
+        return discarded
+
     def commit(self) -> None:
         """Group commit: fsync the WAL batch, then release the delivery
         acks deferred behind it (``ReliableTransport.ack_delivered``) —
@@ -363,6 +467,15 @@ class ParameterServer:
                     "%d (wrong model / stale partition?)", sender,
                     payload.shape[0], self.central.shape[0])
                 return
+            if self.admission is not None:
+                # the admission gate (ISSUE 8) runs BEFORE accounting and
+                # BEFORE the WAL append: a quarantined update must not
+                # inflate the apply clock nor enter the log (a logged
+                # poisoned record would be replayed on every restore)
+                verdict = self.admission.evaluate(sender, payload)
+                if verdict is not None:
+                    self._quarantine_update(sender, verdict)
+                    return
             # workers pre-scale by -lr (Asynchronous.py:55) → server-side add
             staleness = self.staleness.on_push(sender)
             if self.staleness_damping > 0.0 and staleness > 0:
@@ -414,6 +527,43 @@ class ParameterServer:
                 self._reply(sender, self.central)
             else:
                 self.central = payload.astype(np.float32).copy()
+
+    def _quarantine_update(self, sender: int, verdict) -> None:
+        """Record one rejected update and tell the worker EXPLICITLY.
+
+        The ``UpdateNack`` frame (reason + clamped norm/z) is what keeps a
+        reject from being a silent drop: the worker counts it, resyncs by
+        pulling fresh params, and reports the count in its lease renewals
+        (the coordinator's reputation input). The update itself never
+        touches the central vector, the apply clock, or the WAL."""
+        from distributed_ml_pytorch_tpu.utils.health import (
+            NACK_REASONS,
+            clamp_finite32,
+        )
+
+        reason, norm, z = verdict
+        self.quarantined += 1
+        self.quarantined_by_sender[sender] = (
+            self.quarantined_by_sender.get(sender, 0) + 1)
+        self.quarantine.append((sender, int(reason), float(norm), float(z)))
+        _LOGGER.warning(
+            "quarantined GradientUpdate #%d from worker %d: %s "
+            "(norm %.3g, z %.2f) — nacking",
+            self.quarantined_by_sender[sender], sender,
+            NACK_REASONS.get(int(reason), reason), norm, z)
+        # the wire carries float32: clamp inf norms (the very thing being
+        # rejected) so the nack itself survives the receivers' finite guards
+        frame = np.asarray(
+            [float(reason), clamp_finite32(norm), clamp_finite32(z)],
+            np.float32)
+        try:
+            send_message(MessageCode.UpdateNack, frame, dst=sender,
+                         transport=self.transport)
+            self.nacks_sent += 1
+        except (OSError, ConnectionError, KeyError):
+            _LOGGER.warning(
+                "UpdateNack to worker %d failed (peer gone?) — the "
+                "quarantine stands; its next pull resyncs it anyway", sender)
 
     def _reply(self, sender: int, payload: np.ndarray) -> None:
         """Answer one worker; a worker that died between its request and
@@ -691,6 +841,11 @@ class Listener(MessageListener):
         #: unversioned ParameterUpdate
         self._latest_stamp: Optional[Tuple[int, int, int]] = None
         self._got_update = threading.Event()
+        #: admission nacks (ISSUE 8): total received, and the batch not yet
+        #: consumed by the optimizer (``take_nacks`` — each consumed batch
+        #: triggers ONE resync pull, not one per frame)
+        self.nacks = 0
+        self._nacks_pending = 0
 
     def receive(self, sender: int, message_code: MessageCode, parameter: np.ndarray) -> None:
         _LOGGER.info("Processing message: %s", message_code.name)
@@ -711,6 +866,14 @@ class Listener(MessageListener):
                     _join16(parameter[2], parameter[3]),
                     _join16(parameter[4], parameter[5]))
             self._got_update.set()
+        elif message_code == MessageCode.UpdateNack:
+            # the server QUARANTINED one of this worker's pushes (admission
+            # gate, ISSUE 8): count it — the optimizer resyncs by pulling
+            # fresh params instead of silently diverging
+            if parameter.size >= 3 and np.isfinite(parameter[:1]).all():
+                with self._lock:
+                    self.nacks += 1
+                    self._nacks_pending += 1
 
     def take_latest(self) -> Optional[np.ndarray]:
         with self._lock:
@@ -727,6 +890,13 @@ class Listener(MessageListener):
             latest, self._latest = self._latest, None
             stamp, self._latest_stamp = self._latest_stamp, None
         return stamp, latest
+
+    def take_nacks(self) -> int:
+        """Unconsumed admission nacks since the last take (the optimizer's
+        resync trigger)."""
+        with self._lock:
+            n, self._nacks_pending = self._nacks_pending, 0
+            return n
 
     def wait_for_update(self, timeout: float) -> bool:
         """Block until at least one ParameterUpdate has ever arrived (it may
@@ -901,6 +1071,16 @@ class Asynchronous:
         # progress; the optimizer only consults its peer_down flag.
         self.server_down = False
         self.heartbeat = heartbeat
+        #: admission nacks consumed so far (ISSUE 8) — each batch triggers
+        #: a resync pull toward the server
+        self.nacks = 0
+        #: post-nack hold (same discipline as ShardedAsynchronous): device
+        #: updates are skipped from the nack until one step after the
+        #: fresh pull installs — grads derived from the diverged params
+        #: must not stomp the resync install, or the loop never converges
+        #: (install, stomp, explode, nack, repeat)
+        self._hold_updates = False
+        self.skipped_updates = 0
 
         self._device_step = make_downpour_device_step(self.tx, self._pad)
         self._flusher = PushFlusher(
@@ -930,6 +1110,22 @@ class Asynchronous:
             file=sys.stderr,
         )
 
+    def _resync_on_nacks(self) -> None:
+        """The nack response (ISSUE 8): a quarantined push means this
+        worker's view may be diverging from the central params it can no
+        longer influence — pull fresh ones NOW instead of waiting out the
+        cadence. One resync per consumed batch, not per frame."""
+        n = self.listener.take_nacks()
+        if n:
+            self.nacks += n
+            self._hold_updates = True
+            print(
+                f"worker: {n} push(es) quarantined by the server's "
+                "admission gate — resyncing with a fresh pull",
+                file=sys.stderr,
+            )
+            self._send(MessageCode.ParameterRequest, np.zeros(0, np.float32))
+
     def boundary(self, gap: int) -> Optional[np.ndarray]:
         """Host-side communication for inter-step gap ``gap`` (the point
         between step ``gap − 1`` and step ``gap``) — the chunked dispatch
@@ -945,18 +1141,32 @@ class Asynchronous:
             # flusher thread while the caller dispatches the next chunk
             self._flusher.enqueue(self.accum[: self._flat_n])
             self.accum = jnp.zeros_like(self.accum)
+        self._resync_on_nacks()
         latest = self.listener.take_latest()
+        if latest is not None:
+            # chunked dispatch folds updates ON DEVICE inside the chunk, so
+            # the post-nack hold cannot skip them from here — the install
+            # at the next chunk boundary is the resync (the stomp window is
+            # bounded by one chunk); clear the flag so it cannot go stale
+            self._hold_updates = False
         if gap % self.n_pull == 0:
             self._send(MessageCode.ParameterRequest, np.zeros(0, np.float32))
         self.idx = gap
         return latest
 
     def step(self, params: Pytree, grads: Pytree) -> Pytree:
+        self._resync_on_nacks()
+        # decide the skip BEFORE this step's install lands: even on the
+        # step that completes the resync, the grads in hand were computed
+        # on the pre-install params and must not apply over it
+        held = self._hold_updates
         # install the freshest server push at the step boundary (race-free
         # version of the reference's mid-step unravel, Asynchronous.py:17-18)
         latest = self.listener.take_latest()
         if latest is not None:
             params = self.unravel(jnp.asarray(latest))
+            if held:
+                self._hold_updates = False  # updates resume NEXT step
 
         # request fresh params every n_pull steps (:48-49); the reference
         # ships the accumulator as a dummy payload — an empty payload is the
@@ -964,9 +1174,12 @@ class Asynchronous:
         if self.idx % self.n_pull == 0:
             self._send(MessageCode.ParameterRequest, np.zeros(0, np.float32))
 
-        params, self.opt_state, self.accum = self._device_step(
-            params, self.opt_state, grads, self.accum
-        )
+        if held:
+            self.skipped_updates += 1
+        else:
+            params, self.opt_state, self.accum = self._device_step(
+                params, self.opt_state, grads, self.accum
+            )
 
         # push the accumulated updates every n_push steps (:58-60), via the
         # flusher so the fetch+wire overlap the next step's dispatch
@@ -1060,7 +1273,9 @@ def train_worker(
         return jax.value_and_grad(loss_fn)(p)
 
     eval_step = make_eval_fn(model)
-    logger = MetricsLogger(getattr(args, "log_dir", "log"))
+    # worker CSVs default to an untracked run directory (ISSUE 8 satellite:
+    # the tracked log/node*.csv churn is gone; `runs/` is .gitignored)
+    logger = MetricsLogger(getattr(args, "log_dir", "runs"))
 
     # chunked dispatch (VERDICT r2 #2): on TPU the per-batch dispatch over
     # the tunnel — not the DownPour protocol — dominated the PS worker
@@ -1105,6 +1320,8 @@ def train_worker(
             def flush():
                 for rel0, dev_losses, eval_is, ev in pending:
                     for off, loss in enumerate(np.asarray(dev_losses)):
+                        if hasattr(opt, "observe_loss"):
+                            opt.observe_loss(float(loss))
                         i = rel0 + off
                         rec_extra = (
                             {"test_loss": ev[0], "test_accuracy": ev[1]}
@@ -1164,6 +1381,10 @@ def train_worker(
                 loss, grads = grad_fn(params, bx, by, dropout_rng, opt.idx)
                 params = opt.step(params, grads)
                 loss = float(loss)  # block: bounds the trace to this step
+                if hasattr(opt, "observe_loss"):
+                    # health telemetry (ISSUE 8): the loss EWMA + nonfinite
+                    # count ride the coordinator lease renewals
+                    opt.observe_loss(loss)
                 tracer.after_step(opt.idx)
                 rec_extra = {}
                 if i % args.log_interval == 0 and i > 0:
@@ -1209,6 +1430,7 @@ def run_server(args, transport: Transport) -> ParameterServer:
         ckpt_every=getattr(args, "ckpt_every", 500),
         staleness_damping=getattr(args, "staleness_damping", 0.0),
         wal=getattr(args, "wal", False),
+        admission=_admission_from_args(args),
     )
     if getattr(args, "resume", False) and server.maybe_restore():
         print("parameter server: resumed central params from", server._ckpt_path())

@@ -365,14 +365,30 @@ class ServingFrontend:
                     self._send_frame(route, start=n_have,
                                      tokens=toks[n_have:], done=done)
 
+    def _fleet_holding(self) -> bool:
+        """True while new submits must be HELD rather than admitted: the
+        coordinator reports the engine fleet down, OR a PS-fleet rollback
+        barrier is in flight (ISSUE 8 — the same hold-and-readmit path:
+        admitting work against params mid-restore would serve the very
+        state being discarded). The rollback hold fails OPEN via the
+        FleetView's TTL, so a lost completion broadcast can never wedge
+        admission forever."""
+        if self.fleet is None:
+            return False
+        if not self.fleet.engine_up():
+            return True
+        rollback = getattr(self.fleet, "rollback_active", None)
+        return bool(rollback()) if rollback is not None else False
+
     def _on_submit(self, sender: int, code: MessageCode, payload: np.ndarray,
                    now: float, arrived: float) -> None:
         """One submit frame (fresh from the wire, or re-admitted from the
         held queue with its ORIGINAL arrival time)."""
-        if self.fleet is not None and not self.fleet.engine_up():
-            # engine loss (coordinator's fleet view): queue-or-reject.
-            # Held submits re-enter via the sweep on recovery; the
-            # client's stream() just sees added latency, not an error.
+        if self._fleet_holding():
+            # engine loss or rollback barrier (coordinator's fleet view):
+            # queue-or-reject. Held submits re-enter via the sweep on
+            # recovery; the client's stream() just sees added latency,
+            # not an error.
             with self._held_lock:
                 held_room = len(self._held) < self.hold_queue
                 if held_room:
@@ -563,8 +579,9 @@ class ServingFrontend:
         self._send_frame(route, start=start, tokens=new_tokens, done=done)
 
     def _readmit_held(self) -> None:
-        """Re-admit submits held across an engine outage (arrival order)."""
-        if self.fleet is not None and not self.fleet.engine_up():
+        """Re-admit submits held across an engine outage or a rollback
+        barrier (arrival order)."""
+        if self._fleet_holding():
             return
         with self._held_lock:
             held, self._held = self._held, []

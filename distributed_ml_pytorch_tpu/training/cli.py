@@ -91,7 +91,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "runs never stall on a dead network)")
     p.add_argument("--synthetic-train-size", type=int, default=50000)
     p.add_argument("--synthetic-test-size", type=int, default=10000)
-    p.add_argument("--log-dir", type=str, default="log")
+    p.add_argument("--log-dir", type=str, default="runs",
+                   help="worker CSV telemetry directory (default an "
+                        "UNTRACKED run directory — the old tracked "
+                        "log/node*.csv churn is gone; both log/ and runs/ "
+                        "are .gitignored)")
     p.add_argument("--transport", type=str, default="auto",
                    choices=["auto", "native", "python"],
                    help="PS control-plane transport: C++ library "
@@ -123,6 +127,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "reliability envelope); recovery = restore "
                         "checkpoint + replay the log, so no acked "
                         "GradientUpdate can be lost to a crash")
+    p.add_argument("--admission", action="store_true", default=False,
+                   help="PS server: numerical admission gate (ISSUE 8) — "
+                        "every GradientUpdate/ShardPush passes finiteness "
+                        "+ per-worker EWMA norm-outlier checks BEFORE "
+                        "accounting/WAL; rejects are quarantined and "
+                        "explicitly nacked (UpdateNack), the worker "
+                        "resyncs by pulling fresh params")
+    p.add_argument("--admission-z", type=float, default=6.0, metavar="Z",
+                   help="admission gate: reject a push whose log-norm "
+                        "z-score vs the sender's own history exceeds Z")
+    p.add_argument("--admission-warmup", type=int, default=8, metavar="N",
+                   help="admission gate: per-sender pushes admitted before "
+                        "the z-score check activates (finiteness is "
+                        "checked from the first push)")
+    p.add_argument("--manifest-path", type=str, default="",
+                   help="elastic shard servers (--coord): path of the "
+                        "coordinator's FleetManifest — required to honor "
+                        "auto-rollback barriers (RollbackRequest restores "
+                        "the last good snapshot in place)")
     p.add_argument("--profile-dir", type=str, default="",
                    help="capture an xprof/TensorBoard trace of a training-step "
                         "window into this directory (reference has no tracing "

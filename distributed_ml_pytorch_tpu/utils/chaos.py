@@ -126,24 +126,93 @@ class WeatherRule:
         return True
 
 
+@dataclasses.dataclass(frozen=True)
+class SDCRule:
+    """Silent data corruption of the NUMERIC payload on one channel
+    (ISSUE 8): bit-flip / scale / NaN injection that the wire layer CANNOT
+    catch.
+
+    Unlike :class:`FaultRule.corrupt` — which mangles the frame in flight
+    so the reliability CRC drops it and the retry heals it — an SDC rule
+    models corruption in the *sender's memory*, upstream of the envelope:
+    it is applied AFTER envelope stamping and the envelope checksum is
+    re-computed over the corrupted body, so the frame arrives bit-perfect
+    on the wire and only the receiver's admission gate / the health plane
+    can see it. ``code`` matches the INNER message code (the
+    ``ReliableFrame`` envelope is looked through); plain un-enveloped
+    frames are corrupted directly.
+
+    ``skip`` preserves the first N floats of the inner payload (protocol
+    stamps — e.g. 6 for ``ShardPush``'s version/range head): the model is
+    a corrupted gradient *buffer*, not a corrupted protocol header.
+
+    Determinism: for enveloped frames the decision + draws are a pure
+    function of ``(plan.seed, src, dst, inner_code, envelope_seq)`` — a
+    retransmission re-derives the SAME corruption (the poison lives in the
+    sender's pending buffer) and is logged once, so the :class:`ChaosLog`
+    stays byte-identical however retries interleave. Plain frames use a
+    per-channel counter like fault rules. Either way the draws come from
+    their own seeded stream (``_SDC_NS``), so adding SDC rules never
+    perturbs an existing plan's fault or weather decisions.
+
+    Note: assumes the default reliability envelope checksum; a
+    ``legacy_envelope=True`` transport pair would drop the re-stamped
+    frame (and the SDC would degrade into ordinary wire corruption).
+    """
+
+    src: Optional[int] = None
+    dst: Optional[int] = None
+    code: Optional[int] = None          # INNER MessageCode, or None = any
+    p: float = 0.0                      # P(payload silently corrupted)
+    kind: str = "bitflip"               # "bitflip" | "scale" | "nan"
+    factor: float = -4.0                # scale multiplier (kind="scale")
+    skip: int = 0                       # head floats left untouched
+    after: int = 0
+    until: Optional[int] = None
+
+    def __post_init__(self):
+        if self.kind not in ("bitflip", "scale", "nan"):
+            raise ValueError(f"unknown SDC kind: {self.kind!r}")
+
+    def matches(self, src: int, dst: int, code: int, index: int) -> bool:
+        if self.src is not None and src != self.src:
+            return False
+        if self.dst is not None and dst != self.dst:
+            return False
+        if self.code is not None and code != int(self.code):
+            return False
+        if index < self.after:
+            return False
+        if self.until is not None and index >= self.until:
+            return False
+        return True
+
+
 #: namespace tag separating the weather RNG stream from the fault stream
 _WEATHER_NS = 0x57454154  # "WEAT"
+
+#: namespace tag for the SDC draw stream (separate from faults AND weather)
+_SDC_NS = 0x53444331  # "SDC1"
 
 
 @dataclasses.dataclass(frozen=True)
 class ChaosPlan:
     """An ordered fault script plus the seed every channel RNG derives
-    from; ``weather`` adds link-level latency/jitter/bandwidth rules."""
+    from; ``weather`` adds link-level latency/jitter/bandwidth rules and
+    ``sdc`` adds payload-numeric silent-corruption rules (ISSUE 8)."""
 
     rules: Tuple[FaultRule, ...] = ()
     seed: int = 0
     weather: Tuple[WeatherRule, ...] = ()
+    sdc: Tuple[SDCRule, ...] = ()
 
     def __init__(self, rules: Sequence[FaultRule] = (), seed: int = 0,
-                 weather: Sequence[WeatherRule] = ()):
+                 weather: Sequence[WeatherRule] = (),
+                 sdc: Sequence[SDCRule] = ()):
         object.__setattr__(self, "rules", tuple(rules))
         object.__setattr__(self, "seed", int(seed))
         object.__setattr__(self, "weather", tuple(weather))
+        object.__setattr__(self, "sdc", tuple(sdc))
 
     def rule_for(self, src: int, dst: int, code: int, index: int) -> Optional[FaultRule]:
         for rule in self.rules:
@@ -154,6 +223,13 @@ class ChaosPlan:
     def weather_for(self, src: int, dst: int, code: int,
                     index: int) -> Optional[WeatherRule]:
         for rule in self.weather:
+            if rule.matches(src, dst, code, index):
+                return rule
+        return None
+
+    def sdc_for(self, src: int, dst: int, code: int,
+                index: int) -> Optional[SDCRule]:
+        for rule in self.sdc:
             if rule.matches(src, dst, code, index):
                 return rule
         return None
@@ -247,6 +323,12 @@ class FaultyTransport(Transport):
         self.log = log if log is not None else ChaosLog()
         self._world = world if world is not None else _WorldState()
         self._channels: Dict[Tuple[int, int, int], _Channel] = {}
+        #: SDC bookkeeping (ISSUE 8): per-(inner-code) counters for PLAIN
+        #: frames, and the already-logged frame identities so an enveloped
+        #: frame's retransmits re-derive the same corruption without
+        #: re-logging (the log must not depend on retry timing)
+        self._sdc_counts: Dict[Tuple[int, int, int], int] = {}
+        self._sdc_logged: set = set()
         self._lock = threading.Lock()
         self._partitioned: set = set()  # dsts this endpoint cannot reach
         self._link_busy: Dict[int, float] = {}  # bandwidth-cap serialization
@@ -346,6 +428,11 @@ class FaultyTransport(Transport):
         if self._is_crashed(dst):
             raise ConnectionError(f"chaos: peer {dst} is crashed")
         code = MessageCode(code)
+        if self.plan.sdc:
+            # silent data corruption rides FIRST — it models the sender's
+            # memory going bad before the wire, and its draws live on their
+            # own stream so it never perturbs the fault/weather decisions
+            payload = self._maybe_sdc(code, payload, dst)
         chan = self._channel(dst, int(code))
         with self._lock:
             i = chan.index
@@ -392,6 +479,70 @@ class FaultyTransport(Transport):
             # the duplicate shares frame i's weather draw (one latency per
             # decision keeps the log a pure function of the seed)
             self._transmit(code, payload, dst, wu, i, log_weather=False)
+
+    def _maybe_sdc(self, code: MessageCode, payload, dst: int):
+        """Apply the first matching :class:`SDCRule` (see its docstring):
+        corrupt the inner numeric payload, re-stamp the reliability
+        envelope's checksum when there is one, log once per frame
+        identity. Returns the (possibly corrupted) payload."""
+        from distributed_ml_pytorch_tpu.utils.messaging import (
+            _frame_crc,
+            _join16,
+            _split16,
+        )
+
+        arr = np.asarray(payload, np.float32).ravel()
+        enveloped = (code == MessageCode.ReliableFrame and arr.size >= 8
+                     and bool(np.isfinite(arr[:7]).all()))
+        if enveloped:
+            inner = int(arr[6])
+            body_off = 7
+            # the envelope seq IS the frame identity: retransmits re-derive
+            # the same decision/draws instead of rolling fresh ones
+            index = _join16(arr[2], arr[3])
+        else:
+            inner = int(code)
+            body_off = 0
+            with self._lock:
+                key = (self.rank, dst, inner)
+                index = self._sdc_counts.get(key, 0)
+                self._sdc_counts[key] = index + 1
+        rule = self.plan.sdc_for(self.rank, dst, inner, index)
+        if rule is None:
+            return payload
+        lo = body_off + max(0, int(rule.skip))
+        n = arr.size - lo
+        if n <= 0:
+            return payload
+        rng = np.random.default_rng(np.random.SeedSequence(
+            [self.plan.seed & 0xFFFFFFFF, self.rank, dst, inner,
+             index, _SDC_NS]))
+        u = rng.uniform(size=3)
+        if u[0] >= rule.p:
+            return payload
+        out = np.array(arr, copy=True)
+        if rule.kind == "scale":
+            out[lo:] *= np.float32(rule.factor)
+        elif rule.kind == "nan":
+            out[lo + int(u[1] * n) % n] = np.float32(np.nan)
+        else:  # bitflip
+            bits = out.view(np.uint32)
+            bits[lo + int(u[1] * n) % n] ^= np.uint32(1) << np.uint32(
+                int(u[2] * 32) % 32)
+        if enveloped:
+            # re-stamp: the corruption happened "before" the envelope, so
+            # the frame must arrive CRC-clean — bit-perfect on the wire,
+            # numerically poisonous (only the admission gate can see it)
+            inc = _join16(out[0], out[1])
+            crc = _frame_crc(inc, index, inner, out[7:])
+            out[4], out[5] = _split16(crc)
+        log_key = (self.rank, dst, inner, index)
+        with self._lock:
+            first = log_key not in self._sdc_logged
+            self._sdc_logged.add(log_key)
+        if first:
+            self.log.record(self.rank, dst, inner, index, f"sdc-{rule.kind}")
+        return out
 
     def _forward(self, code: MessageCode, payload, dst: int, chan: _Channel,
                  wu: float, i: int) -> None:

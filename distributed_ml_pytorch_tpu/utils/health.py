@@ -1,0 +1,156 @@
+"""Numerical health primitives: the PS-side gradient admission gate
+(ISSUE 8 tentpole).
+
+The wire planes (CRC, acks, WAL) defend against BYTES going wrong in
+flight; nothing defended against an update that is bit-perfect on the wire
+but *numerically poisonous* — an in-memory SDC bit flip upstream of the
+envelope, a NaN/Inf gradient from a diverged worker, an exploding-norm
+straggler. Such an update used to be applied, WAL-logged, and faithfully
+replayed after every recovery: durable poison.
+
+:class:`GradientAdmission` is the gate every ``GradientUpdate``/``ShardPush``
+passes BEFORE any accounting or WAL append:
+
+- **Finiteness** — a payload whose norm is NaN/Inf (any non-finite element,
+  or a magnitude float32 cannot even norm) is rejected unconditionally.
+- **Robust norm outlier** — per-worker EWMA z-score on ``log1p(norm)``:
+  each sender's admitted pushes train a running mean/variance of its own
+  log-norm; once ``warmup`` pushes are in, a push whose z-score exceeds
+  ``z_max`` is rejected. The log transform makes the test scale-free
+  (a 10x norm jump scores the same at step 10 and step 10000) and the
+  ``sigma_floor`` keeps a very-quiet sender's tiny variance from flagging
+  ordinary drift. Rejected samples do NOT update the statistics — one
+  admitted outlier must not drag the mean toward the poison.
+
+Known blind spot, stated honestly: a *norm-preserving* corruption (e.g. a
+sign flip of the whole update — gradient ascent) passes both checks. That
+is exactly why the gate is only the first layer of the health plane: the
+coordinator's loss-telemetry watchdog and the auto-rollback barrier
+(``coord/coordinator.py``, DESIGN.md §16) exist for what the gate cannot
+see. ``tests/test_health.py`` pins the blind spot with a test so a future
+"fix" that silently narrows it is a deliberate decision, not an accident.
+
+Verdicts are returned as ``(reason, norm, z)`` and travel to the worker in
+an explicit ``UpdateNack`` wire frame — a reject is never a silent drop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+#: UpdateNack reason codes (wire values; float32-exact small ints)
+NACK_NONFINITE = 1
+NACK_NORM_OUTLIER = 2
+
+NACK_REASONS = {
+    NACK_NONFINITE: "nonfinite",
+    NACK_NORM_OUTLIER: "norm-outlier",
+}
+
+
+def clamp_finite32(x: float) -> float:
+    """A telemetry value made safe for a float32 wire frame: NaN -> 0,
+    +/-Inf and overflow -> the float32 extreme. Receivers drop frames with
+    nonfinite fields (a poisoned frame must not poison the telemetry
+    plane), so every sender of norms/z-scores/EWMAs — the very quantities
+    that go NaN/Inf when things break — clamps through here."""
+    return float(np.nan_to_num(np.float32(min(x, 3e38))))
+
+
+@dataclasses.dataclass
+class _SenderStats:
+    """Per-sender EWMA of log1p(norm): mean, variance, admitted count."""
+
+    mean: float = 0.0
+    var: float = 0.0
+    count: int = 0
+
+
+class GradientAdmission:
+    """Per-sender finiteness + robust norm-outlier gate (module docstring).
+
+    ``evaluate`` is the whole API: it returns ``None`` to admit (updating
+    the sender's statistics) or a ``(reason, norm, z)`` rejection verdict
+    (statistics untouched). One instance per server; it is only ever
+    called from the server's serve thread, so it carries no lock.
+    """
+
+    def __init__(self, *, z_max: float = 6.0, warmup: int = 8,
+                 alpha: float = 0.2, sigma_floor: float = 0.5):
+        if z_max <= 0 or warmup < 1 or not (0 < alpha <= 1):
+            raise ValueError(
+                f"need z_max > 0, warmup >= 1, 0 < alpha <= 1; got "
+                f"z_max={z_max}, warmup={warmup}, alpha={alpha}")
+        self.z_max = float(z_max)
+        self.warmup = int(warmup)
+        self.alpha = float(alpha)
+        self.sigma_floor = float(sigma_floor)
+        self._stats: Dict[int, _SenderStats] = {}
+        self.admitted = 0
+        self.rejected = 0
+
+    def evaluate(self, sender: int,
+                 payload: np.ndarray) -> Optional[Tuple[int, float, float]]:
+        """Admit (``None``) or reject (``(reason, norm, z)``) one update."""
+        # one O(n) pass: float64 accumulation so a legitimately large but
+        # finite update cannot overflow the norm itself into the reject
+        norm = float(np.linalg.norm(payload.astype(np.float64, copy=False)))
+        if not math.isfinite(norm):
+            self.rejected += 1
+            return (NACK_NONFINITE, norm, 0.0)
+        x = math.log1p(norm)
+        st = self._stats.setdefault(sender, _SenderStats())
+        z = 0.0
+        clamp = None
+        if st.count >= self.warmup:
+            sigma = max(math.sqrt(max(st.var, 0.0)), self.sigma_floor)
+            z = (x - st.mean) / sigma
+            if z > self.z_max:
+                self.rejected += 1
+                return (NACK_NORM_OUTLIER, norm, z)
+            # winsorize the ADMITTED sample at +/-2 sigma before folding it
+            # in: an admitted borderline outlier must not drag the mean
+            # toward itself, or a sender whose norms grow by just-under-
+            # z_max per push walks the gate up an exponential (the boiling
+            # frog: each push individually admissible, the sequence a
+            # runaway) — clamped, the second push of such a ramp already
+            # scores far outside the gate and is rejected
+            clamp = 2.0 * sigma
+        # admit: fold the (winsorized) sample into the running statistics
+        if st.count == 0:
+            st.mean = x
+            st.var = 0.0
+        else:
+            d = x - st.mean
+            if clamp is not None:
+                d = max(-clamp, min(clamp, d))
+            st.mean += self.alpha * d
+            st.var = (1.0 - self.alpha) * (st.var + self.alpha * d * d)
+        st.count += 1
+        self.admitted += 1
+        return None
+
+    def forget(self, sender: int) -> None:
+        """Drop a sender's statistics (a rank whose new life should not be
+        judged by its previous life's norm history)."""
+        self._stats.pop(sender, None)
+
+    def snapshot(self) -> Dict[int, Tuple[float, float, int]]:
+        """``sender -> (mean, var, count)`` for telemetry/tests."""
+        return {s: (st.mean, st.var, st.count)
+                for s, st in self._stats.items()}
+
+
+def admission_from_args(args) -> Optional[GradientAdmission]:
+    """CLI face: ``--admission`` (+ ``--admission-z``/``--admission-warmup``)
+    -> a gate instance, or None when the flag is off. One instance PER
+    server/shard — the statistics are per-(server, sender) by design."""
+    if not getattr(args, "admission", False):
+        return None
+    return GradientAdmission(
+        z_max=getattr(args, "admission_z", 6.0),
+        warmup=getattr(args, "admission_warmup", 8))

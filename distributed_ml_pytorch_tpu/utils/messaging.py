@@ -120,6 +120,10 @@ class MessageCode(enum.IntEnum):
     ShardParams = 25
     # --- adaptive wire (ISSUE 7): batched cumulative ack + credit ---
     CumAck = 26
+    # --- numerical health plane (ISSUE 8): admission + auto-rollback ---
+    UpdateNack = 27
+    RollbackRequest = 28
+    RollbackDone = 29
 
 
 @dataclasses.dataclass(frozen=True)
@@ -204,11 +208,14 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
         doc="explicit leave; stale incarnations cannot evict newer lives"),
     MessageCode.LeaseRenew: PayloadSchema(
         fields=("inc_lo", "inc_hi", "push_count", "step", "ewma_ms",
-                "wire_open"),
+                "wire_open", "nacks", "bad_loss", "loss_ewma", "gnorm_ewma"),
         handled_by=("coord",),
-        doc="lease refresh carrying the straggler-detector progress report "
-            "plus the member's open-circuit-breaker count (wire health; "
-            "receivers tolerate the 5-field pre-ISSUE-7 form)"),
+        doc="lease refresh carrying the straggler-detector progress report, "
+            "the member's open-circuit-breaker count (wire health) and the "
+            "numerical-health telemetry (ISSUE 8): cumulative admission "
+            "nacks received, nonfinite-loss count, and loss / grad-norm "
+            "EWMAs — the reputation + rollback-watchdog inputs (receivers "
+            "tolerate the 5/6-field pre-ISSUE-7/8 forms)"),
     MessageCode.ShardMapUpdate: PayloadSchema(
         fields=("n_entries", "version_lo", "version_hi", "n_params_lo",
                 "n_params_hi"),
@@ -276,6 +283,30 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
             "piggybacks its advertised send-window credit (the "
             "backpressure signal) — one small frame per delivery batch "
             "instead of one ReliableAck per frame"),
+    MessageCode.UpdateNack: PayloadSchema(
+        fields=("reason", "norm", "z"), handled_by=("ps",),
+        doc="server -> worker: your GradientUpdate/ShardPush was QUARANTINED "
+            "by the admission gate (utils/health.py) — reason is a NACK_* "
+            "code, norm/z the offending magnitude (clamped finite for the "
+            "wire). A reject is never silent: the worker counts it, resyncs "
+            "by pulling fresh params, and reports the count in LeaseRenew"),
+    MessageCode.RollbackRequest: PayloadSchema(
+        fields=("roll_lo", "roll_hi", "snap_lo", "snap_hi", "map_lo",
+                "map_hi", "phase"),
+        handled_by=("coord",),
+        doc="coordinator -> everyone: the auto-rollback barrier (ISSUE 8). "
+            "phase 0 = start (shards restore the named FleetManifest "
+            "snapshot in place, workers drop in-flight accumulators and "
+            "pull, serving frontends hold submits), phase 1 = complete/"
+            "abandoned (holds release; member-side holds also expire on a "
+            "TTL so a lost completion frame fails open)"),
+    MessageCode.RollbackDone: PayloadSchema(
+        fields=("roll_lo", "roll_hi", "map_lo", "map_hi", "lo_lo", "lo_hi",
+                "hi_lo", "hi_hi", "apply_lo", "apply_hi"),
+        handled_by=("coord",),
+        doc="shard -> coordinator: range [lo,hi) restored to the manifest "
+            "snapshot at apply_seq under this map version; all-reported "
+            "completes the rollback barrier (MTTR measured)"),
 }
 
 

@@ -228,6 +228,26 @@ class WriteAheadLog:
         self._f = open(self.path, "ab", buffering=0)
         self.pending = 0
 
+    def drop_after(self, upto_seq: int) -> None:
+        """The deliberate inverse of :meth:`truncate` (ISSUE 8 rollback):
+        discard records PAST ``upto_seq``, keeping everything at or below
+        it. A coordinator-driven rollback restores the last good snapshot
+        and caps replay at its apply seq — the discarded tail must also
+        leave the log, or the rolled-back updates would resurrect on the
+        next crash-restore and silently undo the rollback."""
+        self.sync()
+        records, _stats = replay_wal(self.path)
+        keep = [r for r in records if r.seq <= int(upto_seq)]
+        self._f.close()
+        atomic_write(self.path, b"".join(
+            _record_bytes(r.incarnation, r.seq, r.sender, r.env_inc,
+                          r.env_seq, r.payload)
+            for r in keep))
+        self._f = open(self.path, "ab", buffering=0)
+        self.pending = 0
+        # the fast-path watermark must not claim seqs the drop removed
+        self._max_seq = min(self._max_seq, int(upto_seq))
+
     def close(self) -> None:
         try:
             self.sync()

@@ -106,6 +106,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "health: numerical-health tests (utils/health.py admission gate, "
+        "SDC chaos, UpdateNack quarantine, worker reputation, coordinator "
+        "auto-rollback — ISSUE 8); `make health` selects exactly these — "
+        "fast units run in tier-1, the 3x acceptance scenario is "
+        "additionally measured into slow_tests.txt",
+    )
+    config.addinivalue_line(
+        "markers",
         "netweather: adaptive-wire tests under network weather "
         "(utils/chaos.WeatherRule + the RTO/window/breaker machinery in "
         "utils/messaging.ReliableTransport); `make netweather` selects "

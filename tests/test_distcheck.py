@@ -1125,6 +1125,6 @@ def test_coord_client_progress_guarded():
             assert not done.wait(0.25), "report() ignored the client lock"
         assert done.wait(2.0)
         with client._lock:
-            assert client._progress == (1, 2, 3.0, 0)
+            assert client._progress == (1, 2, 3.0, 0, 0, 0, 0.0, 0.0)
     finally:
         client.stop()
