@@ -72,6 +72,19 @@ bench-serving:
 bench-all:
 	$(PY) bench_all.py
 
+# MFU regression gate (ISSUE 9): re-checks a bench record's per-leg MFU
+# against the recorded floors in bench_floors.json and exits non-zero on
+# a breach or a missing leg. The default target is the no-device smoke on
+# a canned record (gate LOGIC is exercised; wired into `make test`);
+# after a real rig run: python bench.py --gate
+bench-gate:
+	$(PY) bench.py --gate --json tests/data/bench_gate_smoke.json
+
+# conv-epilogue cost ladder (fused Pallas kernels vs the unfused XLA
+# chain, per AlexNet tail shape) — the compute-plane microbench phase
+bench-compute:
+	$(PY) bench_all.py --only compute_microbench
+
 # seeded fault-injection suite (utils/chaos.py + the reliability layer):
 # deterministic drop/dup/corrupt/partition/crash scenarios on the PS and
 # serving planes, soak variants included (they carry both markers)
@@ -145,9 +158,10 @@ bench-wire:
 lint:
 	$(PY) -m distributed_ml_pytorch_tpu.analysis --baseline tests/distcheck_baseline.txt
 
-# fast core signal: distcheck + everything that runs in-process (no
-# subprocess worlds, no end-to-end example trainings) — minutes on one core
-test: lint
+# fast core signal: distcheck + the MFU-gate smoke + everything that runs
+# in-process (no subprocess worlds, no end-to-end example trainings) —
+# minutes on one core
+test: lint bench-gate
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 
 # the whole suite, subprocess worlds included (tens of minutes on one core)
@@ -173,4 +187,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-health chaos coord drill drill-demo fleet health health-demo netweather soak lint test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-health bench-gate bench-compute chaos coord drill drill-demo fleet health health-demo netweather soak lint test test-all verify-real-data graph install dist

@@ -1,15 +1,34 @@
 """Benchmark harness (BASELINE.md config #1, the reference's headline workload).
 
 Measures steady-state training throughput (images/sec/chip) of the flagship
-AlexNet on CIFAR-10-shaped data with the reference training recipe — batch 64,
-SGD lr 0.008 (reference ``example/main.py:142,144-145``) — on the default jax
-device (the TPU chip under the driver; CPU elsewhere).
+AlexNet on CIFAR-10-shaped data on the default jax device (the TPU chip
+under the driver; CPU elsewhere), as THREE first-class legs reported side
+by side in one JSON record (round 9 — the ceiling the round-5 audit
+measured is now the shipped number):
 
-``vs_baseline`` is measured, not assumed: the same workload (same architecture,
-same batch, same optimizer) is timed in torch on CPU — the reference's own
-``make single`` configuration (reference ``Makefile:23``; the reference
-publishes no numbers, BASELINE.md, so its baseline must be produced). The
-printed ratio is TPU-images/sec over torch-CPU-images/sec.
+- ``parity_b64`` — the reference training recipe exactly (batch 64, SGD
+  lr 0.008, reference ``example/main.py:142,144-145``): the parity leg
+  every trajectory/steps-to-accuracy comparison anchors to.
+- ``large_batch_b1024`` — the identical architecture at batch 1024 with
+  Pallas-fused conv epilogues (``ops/fused_conv.py``): the throughput
+  leg, and the record's headline ``value``.
+- ``grad_accum_b1024`` — batch 1024 as a microbatch-256 accumulation
+  scan whose applied update is scaled to the SUM of the sixteen
+  batch-64 mean-gradient updates at frozen params
+  (``make_accum_train_step(effective_update_batch=64)``): large-batch
+  geometry, batch-64-recipe effective update (first-order).
+
+Every leg records its ``mfu_floor`` from ``bench_floors.json``; ``--gate``
+re-checks measured MFU against the floors and exits non-zero on a breach
+(``--json FILE`` gates a canned/previous record with no device run — the
+``make bench-gate`` tier-1 smoke), so the headline can never silently
+regress below its recorded floor again.
+
+``vs_baseline`` is measured, not assumed: the reference's own workload
+(``make single`` configuration, batch 64) is timed in torch on CPU (the
+reference publishes no numbers, BASELINE.md). The printed ratio is the
+headline leg's images/sec over torch-CPU-images/sec; the baseline keeps
+the reference's fixed recipe because that IS the baseline.
 
 Prints exactly ONE JSON line on stdout; all narration goes to stderr.
 """
@@ -17,6 +36,7 @@ Prints exactly ONE JSON line on stdout; all narration goes to stderr.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -25,11 +45,18 @@ import numpy as np
 BATCH = 64
 LR = 0.008
 SCAN_K = 100       # steps fused into one compiled program (lax.scan)
+LARGE_BATCH = 1024       # the throughput legs' batch (audited plateau zone)
+LARGE_SCAN_K = 20        # updates per compiled program for the large legs
+ACCUM_MICROBATCH = 256   # grad-accum leg: 4 microbatches per update
+EFFECTIVE_UPDATE = 64    # ...whose update preserves the batch-64 recipe
 N_SHORT, N_LONG = 1, 41  # dispatch counts for the differenced measurement
                          # (long leg ≈ 4000 steps so RTT jitter is small
                          # relative to the compute being measured)
 TRIALS = 5         # report the median differenced estimate
 BASELINE_STEPS = 12
+FLOORS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_floors.json")
+HEADLINE_LEG = "large_batch_b1024"
 
 
 def log(msg: str) -> None:
@@ -90,7 +117,8 @@ def make_batch(batch: int, seed: int = 0, k: int = 0,
 
 def bench_jax(batch: int = BATCH, k: int | None = None, model=None,
               input_shape: tuple = (32, 32, 3), n_classes: int = 10,
-              n_long: int | None = None, trials: int | None = None) -> float:
+              n_long: int | None = None, trials: int | None = None,
+              step_builder=None, flops_override=None) -> float:
     """Steady-state images/sec of the scanned AlexNet trainer on the default
     device.
 
@@ -104,6 +132,16 @@ def bench_jax(batch: int = BATCH, k: int | None = None, model=None,
     time(N_SHORT dispatches), each ended by fetching the final scalar loss
     (a true data dependency), divided by the extra steps. The fixed RTT
     cancels; what remains is per-step device time.
+
+    ``step_builder(model, tx)`` overrides the compiled program (default
+    ``make_scan_train_step``; the grad-accum leg passes
+    ``make_scan_accum_train_step``) — it must keep the
+    ``(state, images [k,B,...], labels [k,B], rng) → (state, losses [k])``
+    contract so the timing/flops machinery applies unchanged.
+    ``flops_override`` replaces XLA's per-dispatch flop count for legs
+    whose program nests a scan (cost_analysis counts each scan body ONCE,
+    so a microbatch scan inside the update body under-reports by the
+    microbatch count; the caller passes the equivalent plain-step count).
     """
     import jax
 
@@ -129,7 +167,7 @@ def bench_jax(batch: int = BATCH, k: int | None = None, model=None,
     state, tx = create_train_state(
         model, jax.random.key(0), lr=LR, sample_shape=(1, *input_shape)
     )
-    train_scan = make_scan_train_step(model, tx)
+    train_scan = (step_builder or make_scan_train_step)(model, tx)
     images, labels = make_batch(batch, k=k, shape=input_shape, n_classes=n_classes)
     images = jax.device_put(images)
     labels = jax.device_put(labels)
@@ -186,7 +224,10 @@ def bench_jax(batch: int = BATCH, k: int | None = None, model=None,
     # XLA's cost_analysis counts a lax.scan body ONCE (not x trip count —
     # verified against a bare scanned matmul), so the k-step scan program's
     # reported flops ARE the per-step flops (+ negligible outside-body ops)
-    scan_flops = compiled_flops(train_scan, state, images, labels, rng)
+    if flops_override is not None:
+        scan_flops = flops_override
+    else:
+        scan_flops = compiled_flops(train_scan, state, images, labels, rng)
     rate = Rate.make(batch / per_step, scan_flops, per_step)
     method = ("device-true trace" if dev.platform == "tpu"
               else f"min-min differenced over {trials} trials")
@@ -259,39 +300,230 @@ def bench_torch_cpu(batch: int = BATCH, steps: int = BASELINE_STEPS) -> float | 
     return med
 
 
-def main() -> None:
-    ips = bench_jax()
-    base = bench_torch_cpu()
-    vs = round(ips / base, 2) if base else None  # null = baseline not measurable here
+def run_headline_legs() -> dict:
+    """Measure the three config-1 legs; ``{leg_name: Rate}``.
+
+    The grad-accum leg's MFU numerator reuses the large-batch leg's XLA
+    flop count: its program nests the microbatch scan inside the update
+    body and ``cost_analysis`` counts scan bodies once (under-reporting by
+    the microbatch count), while the real work per update — conv
+    forward/backward over the same 1024 images plus one full-size
+    optimizer apply — matches the plain batch-1024 step's count.
+    """
+    import jax
+
+    from distributed_ml_pytorch_tpu.models import AlexNet
+    from distributed_ml_pytorch_tpu.training.trainer import (
+        make_scan_accum_train_step,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        big, micro, large_kw = LARGE_BATCH, ACCUM_MICROBATCH, \
+            dict(k=LARGE_SCAN_K)
+    else:
+        # a 1-core CPU host runs the large legs to validate the record
+        # shape and the program paths, not to produce a number (it has no
+        # MFU table anyway); batch 1024 would take ~an hour there
+        big, micro, large_kw = 256, 64, dict(k=2, n_long=2, trials=1)
+    legs: dict = {}
+    log("--- leg parity_b64 (reference recipe)")
+    legs["parity_b64"] = bench_jax()
+    legs["parity_b64"].leg_batch = BATCH
+    fused = AlexNet(num_classes=10, fused_epilogue=True)
+    fused_ok = True
+    log("--- leg large_batch_b1024 (fused epilogues)")
+    try:
+        large = bench_jax(batch=big, model=fused, **large_kw)
+    except Exception as e:
+        # the audited plateau (~1.64M img/s) was measured on the UNFUSED
+        # architecture, so a Mosaic/runtime rejection of the epilogue
+        # kernel must not take the headline leg down with it — fall back
+        # and say so in the record (fused_epilogue: false)
+        log(f"fused-epilogue program failed on this runtime ({e!r}); "
+            "re-running the large-batch legs unfused")
+        fused, fused_ok = AlexNet(num_classes=10), False
+        large = bench_jax(batch=big, model=fused, **large_kw)
+    large.fused_epilogue = fused_ok
+    large.leg_batch = big
+    legs["large_batch_b1024"] = large
+    log("--- leg grad_accum_b1024 (microbatch scan, batch-64 effective update)")
+    accum_kw = dict(
+        batch=big, **large_kw,
+        step_builder=lambda m, tx: make_scan_accum_train_step(
+            m, tx, micro, effective_update_batch=EFFECTIVE_UPDATE),
+        flops_override=large.flops_per_step,
+    )
+    accum_fused_ok = fused_ok
+    try:
+        accum = bench_jax(model=fused, **accum_kw)
+    except Exception as e:
+        # the accum program nests the microbatch scan in the update body —
+        # different block geometry, so the epilogue kernel can be rejected
+        # here even after the plain batch-1024 program compiled; same
+        # fall-back-and-say-so contract as the large leg
+        if not accum_fused_ok:
+            raise  # already unfused: a failure here is a real bug
+        log(f"fused-epilogue accum program failed on this runtime ({e!r}); "
+            "re-running the grad-accum leg unfused")
+        accum_fused_ok = False
+        accum = bench_jax(model=AlexNet(num_classes=10), **accum_kw)
+    accum.fused_epilogue = accum_fused_ok
+    accum.leg_batch = big
+    legs["grad_accum_b1024"] = accum
+    return legs
+
+
+#: per-leg honesty notes for the headline record
+LEG_NOTES = {
+    "parity_b64": (
+        "reference recipe (batch 64, SGD lr 0.008) — the trajectory-parity "
+        "leg; conv-geometry-bound (per-fusion audit, BASELINE.md #1)"),
+    "large_batch_b1024": (
+        "identical architecture, batch 1024, Pallas-fused conv epilogues "
+        "(ops/fused_conv.py) — the audited ~35%-MFU plateau as the shipped "
+        "headline"),
+    "grad_accum_b1024": (
+        "batch 1024 as a microbatch-256 accumulation scan; applied update "
+        "= sum of the 16 batch-64 mean-grad updates at frozen params "
+        "(first-order equal to 16 recipe steps); flops numerator = the "
+        "plain batch-1024 program's XLA count (nested-scan bodies are "
+        "counted once by cost_analysis)"),
+}
+
+
+def load_floors(path: str | None = None) -> dict:
+    """The checked-in MFU floors: ``{"tolerance": f, "legs": {name: floor}}``."""
+    with open(path or FLOORS_PATH) as fh:
+        floors = json.load(fh)
+    return floors
+
+
+def build_record(legs: dict, torch_base: float | None,
+                 floors: dict | None = None) -> dict:
+    """The one-line headline JSON: headline value = the large-batch leg,
+    every leg reported side by side with its recorded ``mfu_floor``."""
+    headline = legs[HEADLINE_LEG]
     rec = {
         "metric": "alexnet_cifar10_train_throughput_per_chip",
-        "value": round(ips, 1),
+        "value": round(float(headline), 1),
         "unit": "images/sec/chip",
-        "vs_baseline": vs,
+        "vs_baseline": round(float(headline) / torch_base, 2) if torch_base else None,
+        "headline_leg": HEADLINE_LEG,
     }
-    if isinstance(ips, Rate):
-        rec.update(ips.record_fields())
-    # measured MFU ceiling for this leg (VERDICT r2 #5, audited per-fusion
-    # in round 5 — BASELINE.md #1): the batch-64 reference recipe is
-    # bound by conv-kernel geometry at small spatial maps, not by MXU or
-    # HBM. Round 5 removed the one provably wasteful fusion family
-    # (select_and_scatter pool backwards, 7.1 us/step -> a reshape-max
-    # custom vjp, bit-identical incl. ties) for +6.6%; the audited
-    # remainder is conv fusions whose alternatives measured slower
-    # (space-to-depth, two im2col forms, bf16) with SGD updates already
-    # fused into the backward conv epilogues. Scaling batch on the
-    # identical architecture lifts MFU to a plateau of ~35% of bf16 peak
-    # (1.61M img/s at b256, 1.64M at b1024, device-true) — the
-    # architecture's structural ceiling on this chip; the recipe's batch
-    # 64 is the binding constraint.
-    rec["mfu_ceiling_note"] = (
-        "batch-64 recipe is conv-geometry-bound (per-fusion audit in "
-        "BASELINE.md #1; pool-backward waste removed in round 5 for +6.6%); "
-        "same architecture plateaus at ~35% MFU / 1.64M img/s by batch "
-        "256-1024 (measured, device-true) - that plateau is the structural "
-        "ceiling the recipe's fixed batch keeps out of reach")
+    if isinstance(headline, Rate):
+        rec.update(headline.record_fields())
+    floor_legs = (floors or {}).get("legs", {})
+    # the TPU leg batches; a CPU validation run records what it actually
+    # ran (the shrunk shapes) via the Rate's leg_batch attribute
+    batches = {"parity_b64": BATCH, "large_batch_b1024": LARGE_BATCH,
+               "grad_accum_b1024": LARGE_BATCH}
+    rec["legs"] = {}
+    for name, rate in legs.items():
+        leg = {"img_per_s": round(float(rate), 1),
+               "batch": getattr(rate, "leg_batch", None) or batches.get(name)}
+        if isinstance(rate, Rate):
+            leg.update(rate.record_fields())
+        if getattr(rate, "fused_epilogue", None) is not None:
+            leg["fused_epilogue"] = rate.fused_epilogue
+        if name in floor_legs:
+            leg["mfu_floor"] = floor_legs[name]
+        if name in LEG_NOTES:
+            leg["note"] = LEG_NOTES[name]
+        rec["legs"][name] = leg
+    rec["recipe_note"] = (
+        "round 9: the round-5 audit's measured batch-256-1024 plateau "
+        "(~35% MFU / 1.64M img/s) is now the shipped headline leg; the "
+        "batch-64 reference recipe stays first-class as the parity leg, "
+        "and the grad-accum leg carries the batch-64 effective update at "
+        "large-batch geometry. --gate enforces the recorded mfu_floor "
+        "per leg (bench_floors.json)")
+    return rec
+
+
+def check_mfu_floors(record: dict, floors: dict) -> tuple[list, list]:
+    """Gate logic, pure on (record, floors): ``(breaches, skips)``.
+
+    A leg listed in the floors but missing from the record is a breach
+    (a silently dropped leg must fail the gate, not pass it); a leg
+    without a measured MFU (CPU hosts have no peak-flops table) is a
+    skip, reported but not failing.
+    """
+    tol = float(floors.get("tolerance", 0.0))
+    legs = record.get("legs", {})
+    breaches, skips = [], []
+    for name, floor in sorted(floors.get("legs", {}).items()):
+        leg = legs.get(name)
+        if leg is None:
+            breaches.append(f"{name}: leg missing from the bench record "
+                            f"(floor {floor:.3f})")
+            continue
+        mfu = leg.get("mfu")
+        if mfu is None:
+            skips.append(f"{name}: no measured MFU on this backend "
+                         f"(floor {floor:.3f} not checkable)")
+            continue
+        if mfu < floor - tol:
+            breaches.append(
+                f"{name}: MFU {mfu:.4f} < floor {floor:.3f} - tol {tol:.3f}")
+    return breaches, skips
+
+
+def gate(record: dict, floors: dict, require_mfu: bool = False) -> int:
+    breaches, skips = check_mfu_floors(record, floors)
+    for line in skips:
+        log(f"gate: SKIP {line}")
+    for line in breaches:
+        log(f"gate: FAIL {line}")
+    if require_mfu and skips:
+        log("gate: FAIL unmeasured legs with --require-mfu")
+        return 1
+    if breaches:
+        log(f"gate: {len(breaches)} MFU floor breach(es)")
+        return 1
+    log(f"gate: ok ({len(floors.get('legs', {})) - len(skips)} leg(s) "
+        "at or above floor)")
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--gate", action="store_true",
+                    help="check measured MFU per leg against the recorded "
+                         "floors (bench_floors.json); exit non-zero on a "
+                         "breach")
+    ap.add_argument("--json", metavar="FILE", default=None,
+                    help="with --gate: gate this previously-emitted record "
+                         "(no device run) — the `make bench-gate` smoke; "
+                         "accepts the raw record or a driver wrapper with "
+                         "a 'parsed' field")
+    ap.add_argument("--floors", metavar="FILE", default=None,
+                    help="floors file (default: bench_floors.json beside "
+                         "this script)")
+    ap.add_argument("--require-mfu", action="store_true",
+                    help="with --gate: unmeasured legs fail instead of skip")
+    args = ap.parse_args(argv)
+
+    floors = load_floors(args.floors)
+    if args.json:
+        if not args.gate:
+            ap.error("--json only makes sense with --gate")
+        with open(args.json) as fh:
+            record = json.load(fh)
+        if "parsed" in record and "legs" not in record:
+            record = record["parsed"]
+        return gate(record, floors, args.require_mfu)
+
+    legs = run_headline_legs()
+    base = bench_torch_cpu()
+    rec = build_record(legs, base, floors)
     print(json.dumps(rec), flush=True)
+    if args.gate:
+        return gate(rec, floors, args.require_mfu)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -19,6 +19,13 @@ path), and these checks make the discipline mechanical:
 - **DC304** — a buffer passed at a ``donate_argnums`` position used again
   after the call: donation invalidates the buffer; XLA may have already
   reused its memory.
+- **DC305** — a host-device sync on a traced value inside a jit/scan step
+  body: ``.block_until_ready()`` / ``.item()`` on a traced value, or
+  ``np.asarray``/``np.array``/``jax.device_get`` applied to one. The perf
+  twin of the correctness checks above: at best these concretization
+  attempts crash at trace time; where they survive (e.g. inside code that
+  is only *sometimes* jitted) they serialize the device pipeline — the
+  exact dispatch-stall class the scanned trainers exist to avoid.
 
 Traced functions are found structurally: ``@jax.jit`` / ``@jit`` /
 ``@partial(jax.jit, …)`` decorations, ``jax.jit(f, …)`` /
@@ -57,6 +64,14 @@ _HOST_STATE_PREFIXES = (
 _HOST_STATE_CALLS = frozenset({"os.getenv", "os.environ.get", "open"})
 
 _KEY_PARAM_HINTS = ("rng", "key", "prng")
+
+#: method calls that force a device->host sync on their receiver (DC305)
+_SYNC_ATTR_CALLS = frozenset({"block_until_ready", "item"})
+#: functions that pull a device value to host when given one (DC305)
+_SYNC_FN_CALLS = frozenset({
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "jax.device_get",
+})
 
 
 def _jit_call_info(call: ast.Call) -> Optional[dict]:
@@ -110,56 +125,125 @@ class TracedFn:
                 if i not in self.static}
 
 
+def _scope_walk(nodes: List[ast.AST]):
+    """Every node reachable from ``nodes`` without entering a nested
+    ``def`` scope: FunctionDef bodies stay unexpanded, but their
+    decorators and default-arg expressions — which evaluate in THIS
+    scope — are visited, and class bodies, control-flow blocks, and
+    lambda bodies are transparent (a ``jax.jit(f)`` / ``lax.scan(body,…)``
+    call sited inside a lambda resolves ``f``/``body`` through the same
+    lexical chain; lambda params cannot shadow a ``def``)."""
+    queue = list(nodes)
+    i = 0
+    while i < len(queue):
+        n = queue[i]
+        i += 1
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            queue.extend(n.decorator_list)
+            queue.extend(n.args.defaults)
+            queue.extend(d for d in n.args.kw_defaults if d is not None)
+        else:
+            queue.extend(ast.iter_child_nodes(n))
+
+
 def find_traced(src: SourceFile) -> List[TracedFn]:
     """Every traced function in a file (decorated, wrapped, or nested)."""
-    defs: Dict[str, ast.FunctionDef] = {}
-    for node in walk_list(src.tree):
-        if isinstance(node, ast.FunctionDef):
-            defs.setdefault(node.name, node)
     traced: Dict[ast.FunctionDef, TracedFn] = {}
 
     def mark(fn: ast.FunctionDef, static=(), donate=(), outer=frozenset()):
         if fn not in traced:
             traced[fn] = TracedFn(fn, set(static), set(donate), set(outer))
 
-    for node in walk_list(src.tree):
-        if isinstance(node, ast.FunctionDef):
-            for dec in node.decorator_list:
-                if isinstance(dec, ast.Call):
-                    info = _jit_call_info(dec)
-                    if info is not None:
-                        mark(node, info["static"], info["donate"])
-                elif dotted_name(dec) in ("jax.jit", "jit"):
-                    mark(node)
-        if isinstance(node, ast.Call):
-            info = _jit_call_info(node)
-            wrapped = None
-            if info is not None and node.args:
-                wrapped = node.args[0]
-            elif dotted_name(node.func) in ("jax.shard_map", "shard_map") \
-                    and node.args:
-                wrapped, info = node.args[0], {"static": set(), "donate": set()}
-            if wrapped is None:
-                continue
-            # unwrap jax.jit(jax.shard_map(f, …), …)
-            while isinstance(wrapped, ast.Call) and dotted_name(
-                    wrapped.func) in ("jax.shard_map", "shard_map") \
-                    and wrapped.args:
-                wrapped = wrapped.args[0]
-            if isinstance(wrapped, ast.Name) and wrapped.id in defs:
-                mark(defs[wrapped.id], info["static"], info["donate"])
+    def process_scope(children: List[ast.AST],
+                      scopes: List[Dict[str, ast.FunctionDef]]) -> None:
+        # a callback name at a call site resolves LEXICALLY — innermost
+        # scope first — not through a file-wide name map: ``def body`` is
+        # this repo's convention for scan bodies and host-only helpers
+        # alike, so first-def-wins by bare name marks the wrong function
+        local: Dict[str, ast.FunctionDef] = {}
+        for n in _scope_walk(children):
+            if isinstance(n, ast.FunctionDef):
+                local.setdefault(n.name, n)
+        stack = scopes + [local]
 
-    # nested defs inside traced functions are traced with the outer taint
+        def resolve(name: str) -> Optional[ast.FunctionDef]:
+            for scope in reversed(stack):
+                if name in scope:
+                    return scope[name]
+            return None
+
+        for node in _scope_walk(children):
+            # async bodies are a scope like any other — a jitted helper
+            # nested in an ``async def`` must still be found
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if isinstance(node, ast.FunctionDef):
+                    for dec in node.decorator_list:
+                        if isinstance(dec, ast.Call):
+                            info = _jit_call_info(dec)
+                            if info is not None:
+                                mark(node, info["static"], info["donate"])
+                        elif dotted_name(dec) in ("jax.jit", "jit"):
+                            mark(node)
+                process_scope(node.body, stack)
+            if isinstance(node, ast.Call):
+                info = _jit_call_info(node)
+                wrapped = None
+                if info is not None and node.args:
+                    wrapped = node.args[0]
+                elif dotted_name(node.func) in ("jax.shard_map",
+                                                "shard_map") \
+                        and node.args:
+                    wrapped, info = node.args[0], {"static": set(),
+                                                   "donate": set()}
+                else:
+                    # scan/loop step bodies trace even when the enclosing
+                    # function is not itself jitted (ISSUE 9 / DC305):
+                    # scan(body, …) at args[0]; fori_loop(lo, hi, body, …)
+                    # at args[2]; while_loop(cond, body, …) traces BOTH
+                    body_positions = {
+                        "jax.lax.scan": (0,), "lax.scan": (0,),
+                        "jax.lax.fori_loop": (2,), "lax.fori_loop": (2,),
+                        "jax.lax.while_loop": (0, 1),
+                        "lax.while_loop": (0, 1),
+                    }.get(dotted_name(node.func), ())
+                    for pos in body_positions:
+                        if pos < len(node.args) and \
+                                isinstance(node.args[pos], ast.Name):
+                            target = resolve(node.args[pos].id)
+                            if target is not None:
+                                mark(target)
+                if wrapped is None:
+                    continue
+                # unwrap jax.jit(jax.shard_map(f, …), …)
+                while isinstance(wrapped, ast.Call) and dotted_name(
+                        wrapped.func) in ("jax.shard_map", "shard_map") \
+                        and wrapped.args:
+                    wrapped = wrapped.args[0]
+                if isinstance(wrapped, ast.Name):
+                    target = resolve(wrapped.id)
+                    if target is not None:
+                        mark(target, info["static"], info["donate"])
+
+    process_scope(list(src.tree.body), [])
+
+    # nested defs inside traced functions are traced with the outer taint.
+    # A body may already be directly marked (a lax.scan callback inside a
+    # jitted fn): UNION the outer taint in and re-process — taint only
+    # grows, so the loop terminates
     frontier = list(traced.values())
     while frontier:
         tf = frontier.pop()
         outer = tf.traced_params() | tf.outer_taint
         for node in walk_list(tf.fn):
-            if isinstance(node, ast.FunctionDef) and node is not tf.fn \
-                    and node not in traced:
-                inner = TracedFn(node, set(), set(), set(outer))
-                traced[node] = inner
-                frontier.append(inner)
+            if isinstance(node, ast.FunctionDef) and node is not tf.fn:
+                if node not in traced:
+                    inner = TracedFn(node, set(), set(), set(outer))
+                    traced[node] = inner
+                    frontier.append(inner)
+                elif not (outer <= traced[node].outer_taint):
+                    traced[node].outer_taint |= outer
+                    frontier.append(traced[node])
     return list(traced.values())
 
 
@@ -237,10 +321,29 @@ def _check_one(src: SourceFile, tf: TracedFn) -> List[Finding]:
                     f"Python {'while' if isinstance(node, ast.While) else 'if'}"
                     " on a traced value inside a jit/shard_map function — "
                     "use jnp.where / lax.cond, or mark the argument static"))
-        # --- DC302 / DC303: calls
+        # --- DC302 / DC303 / DC305: calls
         if isinstance(node, ast.Call):
+            # DC305: sync methods on a traced receiver (x.block_until_ready()
+            # / loss.item()), including subscripted receivers (losses[-1])
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SYNC_ATTR_CALLS and \
+                    _names(node.func.value) & taint and \
+                    not _shape_derived(node.func.value):
+                findings.append(Finding(
+                    src.path, node.lineno, "DC305",
+                    f".{node.func.attr}() on a traced value inside a "
+                    "jit/scan body — a host-device sync in the step hot "
+                    "path; fetch AFTER the jitted call returns"))
             dname = dotted_name(node.func)
             if dname:
+                if dname in _SYNC_FN_CALLS and any(
+                        _names(a) & taint and not _shape_derived(a)
+                        for a in node.args):
+                    findings.append(Finding(
+                        src.path, node.lineno, "DC305",
+                        f"{dname}(...) on a traced value inside a jit/scan "
+                        "body — a device->host transfer in the step hot "
+                        "path; use jnp ops inside, convert outside"))
                 if any(dname.startswith(p) for p in _HOST_STATE_PREFIXES) \
                         or dname in _HOST_STATE_CALLS:
                     findings.append(Finding(

@@ -25,53 +25,13 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-
-@jax.custom_vjp
-def max_pool_2x2(x: jnp.ndarray) -> jnp.ndarray:
-    """2×2 stride-2 max pool via reshape+max — the fast-backward pooling.
-
-    Forward values equal ``nn.max_pool(x, (2, 2), strides=(2, 2))`` exactly
-    (non-overlapping windows). The point is the BACKWARD: ``nn.max_pool``'s
-    vjp lowers to XLA ``select_and_scatter``, measured at 7.1 µs of the
-    57.8 µs batch-64 AlexNet train step (12%, device-true); this
-    formulation's backward is a first-max one-hot select over the four
-    window slots — plain elementwise ops XLA fuses — and cuts the step to
-    53.9 µs (+7.2% img/s). The custom vjp routes each window's cotangent
-    to the FIRST maximal element in window row-major order, matching both
-    torch's MaxPool2d and the previous select_and_scatter lowering
-    bit-for-bit on ties (common right after relu, where windows tie at 0)
-    — NOT ``jnp.max``'s default split-among-ties vjp — so training
-    trajectories (and the matched-init torch parity leg) are unchanged.
-    Requires even spatial dims.
-    """
-    return _pool2_fwd(x)[0]
-
-
-def _pool2_windows(x):
-    b, h, w, c = x.shape
-    xw = x.reshape(b, h // 2, 2, w // 2, 2, c).transpose(0, 1, 3, 2, 4, 5)
-    return xw.reshape(b, h // 2, w // 2, 4, c)  # window row-major slot order
-
-
-def _pool2_fwd(x):
-    xw = _pool2_windows(x)
-    m = xw.max(axis=3)
-    return m, (x, m)
-
-
-def _pool2_bwd(res, g):
-    x, m = res
-    b, h, w, c = x.shape
-    xw = _pool2_windows(x)
-    eq = (xw == m[:, :, :, None, :])
-    # first max in slot order: an equal slot wins iff no earlier slot equals
-    first = eq & (jnp.cumsum(eq, axis=3) == 1)
-    scat = first.astype(g.dtype) * g[:, :, :, None, :]
-    gx = scat.reshape(b, h // 2, w // 2, 2, 2, c).transpose(0, 1, 3, 2, 4, 5)
-    return (gx.reshape(b, h, w, c),)
-
-
-max_pool_2x2.defvjp(_pool2_fwd, _pool2_bwd)
+# the reshape-max pool (first-max tie vjp, round 5) moved to the kernels
+# layer so the Pallas-fused conv epilogues (round 9) share its tie
+# semantics; re-exported here because this is its historical import site
+from distributed_ml_pytorch_tpu.ops.fused_conv import (  # noqa: F401
+    max_pool_2x2,
+    relu_pool2,
+)
 
 
 class LeNet(nn.Module):
@@ -101,10 +61,22 @@ class LeNet(nn.Module):
 
 
 class AlexNet(nn.Module):
-    """CIFAR-sized AlexNet (reference ``example/models.py:25-49``)."""
+    """CIFAR-sized AlexNet (reference ``example/models.py:25-49``).
+
+    ``fused_epilogue=True`` swaps each relu→pool tail for the Pallas-fused
+    ``relu_pool2`` kernel (``ops/fused_conv.py``): bit-identical forward,
+    first-max-tie backward matching the unfused chain element-for-element
+    (tested), so the flag changes kernels, never trajectories or the param
+    tree — checkpoints are interchangeable. Off-TPU it lowers to the exact
+    unfused chain, so the flag is safe to leave on. The conv bias stays
+    inside ``nn.Conv`` (XLA folds it into the conv epilogue — the audit's
+    shipped state); the fused op's optional-bias form exists for callers
+    that keep bias separate.
+    """
 
     num_classes: int = 10
     dtype: Any = jnp.float32
+    fused_epilogue: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -113,26 +85,31 @@ class AlexNet(nn.Module):
         conv = lambda f, k, s, p, name: nn.Conv(
             f, (k, k), strides=(s, s), padding=[(p, p), (p, p)], dtype=self.dtype, name=name
         )
-        x = nn.relu(conv(64, 11, 4, 5, "conv1")(x))      # 32→8
-        x = max_pool_2x2(x)                               # 8→4
-        x = nn.relu(conv(192, 5, 1, 2, "conv2")(x))
-        x = max_pool_2x2(x)                               # 4→2
+        pool_tail = (
+            relu_pool2 if self.fused_epilogue
+            else lambda v: max_pool_2x2(nn.relu(v))
+        )
+        x = pool_tail(conv(64, 11, 4, 5, "conv1")(x))     # 32→8→4
+        x = pool_tail(conv(192, 5, 1, 2, "conv2")(x))     # 4→2
         x = nn.relu(conv(384, 3, 1, 1, "conv3")(x))
         x = nn.relu(conv(256, 3, 1, 1, "conv4")(x))
-        x = nn.relu(conv(256, 3, 1, 1, "conv5")(x))
-        x = max_pool_2x2(x)                               # 2→1
+        x = pool_tail(conv(256, 3, 1, 1, "conv5")(x))     # 2→1
         x = x.reshape((x.shape[0], -1))                   # 256 (:47-48)
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="classifier")(x)
         return x.astype(jnp.float32)
 
 
-def get_model(name: str, num_classes: int = 10, dtype: Any = jnp.float32) -> nn.Module:
-    """Model registry keyed by the CLI ``--model`` flag."""
+def get_model(name: str, num_classes: int = 10, dtype: Any = jnp.float32,
+              fused_epilogue: bool = False) -> nn.Module:
+    """Model registry keyed by the CLI ``--model`` flag. ``fused_epilogue``
+    selects the Pallas conv-epilogue kernels where the model supports them
+    (AlexNet today; others ignore it)."""
     name = name.lower()
     if name == "lenet":
         return LeNet(num_classes=num_classes, dtype=dtype)
     if name == "alexnet":
-        return AlexNet(num_classes=num_classes, dtype=dtype)
+        return AlexNet(num_classes=num_classes, dtype=dtype,
+                       fused_epilogue=fused_epilogue)
     if name.startswith("resnet"):
         from distributed_ml_pytorch_tpu.models.resnet import get_resnet
 

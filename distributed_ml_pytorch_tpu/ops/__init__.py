@@ -3,7 +3,9 @@
 The reference's only in-tree "kernel" work is the per-step O(|θ|) flat
 accumulate / SGD apply on the raveled model (``asgd/optim/Asynchronous.py:
 54-55,68``); everything else lives in libtorch. Here those flat-vector ops are
-Pallas TPU kernels (``fused_update``), and the attention stack that the
+Pallas TPU kernels (``fused_update``), the CNN conv epilogues
+(bias+relu+2x2-pool) are blocked Pallas kernels with first-max-tie custom
+vjps (``fused_conv``), and the attention stack that the
 long-context path needs (``attention``) provides a differentiable Pallas
 flash-attention kernel (forward + custom_vjp backward) plus the blockwise
 (online-softmax) scan formulation used by ring attention
@@ -13,6 +15,11 @@ flash-attention kernel (forward + custom_vjp backward) plus the blockwise
 from distributed_ml_pytorch_tpu.ops.fused_update import (
     downpour_accumulate,
     flat_axpy,
+)
+from distributed_ml_pytorch_tpu.ops.fused_conv import (
+    bias_relu,
+    max_pool_2x2,
+    relu_pool2,
 )
 from distributed_ml_pytorch_tpu.ops.attention import (
     attention_reference,
@@ -25,6 +32,9 @@ from distributed_ml_pytorch_tpu.ops.attention import (
 __all__ = [
     "flat_axpy",
     "downpour_accumulate",
+    "bias_relu",
+    "max_pool_2x2",
+    "relu_pool2",
     "flash_attention",
     "auto_attention",
     "blockwise_attention",

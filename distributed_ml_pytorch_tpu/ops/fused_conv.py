@@ -1,0 +1,383 @@
+"""Pallas TPU kernels for the CNN conv epilogues: bias+relu(+2x2 pool).
+
+Why this exists (round-5 per-fusion audit, BASELINE.md #1): the batch-64
+AlexNet step is conv-geometry-bound, and the *conv formulation* contest was
+won by XLA's native lowering (space-to-depth and both im2col forms measured
+slower). What remains attackable is the TAIL of each conv: the pool
+forwards (~1.6 µs/step) and ~30 sub-µs elementwise/bookkeeping fusions
+(~8 µs of the 54 µs step) — relu masks, pool window restructures, select
+chains in the backward. This module fuses each conv's epilogue —
+``relu`` (+optional bias) and ``relu → 2x2 maxpool`` — into ONE blocked
+Pallas kernel forward and ONE kernel backward (custom VJPs), so the
+epilogue chain costs one VMEM pass instead of a string of small fusions.
+
+Numerics contract (tested in ``tests/test_fused_conv.py``):
+
+- forward is BIT-IDENTICAL to the XLA lowering
+  (``max_pool_2x2(jax.nn.relu(x + b))``) — the ops are the same adds /
+  maxima in the same order, so trajectories and the torch-parity legs are
+  untouched when a model flips the fused flag;
+- backward routes each pool window's cotangent to the FIRST maximal
+  element in window row-major order (the ``max_pool_2x2`` tie contract,
+  matching torch's MaxPool2d) and applies the relu mask exactly as
+  ``jax.nn.relu``'s vjp does (gradient at 0 is 0) — the two compose to
+  ``gm = where(m > 0, g, 0)`` routed to the first-max slot, equal to the
+  unfused chain's cotangent element-for-element.
+
+Layout: the 2x2 window restructure is done OUTSIDE the kernel by a
+row-major-free reshape ``(N, H, W, C) -> (N*H/2, 2, W/2, 2, C)`` (pure
+dimension splits/merges — no data movement), so the kernel sees window
+slots at static indices on leading/sublane axes and never needs strided
+or lane-crossing accesses; channels stay the lane dimension. Blocks are
+rows x full-(2, W/2, 2, C) with a ``cdiv`` grid; the ragged final block
+is safe WITHOUT explicit masks because every kernel is elementwise (or a
+same-position slot max) — each output element depends only on its own
+input positions, so Pallas's OOB read padding produces garbage only in
+lanes whose writes are clipped. A kernel that adds any cross-row op
+(reduction, shift) must add real masks.
+
+Fallback: on non-TPU backends the public entry points lower to the exact
+XLA chain — same values, same vjp — and ``tests`` cover the kernels on
+CPU through ``force_pallas_interpret``. Domains: ``bias_relu`` accepts
+any rank; the pooled entry point's domain IS ``max_pool_2x2``'s (rank-4
+NHWC with even, nonzero spatial dims — ``pool2_tiles``) and it raises
+``ValueError`` outside it rather than crash in a reshape: no 2x2
+stride-2 pool is defined for those shapes, fused or not.
+
+This module also OWNS the reshape-max pool (``max_pool_2x2``, moved here
+from ``models/cnn.py`` which re-exports it) so the fused ops and the
+standalone pool share one tie-semantics implementation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributed_ml_pytorch_tpu.ops.fused_update import (  # noqa: F401
+    _interpret,
+    force_pallas_interpret,
+)
+
+#: target bytes for the main operand block in VMEM (per fused_update's
+#: sizing: small enough to double-buffer, big enough to amortize grid steps)
+_BLOCK_BYTES = 1 << 19
+
+
+# ------------------------------------------------------------------ pooling
+# The reshape-max 2x2 pool and its first-max custom vjp (round 5). Forward
+# equals ``nn.max_pool(x, (2, 2), strides=(2, 2))`` exactly; the backward
+# replaces XLA's select_and_scatter (measured 7.1 µs of the 57.8 µs batch-64
+# step) with plain elementwise ops, routing each window's cotangent to the
+# FIRST maximal element in window row-major order — matching both torch's
+# MaxPool2d and the select_and_scatter lowering bit-for-bit on ties (common
+# right after relu, where windows tie at 0). Requires even spatial dims.
+
+@jax.custom_vjp
+def max_pool_2x2(x: jnp.ndarray) -> jnp.ndarray:
+    """2x2 stride-2 max pool via reshape+max — the fast-backward pooling."""
+    return _pool2_fwd(x)[0]
+
+
+def _pool2_windows(x):
+    b, h, w, c = x.shape
+    xw = x.reshape(b, h // 2, 2, w // 2, 2, c).transpose(0, 1, 3, 2, 4, 5)
+    return xw.reshape(b, h // 2, w // 2, 4, c)  # window row-major slot order
+
+
+def _pool2_fwd(x):
+    xw = _pool2_windows(x)
+    m = xw.max(axis=3)
+    return m, (x, m)
+
+
+def _pool2_bwd(res, g):
+    x, m = res
+    b, h, w, c = x.shape
+    xw = _pool2_windows(x)
+    eq = (xw == m[:, :, :, None, :])
+    # first max in slot order: an equal slot wins iff no earlier slot equals
+    first = eq & (jnp.cumsum(eq, axis=3) == 1)
+    scat = first.astype(g.dtype) * g[:, :, :, None, :]
+    gx = scat.reshape(b, h // 2, w // 2, 2, 2, c).transpose(0, 1, 3, 2, 4, 5)
+    return (gx.reshape(b, h, w, c),)
+
+
+max_pool_2x2.defvjp(_pool2_fwd, _pool2_bwd)
+
+
+# ------------------------------------------------------- shape gating
+
+def pool2_tiles(x) -> bool:
+    """True when the relu+pool kernel's window view exists: rank-4 NHWC
+    with even spatial dims (the pool's own requirement)."""
+    return (
+        getattr(x, "ndim", 0) == 4
+        and x.shape[1] % 2 == 0
+        and x.shape[2] % 2 == 0
+        and all(d > 0 for d in x.shape)
+    )
+
+
+def _use_pallas() -> bool:
+    return _interpret() or jax.default_backend() == "tpu"
+
+
+def _rows_block(row_bytes: int) -> int:
+    return max(1, min(256, _BLOCK_BYTES // max(1, row_bytes)))
+
+
+# ------------------------------------------------- relu(+bias) epilogue
+
+def _relu_kernel(has_bias):
+    if has_bias:
+        def kernel(x_ref, b_ref, o_ref):
+            o_ref[:] = jnp.maximum(x_ref[:] + b_ref[:], 0)
+    else:
+        def kernel(x_ref, o_ref):
+            o_ref[:] = jnp.maximum(x_ref[:], 0)
+    return kernel
+
+
+def _relu_bwd_kernel(has_bias):
+    # dz = where(z > 0, g, 0) — exactly jax.nn.relu's vjp (gradient at 0
+    # is 0), with the bias add recomputed rather than saved
+    if has_bias:
+        def kernel(x_ref, b_ref, g_ref, o_ref):
+            o_ref[:] = jnp.where(x_ref[:] + b_ref[:] > 0, g_ref[:], 0)
+    else:
+        def kernel(x_ref, g_ref, o_ref):
+            o_ref[:] = jnp.where(x_ref[:] > 0, g_ref[:], 0)
+    return kernel
+
+
+def _rows_view(x):
+    c = x.shape[-1]
+    return x.reshape(-1, c)  # free: merges leading dims only
+
+
+def _bias_relu_pallas(x, bias, g=None):
+    """Forward (g None) or backward (g = cotangent) elementwise kernel."""
+    x2 = _rows_view(x)
+    r, c = x2.shape
+    br = _rows_block(4 * c)
+    row_spec = pl.BlockSpec((br, c), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    bias_spec = pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    operands, specs = [x2], [row_spec]
+    if bias is not None:
+        operands.append(bias.reshape(1, c))
+        specs.append(bias_spec)
+    if g is not None:
+        operands.append(_rows_view(g))
+        specs.append(row_spec)
+    kernel = (_relu_bwd_kernel if g is not None else _relu_kernel)(
+        bias is not None)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+        grid=(pl.cdiv(r, br),),
+        in_specs=specs,
+        out_specs=row_spec,
+        interpret=_interpret(),
+    )(*operands)
+    return out.reshape(x.shape)
+
+
+def bias_relu(x: jnp.ndarray, bias=None) -> jnp.ndarray:
+    """``relu(x + bias)`` as one blocked Pallas kernel (XLA chain off-TPU).
+
+    ``bias`` broadcasts over the last axis (``None`` = pure relu). The
+    custom vjp computes ``dz = where(z > 0, g, 0)`` in one backward kernel
+    and reduces ``db`` outside (one small XLA reduction).
+    """
+    return _bias_relu(x, bias)
+
+
+@jax.custom_vjp
+def _bias_relu(x, bias):
+    return _bias_relu_fwd(x, bias)[0]
+
+
+def _bias_relu_fwd(x, bias):
+    if _use_pallas() and x.ndim >= 2:
+        y = _bias_relu_pallas(x, bias)
+    else:
+        y = jax.nn.relu(x if bias is None else x + bias)
+    return y, (x, bias)
+
+
+def _bias_relu_bwd(res, g):
+    x, bias = res
+    if _use_pallas() and x.ndim >= 2:
+        dz = _bias_relu_pallas(x, bias, g=g)
+    else:
+        z = x if bias is None else x + bias
+        dz = jnp.where(z > 0, g, jnp.zeros_like(g))
+    if bias is None:
+        return dz, None
+    db = dz.sum(axis=tuple(range(dz.ndim - 1))).reshape(bias.shape)
+    return dz, db
+
+
+_bias_relu.defvjp(_bias_relu_fwd, _bias_relu_bwd)
+
+
+# -------------------------------------------- relu(+bias) -> 2x2 pool
+
+def _windows5(x):
+    """(N, H, W, C) -> (N*H/2, 2, W/2, 2, C): pure splits/merges of
+    contiguous row-major dims — a free (metadata-only) reshape, unlike
+    ``_pool2_windows``'s transpose."""
+    n, h, w, c = x.shape
+    return x.reshape(n * (h // 2), 2, w // 2, 2, c)
+
+
+def _slots(v):
+    """The four pool slots of a (R, 2, W2, 2, C) window block, in window
+    row-major order — static leading/sublane indices only."""
+    return v[:, 0, :, 0, :], v[:, 0, :, 1, :], v[:, 1, :, 0, :], v[:, 1, :, 1, :]
+
+
+def _pool_kernel(has_bias):
+    def body(xw_ref, b_ref, o_ref):
+        v = xw_ref[:]
+        if b_ref is not None:
+            v = v + b_ref[:]  # (1, C) broadcasts over (BR, 2, W2, 2, C)
+        y = jnp.maximum(v, 0)
+        y00, y01, y10, y11 = _slots(y)
+        o_ref[:] = jnp.maximum(jnp.maximum(y00, y01), jnp.maximum(y10, y11))
+
+    if has_bias:
+        def kernel(xw_ref, b_ref, o_ref):
+            body(xw_ref, b_ref, o_ref)
+    else:
+        def kernel(xw_ref, o_ref):
+            body(xw_ref, None, o_ref)
+    return kernel
+
+
+def _pool_bwd_kernel(has_bias):
+    def body(xw_ref, b_ref, m_ref, g_ref, dx_ref):
+        v = xw_ref[:]
+        if b_ref is not None:
+            v = v + b_ref[:]
+        y = jnp.maximum(v, 0)
+        y00, y01, y10, y11 = _slots(y)
+        m = m_ref[:]
+        # first max in window row-major slot order; the relu mask collapses
+        # to (m > 0): the selected slot has y == m, and y > 0 iff z > 0
+        e00 = y00 == m
+        e01 = (y01 == m) & ~e00
+        e10 = (y10 == m) & ~e00 & ~e01
+        e11 = (y11 == m) & ~e00 & ~e01 & ~e10
+        gm = jnp.where(m > 0, g_ref[:], jnp.zeros_like(m))
+        zero = jnp.zeros_like(gm)
+        dx_ref[:, 0, :, 0, :] = jnp.where(e00, gm, zero)
+        dx_ref[:, 0, :, 1, :] = jnp.where(e01, gm, zero)
+        dx_ref[:, 1, :, 0, :] = jnp.where(e10, gm, zero)
+        dx_ref[:, 1, :, 1, :] = jnp.where(e11, gm, zero)
+
+    if has_bias:
+        def kernel(xw_ref, b_ref, m_ref, g_ref, dx_ref):
+            body(xw_ref, b_ref, m_ref, g_ref, dx_ref)
+    else:
+        def kernel(xw_ref, m_ref, g_ref, dx_ref):
+            body(xw_ref, None, m_ref, g_ref, dx_ref)
+    return kernel
+
+
+def _relu_pool_pallas(x, bias, m=None, g=None):
+    """Forward (m/g None) or backward (m = pooled output, g = cotangent)."""
+    n, h, w, c = x.shape
+    h2, w2 = h // 2, w // 2
+    xw = _windows5(x)
+    r = xw.shape[0]
+    br = _rows_block(4 * w2 * c * 4)  # 4 window slots x w2 x c, f32 bytes
+    xw_spec = pl.BlockSpec(
+        (br, 2, w2, 2, c), lambda i: (i, 0, 0, 0, 0), memory_space=pltpu.VMEM)
+    out_spec = pl.BlockSpec(
+        (br, w2, c), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
+    operands, specs = [xw], [xw_spec]
+    if bias is not None:
+        operands.append(bias.reshape(1, c))
+        specs.append(pl.BlockSpec(
+            (1, c), lambda i: (0, 0), memory_space=pltpu.VMEM))
+    if g is not None:
+        operands += [m.reshape(r, w2, c), g.reshape(r, w2, c)]
+        specs += [out_spec, out_spec]
+        kernel = _pool_bwd_kernel(bias is not None)
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(xw.shape, x.dtype),
+            grid=(pl.cdiv(r, br),),
+            in_specs=specs,
+            out_specs=xw_spec,
+            interpret=_interpret(),
+        )(*operands)
+        return out.reshape(x.shape)
+    kernel = _pool_kernel(bias is not None)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((r, w2, c), x.dtype),
+        grid=(pl.cdiv(r, br),),
+        in_specs=specs,
+        out_specs=out_spec,
+        interpret=_interpret(),
+    )(*operands)
+    return out.reshape(n, h2, w2, c)
+
+
+def relu_pool2(x: jnp.ndarray, bias=None) -> jnp.ndarray:
+    """``max_pool_2x2(relu(x + bias))`` as ONE blocked Pallas kernel.
+
+    The conv epilogue of the AlexNet recipe (relu then 2x2 stride-2 pool,
+    optionally with the conv bias folded in), fused forward AND backward:
+    one kernel each instead of the add/max/window-restructure/select
+    fusion chain. Forward is bit-identical to the XLA lowering; the
+    backward keeps ``max_pool_2x2``'s first-max tie contract and
+    ``jax.nn.relu``'s gradient-at-0 = 0. Falls back to the exact XLA
+    chain off-TPU; the domain is ``max_pool_2x2``'s own (rank-4 NHWC,
+    even nonzero spatial dims — ``pool2_tiles``), raising ``ValueError``
+    outside it.
+    """
+    if not pool2_tiles(x):
+        raise ValueError(
+            f"relu_pool2 needs rank-4 NHWC with even, nonzero spatial dims "
+            f"(got shape {getattr(x, 'shape', None)}); no 2x2 stride-2 pool "
+            f"is defined for this shape — use bias_relu plus your own "
+            f"pooling instead")
+    return _relu_pool2(x, bias)
+
+
+@jax.custom_vjp
+def _relu_pool2(x, bias):
+    return _relu_pool_fwd(x, bias)[0]
+
+
+def _relu_pool_fwd(x, bias):
+    if _use_pallas() and pool2_tiles(x):
+        m = _relu_pool_pallas(x, bias)
+    else:
+        m = max_pool_2x2(jax.nn.relu(x if bias is None else x + bias))
+    return m, (x, bias, m)
+
+
+def _relu_pool_bwd(res, g):
+    x, bias, m = res
+    if _use_pallas() and pool2_tiles(x):
+        dz = _relu_pool_pallas(x, bias, m=m, g=g)
+    else:
+        # the exact unfused chain: pool vjp (first-max) then relu mask
+        z = x if bias is None else x + bias
+        y = jax.nn.relu(z)
+        dy = jax.vjp(max_pool_2x2, y)[1](g)[0]
+        dz = jnp.where(z > 0, dy, jnp.zeros_like(dy))
+    if bias is None:
+        return dz, None
+    db = dz.sum(axis=tuple(range(dz.ndim - 1))).reshape(bias.shape)
+    return dz, db
+
+
+_relu_pool2.defvjp(_relu_pool_fwd, _relu_pool_bwd)

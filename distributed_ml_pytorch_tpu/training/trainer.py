@@ -335,6 +335,128 @@ def make_scan_train_step(model, tx: optax.GradientTransformation) -> Callable:
     return scan_train_step
 
 
+def _accum_update_body(model, tx, microbatch: int, state: TrainState,
+                       images, labels, dropout_rng,
+                       effective_update_batch: Optional[int],
+                       remat: bool):
+    """Unjitted large-batch update via a microbatch accumulation scan.
+
+    ``images`` is one large batch ``(B, ...)`` with ``B = k·microbatch``;
+    the scan runs the forward+backward on each microbatch and accumulates
+    the SUM of per-microbatch mean gradients into a zeros-initialized
+    accumulator (a scan carry — XLA updates it in place, so peak HBM is
+    one microbatch's activations + one gradient-sized buffer, never the
+    full batch's activations).
+
+    Update semantics (the large-batch recipe knob):
+
+    - ``effective_update_batch=None`` — the accumulated grad is divided
+      by ``k``: exactly the mean over the full ``B`` (one large-batch
+      step; equal to the unaccumulated step up to float summation order).
+    - ``effective_update_batch=e`` (e.g. 64) — the accumulated grad is
+      scaled by ``microbatch/e``, making it ``Σ`` of the ``B/e``
+      batch-``e`` mean gradients at the current params. For SGD the
+      applied update is then the SUM of the ``B/e`` reference-recipe
+      batch-``e`` updates evaluated at frozen params — first-order
+      equivalent to ``B/e`` sequential recipe steps (linear-scaling, per
+      the weight-update engineering of arXiv:2004.13336) — so the
+      throughput leg preserves the batch-64 *effective update* while the
+      compute runs at large-batch geometry.
+
+    ``remat`` wraps the microbatch loss in ``jax.checkpoint`` (recompute
+    activations in the backward) — measured OFF as the default: AlexNet
+    microbatch activations are far below HBM, so remat only adds FLOPs.
+    """
+    b = images.shape[0]
+    if b % microbatch:
+        raise ValueError(f"batch {b} must divide by microbatch {microbatch}")
+    k = b // microbatch
+    if effective_update_batch is not None:
+        if effective_update_batch <= 0:
+            raise ValueError(
+                f"effective_update_batch must be positive, got "
+                f"{effective_update_batch} (use None for the large-batch "
+                f"mean update)")
+        scale = microbatch / float(effective_update_batch)
+    else:
+        scale = 1.0 / k
+    mi = images.reshape(k, microbatch, *images.shape[1:])
+    ml = labels.reshape(k, microbatch)
+
+    def micro_loss(params, bx, by, rng):
+        logits = model.apply(
+            {"params": params}, bx, train=True, rngs={"dropout": rng})
+        return cross_entropy_loss(logits, by)
+
+    if remat:
+        micro_loss = jax.checkpoint(micro_loss)
+    step_key = jax.random.fold_in(dropout_rng, state.step)
+
+    def body(carry, batch):
+        acc, loss_sum, j = carry
+        bx, by = batch
+        rng = jax.random.fold_in(step_key, j)  # unique per (update, micro)
+        loss, grads = jax.value_and_grad(micro_loss)(state.params, bx, by, rng)
+        acc = jax.tree.map(jnp.add, acc, grads)
+        return (acc, loss_sum + loss, j + 1), None
+
+    zeros = jax.tree.map(jnp.zeros_like, state.params)
+    carry0 = (zeros, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32))
+    (acc, loss_sum, _), _ = jax.lax.scan(body, carry0, (mi, ml))
+    grads = jax.tree.map(lambda gsum: gsum * scale, acc)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    new_state = state.replace(
+        params=params, opt_state=opt_state, step=state.step + 1)
+    return new_state, loss_sum / k
+
+
+def make_accum_train_step(model, tx: optax.GradientTransformation,
+                          microbatch: int,
+                          effective_update_batch: Optional[int] = None,
+                          remat: bool = False) -> Callable:
+    """ONE optimizer update from a large batch via a microbatch scan.
+
+    ``(state, images [B, ...], labels [B], dropout_rng) → (state, loss)``
+    with ``B`` a multiple of ``microbatch``. See :func:`_accum_update_body`
+    for the accumulator and update-scaling semantics; the state is donated
+    so params/opt-state update in place.
+    """
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def accum_step(state: TrainState, images, labels, dropout_rng):
+        return _accum_update_body(
+            model, tx, microbatch, state, images, labels, dropout_rng,
+            effective_update_batch, remat)
+
+    return accum_step
+
+
+def make_scan_accum_train_step(model, tx: optax.GradientTransformation,
+                               microbatch: int,
+                               effective_update_batch: Optional[int] = None,
+                               remat: bool = False) -> Callable:
+    """U accumulated large-batch updates in ONE compiled program.
+
+    ``(state, images [U, B, ...], labels [U, B], dropout_rng) →
+    (state, losses [U])`` — the :func:`make_scan_train_step` analog for
+    the gradient-accumulation recipe, so the large-batch bench legs pay
+    host dispatch once per U updates like the parity leg does.
+    """
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def scan_accum_step(state: TrainState, images, labels, dropout_rng):
+        def outer(st, batch):
+            bx, by = batch
+            return _accum_update_body(
+                model, tx, microbatch, st, bx, by, dropout_rng,
+                effective_update_batch, remat)
+
+        return jax.lax.scan(outer, state, (images, labels))
+
+    return scan_accum_step
+
+
 def make_eval_fn(model) -> Callable:
     """Jitted per-batch eval: (summed-mean loss contribution, predictions)."""
 

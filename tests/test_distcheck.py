@@ -802,6 +802,173 @@ def test_dc304_fires_inside_loop_bodies(tmp_path):
     assert not active
 
 
+def test_dc305_host_device_syncs_in_traced_fns(tmp_path):
+    """ISSUE 9 satellite: block_until_ready / .item() / np.asarray on a
+    traced value inside a jit (or scan-body) function is a host-device
+    sync in the step hot path — the perf twin of DC301-304."""
+    broken = {"step.py": """
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def f(x):
+            y = x * 2
+            y.block_until_ready()
+            return y
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC305"]
+    broken = {"step.py": """
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def f(x):
+            host = np.asarray(x)
+            return host.sum()
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC305"]
+    # scan bodies are nested defs inside a traced fn: taint flows in, and
+    # subscripted receivers (losses[-1].item()) are still caught
+    broken = {"step.py": """
+        import jax
+
+        @jax.jit
+        def train(state, batches):
+            def body(st, b):
+                loss = st + b
+                return st, loss.item()
+
+            return jax.lax.scan(body, state, batches)
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC305"]
+
+
+def test_dc305_fires_in_unjitted_scan_body(tmp_path):
+    """A ``lax.scan`` body traces even when the enclosing function is not
+    jitted — the finder marks bodies handed to scan/fori_loop/while_loop
+    directly (fori_loop's body is its THIRD argument)."""
+    broken = {"step.py": """
+        import numpy as np
+        import jax
+
+        def drive(state, batches):
+            def body(st, b):
+                host = np.asarray(b)
+                return st + host.sum(), st
+
+            return jax.lax.scan(body, state, batches)
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC305"]
+    broken = {"step.py": """
+        import jax
+
+        def drive(x):
+            def body(i, acc):
+                acc.block_until_ready()
+                return acc + i
+
+            return jax.lax.fori_loop(0, 10, body, x)
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC305"]
+
+
+def test_scan_body_name_collision_resolves_lexically(tmp_path):
+    """``def body`` is the convention for scan bodies AND host-only
+    helpers; the finder must resolve the callback name from the call
+    site's scope chain, not a file-wide first-def-wins map. Here the
+    FIRST ``body`` is host-only (its np.asarray is fine) and the SECOND,
+    inside another function, is the real scan body with the sync bug."""
+    broken = {"step.py": """
+        import numpy as np
+        import jax
+
+        def host_prep(rows):
+            def body(row):
+                return np.asarray(row).sum()
+
+            return [body(r) for r in rows]
+
+        def drive(state, batches):
+            def body(st, b):
+                host = np.asarray(b)
+                return st + host.sum(), st
+
+            return jax.lax.scan(body, state, batches)
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC305"]
+    assert active[0].line > 10, active[0].render()  # the scan body, not host_prep's
+
+
+def test_scan_call_inside_lambda_still_marks_body(tmp_path):
+    """Lambda bodies are transparent to the traced-fn finder: a
+    ``lax.scan(body, …)`` sited inside a lambda (the PHASES-table idiom)
+    must still mark ``body`` — a coverage hole the scope-aware rewrite
+    briefly opened."""
+    broken = {"step.py": """
+        import numpy as np
+        import jax
+
+        def drive(state, batches):
+            def body(st, b):
+                host = np.asarray(b)
+                return st + host.sum(), st
+
+            run = lambda: jax.lax.scan(body, state, batches)
+            return run()
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC305"]
+
+
+def test_scan_body_in_jitted_fn_keeps_outer_taint(tmp_path):
+    """Regression for the direct scan-body marking: a body nested inside a
+    jitted fn must STILL see the outer function's traced params as taint
+    (branching on a closed-over traced value is DC301 even though the body
+    is also handed to lax.scan directly)."""
+    broken = {"step.py": """
+        import jax
+
+        @jax.jit
+        def train(state, flag, batches):
+            def body(st, b):
+                if flag:
+                    return st + b, st
+                return st, st
+
+            return jax.lax.scan(body, state, batches)
+    """}
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC301"]
+
+
+def test_dc305_clean_twins_stay_silent(tmp_path):
+    # the correct shape: fetch AFTER the jitted call returns, np.asarray
+    # on host values, and jnp ops inside the traced fn
+    clean = {"step.py": """
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return jnp.asarray(x) * 2
+
+        def drive(x, host_cfg):
+            scale = np.asarray(host_cfg)  # host value: no finding
+            y = f(x * scale)
+            y.block_until_ready()         # outside the traced fn: fine
+            return float(np.asarray(y))
+    """}
+    active, _ = _run(tmp_path, clean)
+    assert not active, [f.render() for f in active]
+
+
 def test_traced_detection_covers_shard_map_wrapping(tmp_path):
     broken = {"sharded.py": """
         import time

@@ -303,3 +303,117 @@ def test_prefetched_training_matches_unprefetched(tmp_path):
         np.testing.assert_allclose(r1["training_loss"], r2["training_loss"], rtol=1e-6)
     for p1, p2 in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
         np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-6)
+
+
+# ------------------------------------------------ large-batch accum (ISSUE 9)
+
+def test_accum_step_mean_mode_equals_big_batch_step():
+    """``effective_update_batch=None``: the microbatch accumulation scan
+    applies exactly the full-batch mean gradient — one large-batch step,
+    equal to ``make_train_step`` on the same batch up to summation order."""
+    from distributed_ml_pytorch_tpu.training.trainer import make_accum_train_step
+
+    model = AlexNet(num_classes=10)  # no dropout: deterministic
+    rng_np = np.random.default_rng(1)
+    images = rng_np.normal(size=(32, 32, 32, 3)).astype(np.float32)
+    labels = (np.arange(32) % 10).astype(np.int32)
+    drng = jax.random.key(1)
+
+    state_a, tx_a = create_train_state(model, jax.random.key(0), lr=0.05)
+    accum = make_accum_train_step(model, tx_a, microbatch=8)
+    state_a, loss_a = accum(state_a, images, labels, drng)
+
+    state_b, tx_b = create_train_state(model, jax.random.key(0), lr=0.05)
+    step = make_train_step(model, tx_b)
+    state_b, loss_b = step(state_b, images, labels, drng)
+
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7)
+
+
+def test_accum_step_effective_update_preserves_small_batch_recipe():
+    """``effective_update_batch=e``: the applied SGD update equals the SUM
+    of the B/e batch-``e`` recipe updates at frozen params — the
+    large-batch throughput leg's linear-scaling contract."""
+    import optax
+
+    from distributed_ml_pytorch_tpu.training.trainer import (
+        cross_entropy_loss,
+        make_accum_train_step,
+    )
+
+    model = AlexNet(num_classes=10)
+    rng_np = np.random.default_rng(2)
+    images = rng_np.normal(size=(32, 32, 32, 3)).astype(np.float32)
+    labels = (np.arange(32) % 10).astype(np.int32)
+
+    state, tx = create_train_state(model, jax.random.key(0), lr=0.05)
+    accum = make_accum_train_step(
+        model, tx, microbatch=16, effective_update_batch=8)
+    got, _ = accum(jax.tree.map(jax.numpy.copy, state), images, labels,
+                   jax.random.key(1))
+
+    def loss_fn(params, bx, by):
+        return cross_entropy_loss(model.apply({"params": params}, bx), by)
+
+    gsum = None
+    for j in range(4):  # B/e = 32/8 batch-8 mean grads at frozen params
+        g = jax.grad(loss_fn)(
+            state.params, images[j * 8:(j + 1) * 8], labels[j * 8:(j + 1) * 8])
+        gsum = g if gsum is None else jax.tree.map(jax.numpy.add, gsum, g)
+    upd, _ = tx.update(gsum, state.opt_state, state.params)
+    want = optax.apply_updates(state.params, upd)
+    for a, b in zip(jax.tree.leaves(got.params), jax.tree.leaves(want)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7)
+
+
+def test_scan_accum_step_matches_sequential_accum_steps():
+    """The U-update scan (the bench leg's compiled program) is exactly U
+    sequential accum dispatches — same params, same per-update losses,
+    same step count; remat=True changes memory, not values."""
+    from distributed_ml_pytorch_tpu.training.trainer import (
+        make_accum_train_step,
+        make_scan_accum_train_step,
+    )
+
+    model = AlexNet(num_classes=10)
+    rng_np = np.random.default_rng(3)
+    images = rng_np.normal(size=(3, 16, 32, 32, 3)).astype(np.float32)
+    labels = (np.arange(3 * 16) % 10).astype(np.int32).reshape(3, 16)
+    drng = jax.random.key(1)
+
+    state0, tx = create_train_state(model, jax.random.key(0), lr=0.05)
+    scan = make_scan_accum_train_step(model, tx, microbatch=4,
+                                      effective_update_batch=4)
+    sa, losses = scan(jax.tree.map(jax.numpy.copy, state0), images, labels, drng)
+
+    accum = make_accum_train_step(model, tx, microbatch=4,
+                                  effective_update_batch=4)
+    sb = jax.tree.map(jax.numpy.copy, state0)
+    seq_losses = []
+    for u in range(3):
+        sb, lu = accum(sb, images[u], labels[u], drng)
+        seq_losses.append(float(lu))
+    assert int(sa.step) == int(sb.step) == 3
+    np.testing.assert_allclose(np.asarray(losses), seq_losses, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(sa.params), jax.tree.leaves(sb.params)):
+        assert bool((a == b).all())
+
+    remat = make_scan_accum_train_step(model, tx, microbatch=4,
+                                       effective_update_batch=4, remat=True)
+    sr, _ = remat(jax.tree.map(jax.numpy.copy, state0), images, labels, drng)
+    for a, b in zip(jax.tree.leaves(sr.params), jax.tree.leaves(sa.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-8)
+
+
+def test_accum_step_rejects_indivisible_batch():
+    from distributed_ml_pytorch_tpu.training.trainer import make_accum_train_step
+
+    model = AlexNet(num_classes=10)
+    state, tx = create_train_state(model, jax.random.key(0), lr=0.05)
+    accum = make_accum_train_step(model, tx, microbatch=7)
+    images = np.zeros((16, 32, 32, 3), np.float32)
+    labels = np.zeros((16,), np.int32)
+    with pytest.raises(ValueError, match="divide"):
+        accum(state, images, labels, jax.random.key(1))
