@@ -137,6 +137,24 @@ health-demo:
 bench-health:
 	$(PY) bench_all.py --only health
 
+# MPMD pipeline-plane suite (ISSUE 10): stages as fleet members — per-stage
+# compiled programs over the reliable wire, coordinator StagePlacement,
+# stage kill -> lease-expiry detection -> checkpoint restart with
+# watermark-bounded microbatch replay (byte-identical chaos logs 3x),
+# stage speculation via standby takeover
+mpmd:
+	$(PY) -m pytest tests/ -q -m mpmd
+
+# one-command MPMD demo (prints the loss trajectory, stage-restart MTTR,
+# applied-microbatch accounting and chaos counts)
+mpmd-demo:
+	$(PY) -m distributed_ml_pytorch_tpu.coord.cli --mpmd
+
+# MPMD bench phase: steady-state pipeline throughput, bubble fraction,
+# and stage-kill MTTR before/during/after a restart
+bench-mpmd:
+	$(PY) bench_all.py --only mpmd
+
 # adaptive-wire suite (ISSUE 7): RTT-driven retransmission, window/credit
 # backpressure, circuit breakers, and seeded network weather (latency /
 # jitter / bandwidth caps / one-way degradation) — the training acceptance
@@ -187,4 +205,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-health bench-gate bench-compute chaos coord drill drill-demo fleet health health-demo netweather soak lint test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-health bench-gate bench-compute bench-mpmd chaos coord drill drill-demo fleet health health-demo mpmd mpmd-demo netweather soak lint test test-all verify-real-data graph install dist

@@ -1098,6 +1098,91 @@ def recovery_phase() -> None:
         f"{out['replayed_updates']}, chaos {out['chaos_counts']}")
 
 
+def mpmd_phase() -> None:
+    """Config 3, MPMD-pipeline-plane leg (ISSUE 10): a 4-stage pipeline of
+    fleet members over the reliable in-process wire. Leg 1 (steady state):
+    tokens/s through the fault-free fleet plus the measured BUBBLE
+    fraction (1 - sum of per-stage busy seconds / (stages x wall)). Leg 2
+    (stage kill): the middle stage is killed mid-schedule and restarted
+    from its per-stage checkpoint — stage-restart MTTR (vacancy ->
+    replacement StageReady) with throughput before/during/after, and the
+    applied-microbatch accounting reported as the no-double-apply check."""
+    import tempfile
+
+    from distributed_ml_pytorch_tpu.coord.stages import mpmd_scenario
+
+    # all shape knobs passed EXPLICITLY so the rates below can never skew
+    # against a changed scenario default
+    steps, n_stages, M, mb, seq = 16, 4, 4, 4, 8
+    shape = dict(n_stages=n_stages, n_microbatches=M, mb=mb, seq=seq)
+    warm = mpmd_scenario(base_dir=tempfile.mkdtemp(prefix="bench_mpmd_"),
+                         seed=0, steps=4, **shape)
+    if not warm["ok"]:
+        log(f"mpmd_phase warmup incomplete: {warm['errors']}")
+        return
+    out = mpmd_scenario(base_dir=tempfile.mkdtemp(prefix="bench_mpmd_"),
+                        seed=0, steps=steps, **shape)
+    if not out["ok"] or out["wall_s"] is None:
+        log(f"mpmd_phase steady leg incomplete: ok={out['ok']} "
+            f"errors={out['errors']}")
+        return
+    tok_per_step = M * mb * seq
+    steady = tok_per_step * (steps - 1) / out["wall_s"]
+    bubble = max(0.0, 1.0 - out["busy_s"] / (n_stages * out["wall_s"]))
+    emit(3, "mpmd_pipeline_steady", steady, "tokens/sec",
+         "in-process fleet, 1 core",
+         f"{n_stages}-stage MPMD pipeline (per-stage compiled programs "
+         f"over ReliableTransport), M={M} microbatches of {mb}x{seq} "
+         "tokens; driver step cadence, fault-free "
+         "(coord/stages.mpmd_scenario)")
+    emit(3, "mpmd_bubble_fraction", bubble * 100.0, "%",
+         "in-process fleet, 1 core",
+         "1 - sum(stage busy s) / (stages x wall s) over the steady run — "
+         "idle share of stage-seconds (schedule bubble + wire wait)")
+
+    kill_at = 6
+    out = mpmd_scenario(base_dir=tempfile.mkdtemp(prefix="bench_mpmd_"),
+                        seed=0, steps=steps, kill_stage=1,
+                        kill_at_step=kill_at, snapshot_at_step=2, **shape)
+    if not out["ok"] or out["stage_mttr_s"] is None:
+        log(f"mpmd_phase kill leg incomplete: ok={out['ok']} "
+            f"errors={out['errors']} events={out['events'][-5:]}")
+        return
+    emit(3, "mpmd_stage_restart_mttr", out["stage_mttr_s"] * 1e3, "ms",
+         "in-process fleet, 1 core",
+         f"middle stage killed at its step {kill_at} (silent; lease "
+         "expiry detection) -> checkpoint restart -> StageReady; "
+         "watermark-bounded replay refilled the in-flight microbatches "
+         f"(applied accounting {'OK' if out['applied_ok'] else 'BROKEN'}: "
+         "no microbatch applied twice)")
+
+    # throughput before/during/after on the driver's step-completion
+    # timeline (step_times[i] = completion instant of step i)
+    ts = out["step_times"]
+
+    def rate(a, b):
+        if b - a < 2 or b > len(ts):
+            return None
+        return tok_per_step * (b - 1 - a) / (ts[b - 1] - ts[a])
+
+    for name, value, win in (
+        ("before", rate(1, kill_at), f"steps 1-{kill_at}"),
+        ("during", rate(kill_at, kill_at + 4),
+         f"steps {kill_at}-{kill_at + 4} (kill -> lease expiry -> "
+         "restart -> replay)"),
+        ("after", rate(kill_at + 4, steps), f"steps {kill_at + 4}-{steps}"),
+    ):
+        if value is None:
+            log(f"mpmd_phase: window {name} too short to rate")
+            continue
+        emit(3, f"mpmd_stage_kill_throughput_{name}", value, "tokens/sec",
+             "in-process fleet, 1 core",
+             f"driver step-completion rate {win}; 4-stage pipeline, "
+             "middle stage killed and restarted from its checkpoint")
+    log(f"mpmd_phase: kill leg driver stats {out['driver_stats']}, "
+        f"events {out['events'][-3:]}")
+
+
 def health_phase() -> None:
     """Config 3, numerical-health leg (ISSUE 8): the immune-system scenario
     — 2 workers + 2 WAL'd shards behind the admission gate, one worker's
@@ -1827,6 +1912,7 @@ PHASES = {
     "elastic": lambda: elastic_phase(),
     "recovery": lambda: recovery_phase(),
     "health": lambda: health_phase(),
+    "mpmd": lambda: mpmd_phase(),
     "ps_tpu": lambda: ps_tpu_phase(),
     "transport": lambda: transport_phase(),
     "reliability": lambda: reliability_phase(),
@@ -1856,6 +1942,7 @@ def main(argv=None) -> None:
     elastic_phase()
     recovery_phase()
     health_phase()
+    mpmd_phase()
     ps_tpu_phase()
     transport_phase()
     reliability_phase()

@@ -20,6 +20,10 @@ decision (ranks, shard map, fleet size) becomes a runtime-negotiated one:
 - :mod:`~.elastic` — :class:`ElasticShardServer` (a ParameterServer whose
   range is coordinator-assigned and resizable mid-run) and the elastic
   worker loop used by the acceptance tests and ``coord/cli.py``.
+- :mod:`~.stages` — the MPMD pipeline plane's control side (ISSUE 10):
+  the versioned :class:`StagePlacement`, :class:`StageCoordinator`
+  (stage death detection, checkpoint-restart assignment with MTTR, stage
+  speculation), and the ``mpmd_scenario`` acceptance machinery.
 """
 
 from distributed_ml_pytorch_tpu.coord.shardmap import ShardEntry, ShardMap

@@ -13,7 +13,11 @@ Run a coordinator process for an elastic PS fleet over TCP::
     # self-contained elastic demo: in-process coordinator + 2 shard servers
     # + 2 workers; a 3rd worker joins mid-run, a shard server is crashed,
     # the map rebalances, training completes — the acceptance scenario as a
-    # one-command script
+    # one-command script (siblings: --drill runs the ISSUE 5 disaster-
+    # recovery drill, --health the ISSUE 8 immune-system scenario, and
+    # --mpmd the ISSUE 10 MPMD pipeline scenario: a 4-stage pipeline under
+    # drop/dup + weather whose middle stage is killed mid-schedule and
+    # restarted from its per-stage checkpoint)
     python -m distributed_ml_pytorch_tpu.coord.cli --demo
 
 The coordinator's TCP hub is ELASTIC: it binds and serves immediately
@@ -66,6 +70,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(ISSUE 8: admission gate + nacks, seeded SDC "
                         "poisoned worker, reputation revocation, "
                         "coordinator auto-rollback) and exit")
+    p.add_argument("--mpmd", action="store_true",
+                   help="run the in-process MPMD pipeline scenario "
+                        "(ISSUE 10: 4 stage fleet members under drop/dup "
+                        "+ weather, middle stage killed mid-schedule, "
+                        "checkpoint restart + watermark replay, MTTR "
+                        "reported) and exit")
     p.add_argument("--auto-rollback", action="store_true",
                    help="TCP hub mode: watch the fleet's loss telemetry "
                         "and drive RollbackRequest barriers to the last "
@@ -132,6 +142,15 @@ def run_health(args) -> int:
     return 0 if summary.get("ok") else 1
 
 
+def run_mpmd(args) -> int:
+    """The ISSUE 10 MPMD pipeline scenario as a one-command script."""
+    from distributed_ml_pytorch_tpu.coord.stages import mpmd_demo
+
+    summary = mpmd_demo(seed=args.seed)
+    print("mpmd scenario:", summary)
+    return 0 if summary.get("ok") else 1
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     print(args)
@@ -141,6 +160,8 @@ def main(argv=None) -> int:
         return run_drill(args)
     if args.health:
         return run_health(args)
+    if args.mpmd:
+        return run_mpmd(args)
 
     from distributed_ml_pytorch_tpu.coord.coordinator import Coordinator
     from distributed_ml_pytorch_tpu.utils.messaging import TCPTransport

@@ -63,7 +63,9 @@ _LOGGER = logging.getLogger(__name__)
 KIND_WORKER = 0
 KIND_SHARD = 1
 KIND_ENGINE = 2
-_KIND_NAMES = {KIND_WORKER: "worker", KIND_SHARD: "shard", KIND_ENGINE: "engine"}
+KIND_STAGE = 3  # MPMD pipeline stage member (ISSUE 10, coord/stages.py)
+_KIND_NAMES = {KIND_WORKER: "worker", KIND_SHARD: "shard",
+               KIND_ENGINE: "engine", KIND_STAGE: "stage"}
 
 
 def encode_join(kind: int, incarnation: int) -> np.ndarray:

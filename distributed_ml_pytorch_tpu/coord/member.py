@@ -37,6 +37,7 @@ import numpy as np
 from distributed_ml_pytorch_tpu.coord.coordinator import (
     KIND_ENGINE,
     KIND_SHARD,
+    KIND_STAGE,
     KIND_WORKER,
     decode_fleet,
     encode_join,
@@ -53,7 +54,8 @@ from distributed_ml_pytorch_tpu.utils.messaging import (
     _next_incarnation,
 )
 
-_KINDS = {"worker": KIND_WORKER, "shard": KIND_SHARD, "engine": KIND_ENGINE}
+_KINDS = {"worker": KIND_WORKER, "shard": KIND_SHARD, "engine": KIND_ENGINE,
+          "stage": KIND_STAGE}
 
 
 class FleetView:
@@ -128,6 +130,7 @@ class CoordClient:
         on_speculate: Optional[Callable[[int, int, int], None]] = None,
         on_snapshot: Optional[Callable[[int, int], None]] = None,
         on_rollback: Optional[Callable[[int, int], None]] = None,
+        on_stage_assign: Optional[Callable[[object], None]] = None,
         rollback_hold_ttl: float = 15.0,
     ):
         if kind not in _KINDS:
@@ -155,10 +158,17 @@ class CoordClient:
         #: mailbox in by assignment; called with ``(rollback_id, phase)``
         #: on the listener thread (phase 0 = start, 1 = complete/abandoned)
         self.on_rollback = on_rollback
+        #: PUBLIC and mutable like on_snapshot: the MPMD stage member /
+        #: driver (parallel/mpmd.py) wires its placement mailbox in by
+        #: assignment; called with the decoded ``StagePlacement`` on the
+        #: listener thread (ISSUE 10)
+        self.on_stage_assign = on_stage_assign
         self.rollback_hold_ttl = float(rollback_hold_ttl)
         self._lock = threading.Lock()
         self._latest_map: Optional[ShardMap] = None
         self._current_version = -1
+        self._latest_placement = None
+        self._placement_version = -1
         self._got_map = threading.Event()
         #: (push_count, step, ewma_ms, wire_open, nacks, bad_loss,
         #: loss_ewma, gnorm_ewma) — wire_open is the member's open-circuit-
@@ -217,6 +227,17 @@ class CoordClient:
                 self.on_snapshot(
                     _join16(payload[0], payload[1]),
                     _join16(payload[2], payload[3]))
+        elif code == MessageCode.StageAssign and payload.size >= 5:
+            from distributed_ml_pytorch_tpu.coord.stages import StagePlacement
+
+            p = StagePlacement.decode(payload)
+            with self._lock:
+                if p.version <= self._placement_version:
+                    return  # stale rebroadcast: never roll a consumer back
+                self._placement_version = p.version
+                self._latest_placement = p
+            if self.on_stage_assign is not None:
+                self.on_stage_assign(p)
         elif code == MessageCode.RollbackRequest and payload.size >= 7:
             if not np.isfinite(payload[:7]).all():
                 return
@@ -298,6 +319,20 @@ class CoordClient:
         """Report this shard's completed in-place rollback (ISSUE 8)."""
         self._send(MessageCode.RollbackDone, encode_rollback_done(
             rollback_id, map_version, lo, hi, apply_seq))
+
+    def stage_ready(self, stage: int, watermark: int) -> None:
+        """Announce this member serves pipeline stage ``stage`` at the
+        given microbatch watermark (ISSUE 10); the coordinator assigns it
+        into the StagePlacement and broadcasts StageAssign."""
+        from distributed_ml_pytorch_tpu.coord.stages import encode_stage_ready
+
+        self._send(MessageCode.StageReady, encode_stage_ready(
+            stage, self.incarnation, watermark))
+
+    def current_placement(self):
+        """The newest StagePlacement seen (None before the first)."""
+        with self._lock:
+            return self._latest_placement
 
     def leave(self) -> None:
         self._send(MessageCode.CoordLeave, encode_leave(self.incarnation))

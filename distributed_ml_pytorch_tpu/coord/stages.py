@@ -1,0 +1,831 @@
+"""Stage placement — the coordinator side of the MPMD pipeline plane
+(ISSUE 10 tentpole, with ``parallel/mpmd.py``).
+
+A :class:`StagePlacement` is to pipeline stages what ``ShardMap`` is to PS
+shards: the single versioned source of truth for WHICH fleet member serves
+which pipeline stage, carrying per-entry the member's rank, its
+INCARNATION (the restart detector: a changed incarnation means the
+endpoint lost its in-flight state), the stage's contiguous flat-param
+range ``[lo, hi)``, and the member's microbatch watermark (the recovery
+point its checkpoint promises). It rides the tagged-float32 wire as
+``MessageCode.StageAssign``.
+
+:class:`StageCoordinator` extends the base :class:`~.coordinator.Coordinator`
+with the stage lifecycle:
+
+- stage members join with kind ``stage`` and announce which stage they
+  serve via ``StageReady(stage, watermark)``; the coordinator assigns them
+  into the placement, bumps its version, and broadcasts;
+- a stage member silent past its lease is VACATED from the placement
+  (the pipeline pauses at that stage — neighbors hold their hand-offs);
+  when a replacement announces ``StageReady``, the assignment completes
+  and the vacancy duration is recorded as the stage-restart MTTR;
+- the placement is mirrored into the base ``shard_map`` (entries = stage
+  ranges, ``server_id`` = member rank), so the existing snapshot barrier
+  (``SnapshotRequest``/``SnapshotDone`` -> ``FleetManifest``) covers stage
+  checkpoints without modification — a stage fleet's manifest tiles
+  ``[0, n_params)`` exactly like a shard fleet's;
+- Sandblaster speculation applied to stages: a straggling stage member
+  (step-latency EWMA past ``straggler_factor`` x the fleet median, from
+  lease renewals) gets its stage raced by an idle STANDBY member, which
+  loads the victim's checkpoint and announces ``StageReady``; the
+  placement flip is the first-wins dedup and the victim goes passive.
+
+:func:`mpmd_scenario` is the acceptance machinery (the drill/demo pattern):
+one in-process fleet — StageCoordinator + S stage members + a driver, the
+data plane under seeded chaos + ReliableTransport — that trains, kills a
+middle stage mid-schedule, restarts it from its per-stage checkpoint, and
+returns everything the acceptance criteria judge (loss trajectory,
+applied-microbatch accounting, chaos log, MTTR, coordinator events).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from distributed_ml_pytorch_tpu.coord.coordinator import (
+    KIND_STAGE,
+    Coordinator,
+)
+from distributed_ml_pytorch_tpu.coord.shardmap import ShardEntry, ShardMap
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    MessageCode,
+    _join16,
+    _split16,
+)
+
+
+def encode_stage_ready(stage: int, incarnation: int,
+                       watermark: int) -> np.ndarray:
+    return np.asarray(
+        [float(stage), *_split16(incarnation), *_split16(watermark)],
+        np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class StageEntry:
+    """One stage's assignment: the member serving it (rank < 0 = vacant),
+    that member's incarnation, the stage's flat-param range, and the
+    watermark its checkpoint promises."""
+
+    stage: int
+    rank: int = -1
+    inc: int = 0
+    lo: int = 0
+    hi: int = 0
+    watermark: int = 0
+
+    @property
+    def vacant(self) -> bool:
+        return self.rank < 0
+
+
+@dataclasses.dataclass(frozen=True)
+class StagePlacement:
+    """An immutable, versioned assignment of pipeline stages to members."""
+
+    version: int
+    n_params: int
+    entries: Tuple[StageEntry, ...] = ()
+
+    def __init__(self, version: int, n_params: int,
+                 entries: Sequence[StageEntry] = ()):
+        object.__setattr__(self, "version", int(version))
+        object.__setattr__(self, "n_params", int(n_params))
+        object.__setattr__(self, "entries", tuple(entries))
+
+    def entry_for_rank(self, rank: int) -> Optional[StageEntry]:
+        for e in self.entries:
+            if e.rank == int(rank):
+                return e
+        return None
+
+    @property
+    def assigned(self) -> bool:
+        return bool(self.entries) and all(not e.vacant for e in self.entries)
+
+    # ------------------------------------------------------------- encoding
+    def encode(self) -> np.ndarray:
+        head = [*_split16(self.version), float(len(self.entries)),
+                *_split16(self.n_params)]
+        body: List[float] = []
+        for e in self.entries:
+            body += [float(e.stage), float(e.rank), *_split16(e.inc),
+                     *_split16(e.lo), *_split16(e.hi),
+                     *_split16(e.watermark)]
+        return np.asarray(head + body, np.float32)
+
+    @classmethod
+    def decode(cls, payload: np.ndarray) -> "StagePlacement":
+        if payload.size < 5 or not np.isfinite(payload[:5]).all():
+            raise ValueError(
+                f"malformed StagePlacement frame (size {payload.size})")
+        version = _join16(payload[0], payload[1])
+        k = int(payload[2])
+        n_params = _join16(payload[3], payload[4])
+        if k < 0 or payload.size < 5 + 10 * k:
+            raise ValueError(
+                f"StagePlacement frame declares {k} entries but carries "
+                f"{payload.size} floats")
+        entries = []
+        for i in range(k):
+            f = payload[5 + 10 * i: 5 + 10 * (i + 1)]
+            if not np.isfinite(f).all():
+                raise ValueError("non-finite StagePlacement entry")
+            entries.append(StageEntry(
+                stage=int(f[0]), rank=int(f[1]),
+                inc=_join16(f[2], f[3]), lo=_join16(f[4], f[5]),
+                hi=_join16(f[6], f[7]), watermark=_join16(f[8], f[9])))
+        entries.sort(key=lambda e: e.stage)
+        return cls(version, n_params, entries)
+
+
+def placement_deltas(old: Optional[StagePlacement], new: StagePlacement,
+                     *, inc_only: bool = False) -> List[StageEntry]:
+    """The entries of ``new`` whose serving member CHANGED vs ``old`` —
+    the one replay-trigger predicate both consumers share
+    (``MpmdStage._apply_placement`` re-ships retained hand-offs to these,
+    ``MpmdDriver`` its retained data). ``inc_only`` restricts the trigger
+    to INCARNATION changes: the driver bursts everything up front and
+    never ships into a vacancy, so a vacant->same-life re-admission has
+    nothing of its to replay (and a gratuitous re-ship would perturb the
+    chaos log's faulted burst channels); stage members DO hold hand-offs
+    across a vacancy, so they also fire on rank transitions."""
+    if old is None:
+        return []  # first sight: nothing retained yet, nothing to replay
+    out = []
+    for e in new.entries:
+        if e.rank < 0 or e.stage >= len(old.entries):
+            continue
+        oe = old.entries[e.stage]
+        if oe.inc == e.inc and (inc_only or oe.rank == e.rank):
+            continue
+        out.append(e)
+    return out
+
+
+class StageCoordinator(Coordinator):
+    """The coordinator of an MPMD pipeline fleet (see module docstring)."""
+
+    def __init__(self, transport, stage_ranges: Sequence[Tuple[int, int]],
+                 *, straggler_factor: float = 0.0,
+                 straggler_after_steps: int = 3, **kwargs):
+        ranges = [(int(lo), int(hi)) for lo, hi in stage_ranges]
+        if not ranges:
+            raise ValueError("stage_ranges must name at least one stage")
+        kwargs.setdefault("speculation", False)  # worker-plane speculation off
+        super().__init__(transport, ranges[-1][1], **kwargs)
+        self.stage_ranges = ranges
+        self.n_stages = len(ranges)
+        self.placement = StagePlacement(0, self.shard_map.n_params, [
+            StageEntry(stage=s, lo=lo, hi=hi)
+            for s, (lo, hi) in enumerate(ranges)])
+        self.stage_straggler_factor = float(straggler_factor)
+        self.stage_straggler_after = int(straggler_after_steps)
+        self.stage_speculated: Dict[int, int] = {}  # victim rank -> task id
+        self._vacant_since: Dict[int, float] = {}
+        self.stage_mttrs: List[float] = []
+        self.stage_restarts = 0
+
+    # ------------------------------------------------------------ placement
+    def _set_entry(self, entry: StageEntry, why: str) -> None:
+        entries = list(self.placement.entries)
+        entries[entry.stage] = entry
+        self.placement = StagePlacement(
+            self.placement.version + 1, self.placement.n_params, entries)
+        self._mirror_shard_map()
+        if self._snap is not None:
+            self._log(
+                f"snapshot {self._snap['id']} aborted: stage placement "
+                f"moved to v{self.placement.version} mid-barrier")
+            self._snap = None
+        self._log(
+            f"stage placement v{self.placement.version} on {why}: "
+            + ", ".join(
+                (f"s{e.stage}=r{e.rank}@{e.watermark}" if not e.vacant
+                 else f"s{e.stage}=VACANT")
+                for e in self.placement.entries))
+        self._announce()
+
+    def _mirror_shard_map(self) -> None:
+        """The placement IS the stage fleet's shard map: stage ranges keyed
+        by member rank, so the base snapshot barrier and FleetManifest
+        machinery cover stage checkpoints unchanged."""
+        self.shard_map = ShardMap(
+            self.placement.version, self.placement.n_params,
+            [ShardEntry(e.rank, e.lo, e.hi)
+             for e in self.placement.entries if not e.vacant])
+
+    def _announce(self) -> None:
+        super()._announce()
+        if self.placement.version > 0:
+            self._broadcast(MessageCode.StageAssign, self.placement.encode())
+
+    # --------------------------------------------------------------- handle
+    def handle(self, sender: int, code: MessageCode, payload) -> None:
+        if code == MessageCode.StageReady and payload.size >= 5:
+            if not np.isfinite(payload[:5]).all():
+                return
+            member = self.members.get(sender)
+            if member is None or member.kind != KIND_STAGE:
+                return  # pre-join chatter: the member's retry self-heals
+            member.last_seen = self._clock()
+            self._on_stage_ready(
+                sender, member,
+                stage=int(payload[0]),
+                inc=_join16(payload[1], payload[2]),
+                watermark=_join16(payload[3], payload[4]))
+            return
+        super().handle(sender, code, payload)
+        if (code == MessageCode.CoordJoin and sender in self.members
+                and self.placement.version > 0):
+            # joiners (and idempotent re-joins) get the current placement
+            # directly — the broadcast in _announce only covers fleet-wide
+            # membership events
+            self._send(sender, MessageCode.StageAssign,
+                       self.placement.encode())
+
+    def _on_stage_ready(self, sender: int, member, *, stage: int, inc: int,
+                        watermark: int) -> None:
+        if not (0 <= stage < self.n_stages):
+            self._log(f"ignored StageReady for unknown stage {stage} "
+                      f"from rank {sender}")
+            return
+        if inc < member.incarnation:
+            self._log(f"ignored stale StageReady from rank {sender} "
+                      f"(inc {inc} < {member.incarnation})")
+            return
+        cur = self.placement.entries[stage]
+        if cur.rank == sender and cur.inc == member.incarnation:
+            # idempotent re-announce from the SAME life: answer the sender
+            # alone, no bump — and the entry's watermark stays the life's
+            # FIRST announcement (its recovery point: the replay boundary
+            # neighbors honor and the accounting cutoff), not the member's
+            # advancing progress
+            self._send(sender, MessageCode.StageAssign,
+                       self.placement.encode())
+            return
+        lo, hi = self.stage_ranges[stage]
+        takeover = not cur.vacant and cur.rank != sender
+        same_life = cur.vacant and cur.inc == member.incarnation
+        vacated_at = self._vacant_since.pop(stage, None)
+        if same_life:
+            # transient lease expiry of a life that never died: nothing was
+            # lost, neighbors need no replay — re-admit at the entry's
+            # ORIGINAL recovery point (not the member's advancing progress)
+            # and count no restart
+            entry = StageEntry(stage=stage, rank=sender,
+                               inc=member.incarnation, lo=lo, hi=hi,
+                               watermark=cur.watermark)
+            self._set_entry(
+                entry, f"re-admission of rank {sender} after transient "
+                       "lease expiry (same life)")
+            return
+        entry = StageEntry(stage=stage, rank=sender,
+                           inc=member.incarnation, lo=lo, hi=hi,
+                           watermark=watermark)
+        why = (f"StageReady from rank {sender} (watermark {watermark})"
+               + (" — TAKEOVER" if takeover else ""))
+        if vacated_at is not None:
+            mttr = self._clock() - vacated_at
+            self.stage_mttrs.append(mttr)
+            self.stage_restarts += 1
+            self._log(
+                f"stage {stage} restored by rank {sender} after "
+                f"{mttr * 1e3:.0f} ms vacancy (watermark {watermark}: "
+                f"neighbors replay in-flight microbatches past it)")
+        self._set_entry(entry, why)
+
+    # ----------------------------------------------------------------- tick
+    def tick(self) -> bool:
+        changed = super().tick()
+        self._sync_placement()
+        if self.stage_straggler_factor > 0:
+            self.check_stage_stragglers()
+        return changed
+
+    def _sync_placement(self) -> None:
+        """Vacate placement entries whose member is gone (lease expiry or
+        leave) — the stage-death detection path."""
+        live = {m.rank for m in self._live(KIND_STAGE)}
+        now = self._clock()
+        for e in self.placement.entries:
+            if e.vacant or e.rank in live:
+                continue
+            self._vacant_since.setdefault(e.stage, now)
+            self.stage_speculated.pop(e.rank, None)
+            # inc + watermark survive the vacancy: a SAME-life re-admission
+            # (transient lease expiry, nothing lost) is told apart from a
+            # replacement by comparing incarnations at the next StageReady
+            self._set_entry(
+                StageEntry(stage=e.stage, inc=e.inc, lo=e.lo, hi=e.hi,
+                           watermark=e.watermark),
+                f"death of stage {e.stage} member rank {e.rank}")
+
+    # ------------------------------------------------------ snapshot barrier
+    def _start_snapshot(self, now: float) -> None:
+        """Stage fleets snapshot like shard fleets, but only a FULLY
+        assigned placement can produce a manifest that tiles — a vacancy
+        means the barrier cannot complete consistently."""
+        if self._snap is not None:
+            self._log(
+                f"snapshot request ignored: snapshot {self._snap['id']} "
+                "still in flight")
+            return
+        if not self.placement.assigned:
+            self._log("snapshot request ignored: stage placement has "
+                      "vacancies")
+            return
+        stages = self._live(KIND_STAGE)
+        assigned = {e.rank for e in self.placement.entries}
+        members = [m for m in stages if m.rank in assigned]
+        if len(members) < self.n_stages:
+            self._log("snapshot request ignored: assigned stage members "
+                      "not all live")
+            return
+        self._snap_seq += 1
+        self._snap = {
+            "id": self._snap_seq,
+            "map_version": self.shard_map.version,
+            "expected": {m.rank for m in members},
+            "got": {},
+            "started": now,
+        }
+        self._log(
+            f"snapshot {self._snap_seq} started: placement "
+            f"v{self.shard_map.version}, awaiting "
+            f"{sorted(self._snap['expected'])}")
+        from distributed_ml_pytorch_tpu.coord.coordinator import (
+            encode_snapshot_request,
+        )
+
+        frame = encode_snapshot_request(self._snap_seq,
+                                        self.shard_map.version)
+        for m in members:
+            self._send(m.rank, MessageCode.SnapshotRequest, frame)
+
+    # distcheck: ignore[DC205] membership decisions are single-threaded by
+    # design (handle/tick run on the serve thread only — the base
+    # Coordinator contract, which carries the same suppression); engine_up
+    # is an advisory GIL-atomic snapshot. Overridden HERE so the finding
+    # the analyzer anchors on this subclass has a local line to suppress.
+    def engine_up(self) -> bool:
+        return super().engine_up()
+
+    # ---------------------------------------------------------- speculation
+    def check_stage_stragglers(self) -> Optional[int]:
+        """Sandblaster speculation for stages: when an assigned stage
+        member's step-latency EWMA exceeds ``straggler_factor`` x the
+        fleet median, point an idle standby member at it (SpeculateTask);
+        the standby loads the victim's checkpoint and races it for the
+        stage — the placement flip is the first-wins dedup."""
+        assigned = {e.rank: e for e in self.placement.entries if not e.vacant}
+        members = [m for m in self._live(KIND_STAGE)
+                   if m.rank in assigned and m.ewma_ms > 0
+                   and m.step >= self.stage_straggler_after
+                   and m.rank not in self.stage_speculated]
+        if len(members) < 2:
+            return None
+        standbys = [m for m in self._live(KIND_STAGE)
+                    if m.rank not in assigned]
+        if not standbys:
+            return None
+        by_speed = sorted(members, key=lambda m: m.ewma_ms)
+        victim = by_speed[-1]
+        median = by_speed[(len(by_speed) - 1) // 2].ewma_ms
+        if median <= 0 or victim.ewma_ms < self.stage_straggler_factor * median:
+            return None
+        backup = standbys[0]
+        task_id = self._next_task
+        self._next_task += 1
+        self.stage_speculated[victim.rank] = task_id
+        e = assigned[victim.rank]
+        self._log(
+            f"stage straggler: stage {e.stage} member rank {victim.rank} "
+            f"at {victim.ewma_ms:.1f} ms/step (median {median:.1f}) — "
+            f"standby rank {backup.rank} races it as task {task_id}")
+        frame = np.asarray(
+            [float(task_id), float(victim.rank), float(victim.step)],
+            np.float32)
+        self._send(backup.rank, MessageCode.SpeculateTask, frame)
+        self._send(victim.rank, MessageCode.SpeculateTask, frame)
+        return task_id
+
+
+# ---------------------------------------------------------------- scenario
+
+def _wait_for(predicate, timeout: float, what: str, poll: float = 0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = predicate()
+        if got:
+            return got
+        time.sleep(poll)
+    raise TimeoutError(f"mpmd: timed out after {timeout:.0f}s waiting for "
+                       f"{what}")
+
+
+def default_mpmd_plan(seed: int = 0, *, weather: bool = True):
+    """Seeded drop/dup + network weather on the DRIVER'S burst channels
+    (tokens -> stage 0, targets -> last stage, reliability envelope code).
+
+    Determinism contract: the driver ships every step's data up front, so
+    these channels' send sequences are pure functions of the dataset; with
+    the scenario's RTO floor far above the in-process RTT, retransmissions
+    are loss-driven (seeded) rather than timing-driven, and the chaos log
+    renders byte-identically across repeats. Channels touching the killed
+    stage are deliberately un-faulted — their retry counts during the
+    outage are wall-clock-dependent.
+    """
+    from distributed_ml_pytorch_tpu.utils.chaos import (
+        ChaosPlan,
+        FaultRule,
+        WeatherRule,
+    )
+
+    rules = [FaultRule(src=0, code=int(MessageCode.ReliableFrame),
+                       drop=0.05, dup=0.05)]
+    weather_rules = ()
+    if weather:
+        weather_rules = (WeatherRule(
+            src=0, code=int(MessageCode.ReliableFrame),
+            latency=0.005, jitter=0.002),)
+    return ChaosPlan(rules, seed=seed, weather=weather_rules)
+
+
+def mpmd_scenario(
+    *,
+    base_dir: str,
+    seed: int = 0,
+    steps: int = 8,
+    n_stages: int = 4,
+    n_microbatches: int = 4,
+    mb: int = 4,
+    seq: int = 8,
+    lr: float = 0.1,
+    lease: float = 0.5,
+    kill_stage: Optional[int] = None,
+    kill_at_step: Optional[int] = None,
+    snapshot_at_step: Optional[int] = None,
+    restore_via_manifest: bool = False,
+    plan=None,
+    throttle_stage: Optional[int] = None,
+    throttle: float = 0.0,
+    standby: bool = False,
+    straggler_factor: float = 0.0,
+    cfg=None,
+    timeout: float = 240.0,
+) -> Dict:
+    """Run one MPMD pipeline fleet script (see module docstring).
+
+    Rank layout: stage ``i`` is rank ``1 + i`` in BOTH the coordination
+    star and the data-plane world; an optional standby member is rank
+    ``n_stages + 1`` in both (placement-routed members MUST share one
+    rank across worlds); the driver is data rank 0 (the hub the chaos
+    plan's ``src=0`` rules match) and takes the next free coord rank
+    (``n_stages + 1``, or ``n_stages + 2`` with a standby). ``kill_stage``/``kill_at_step`` crash that stage
+    member SILENTLY from its own step hook the moment it finishes the
+    named update (its checkpoint watermark is then exactly
+    ``kill_at_step * M`` — the deterministic replay boundary); the main
+    thread restarts it (from its per-stage checkpoint, via the
+    FleetManifest when ``restore_via_manifest``) once the coordinator has
+    detected the death and vacated the stage.
+    """
+    import os
+
+    from distributed_ml_pytorch_tpu.coord.manifest import (
+        MANIFEST_NAME,
+        FleetManifest,
+    )
+    from distributed_ml_pytorch_tpu.coord.member import CoordClient
+    from distributed_ml_pytorch_tpu.parallel.mpmd import (
+        MpmdDriver,
+        MpmdStage,
+        stage_param_ranges,
+    )
+    from distributed_ml_pytorch_tpu.parallel.pipeline import PipelineLMConfig
+    from distributed_ml_pytorch_tpu.parallel.seq_parallel import (
+        next_token_targets,
+    )
+    from distributed_ml_pytorch_tpu.utils.chaos import FaultyTransport
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        InProcessTransport,
+        ReliableTransport,
+    )
+
+    S, M = int(n_stages), int(n_microbatches)
+    if cfg is None:
+        cfg = PipelineLMConfig(
+            vocab_size=32, d_model=16, n_heads=2, n_layers=S, d_ff=32,
+            max_len=max(64, seq))
+    ranges = stage_param_ranges(cfg, S)
+    n_extra = 1 if standby else 0
+    # placement-routed members (stages, standby) MUST hold the SAME rank in
+    # the coordination star and the data world — the placement's rank is
+    # both identities. The driver is never in the placement, so its coord
+    # rank floats to whatever is free.
+    standby_rank = S + 1  # data AND coord rank
+    driver_coord_rank = S + 1 + n_extra
+
+    # --- data: every run of one seed feeds identical microbatches ---------
+    rng = np.random.default_rng(seed)
+    tokens_steps, targets_steps = [], []
+    for _t in range(steps):
+        toks = rng.integers(0, cfg.vocab_size, size=(M * mb, seq)).astype(
+            np.int32)
+        tgts = next_token_targets(toks)
+        tokens_steps.append(toks.reshape(M, mb, seq))
+        targets_steps.append(np.asarray(tgts).reshape(M, mb, seq))
+
+    # --- worlds: plain coordination star + chaos-wrapped data plane -------
+    coord_world = InProcessTransport.create_world(2 + S + n_extra)
+    data_world = InProcessTransport.create_world(1 + S + n_extra)
+    log = None
+    if plan is not None:
+        from distributed_ml_pytorch_tpu.utils.chaos import ChaosLog
+
+        log = ChaosLog()
+        data_world, _ = FaultyTransport.wrap_world(data_world, plan, log=log)
+
+    #: RTO floor far above the in-process RTT + weather — AND above a jit
+    #: compile stall, which starves a stage's serve loop for seconds on a
+    #: cold program cache — so retransmits are loss-driven, hence seeded
+    #: and deterministic (the byte-identical-log contract; the acceptance
+    #: test additionally warms the program cache with its corridor run
+    #: first). breaker_grace keeps a compile stall from reading as a dead
+    #: peer, the same knob the health world runs.
+    rel_opts = dict(ack_timeout=4.0, max_backoff=8.0, max_retries=120,
+                    send_window=32, breaker_grace=60.0)
+
+    def rel(rank: int) -> ReliableTransport:
+        return ReliableTransport(data_world[rank], **rel_opts)
+
+    coord = StageCoordinator(
+        coord_world[0], ranges, lease=lease,
+        manifest_dir=base_dir, straggler_factor=straggler_factor)
+    coord_thread = threading.Thread(
+        target=coord.run, kwargs={"timeout": timeout + 60}, daemon=True)
+    coord_thread.start()
+
+    crash_evt = threading.Event()
+    victim_holder: Dict[str, MpmdStage] = {}
+    retired: List[MpmdStage] = []
+    errors: List[tuple] = []
+    timings: Dict[str, float] = {}
+    manifest_path = os.path.join(base_dir, MANIFEST_NAME)
+
+    def make_stage(i: int, transport) -> MpmdStage:
+        client = CoordClient(coord_world[1 + i], "stage",
+                             renew_interval=lease / 4)
+
+        def hook(srv: MpmdStage, new_step: int) -> None:
+            if (kill_stage == i and kill_at_step is not None
+                    and new_step == kill_at_step and not crash_evt.is_set()):
+                timings["killed"] = time.monotonic()
+                srv.crash()
+                if hasattr(data_world[1 + i], "crash"):
+                    data_world[1 + i].crash()
+                crash_evt.set()
+
+        return MpmdStage(
+            i, cfg, S, M, transport, client,
+            mb_size=mb, seq_len=seq, lr=lr, seed=seed,
+            ckpt_dir=os.path.join(base_dir, f"stage{i}"),
+            throttle=(throttle if throttle_stage == i else 0.0),
+            step_hook=hook)
+
+    stages: List[MpmdStage] = []
+    stage_threads: List[threading.Thread] = []
+    for i in range(S):
+        srv = make_stage(i, rel(1 + i))
+        stages.append(srv)
+        t = threading.Thread(target=srv.run, kwargs={"timeout": timeout + 60},
+                             daemon=True)
+        t.start()
+        stage_threads.append(t)
+
+    standby_member = None
+    if standby:
+        client = CoordClient(coord_world[standby_rank], "stage",
+                             renew_interval=lease / 4)
+        standby_member = MpmdStage(
+            None, cfg, S, M, rel(standby_rank), client,
+            mb_size=mb, seq_len=seq, lr=lr, seed=seed, ckpt_root=base_dir)
+        t = threading.Thread(target=standby_member.run,
+                             kwargs={"timeout": timeout + 60}, daemon=True)
+        t.start()
+        stage_threads.append(t)
+
+    # --- restart watcher: once the coordinator vacates the killed stage,
+    # stand the replacement up from its checkpoint --------------------------
+    def restart_victim() -> None:
+        try:
+            crash_evt.wait(timeout)
+            if kill_stage is None or not crash_evt.is_set():
+                return
+            _wait_for(
+                lambda: coord.placement.entries[kill_stage].vacant,
+                timeout, f"the coordinator to vacate stage {kill_stage}")
+            timings["vacated"] = time.monotonic()
+            old = stages[kill_stage]
+            retired.append(old)
+            detach = getattr(old.transport, "detach", None)
+            if detach is not None:
+                detach()
+            if hasattr(data_world[1 + kill_stage], "restart"):
+                data_world[1 + kill_stage].restart()
+            srv = make_stage(kill_stage, rel(1 + kill_stage))
+            manifest = None
+            if restore_via_manifest:
+                manifest = FleetManifest.load(manifest_path)
+            srv.restore(manifest=manifest)
+            stages[kill_stage] = srv
+            victim_holder["new"] = srv
+            timings["restored"] = time.monotonic()
+            t = threading.Thread(target=srv.run,
+                                 kwargs={"timeout": timeout + 60},
+                                 daemon=True)
+            t.start()
+            stage_threads.append(t)
+        except Exception as e:  # noqa: BLE001 — surfaced to the caller
+            errors.append(("restart", repr(e)))
+            crash_evt.set()
+
+    restarter = None
+    if kill_stage is not None:
+        restarter = threading.Thread(target=restart_victim, daemon=True)
+        restarter.start()
+
+    # --- driver -----------------------------------------------------------
+    driver_client = CoordClient(coord_world[driver_coord_rank], "worker",
+                                renew_interval=lease / 4)
+    driver = MpmdDriver(rel(0), driver_client, S, M)
+
+    def driver_hook(t: int, _loss: float) -> None:
+        if snapshot_at_step is not None and t == snapshot_at_step:
+            coord.trigger_snapshot()
+            _wait_for(
+                lambda: coord.manifests_written > 0
+                and os.path.exists(manifest_path),
+                60, "the stage snapshot barrier to publish a manifest")
+
+    losses: List[float] = []
+    try:
+        losses = driver.run(tokens_steps, targets_steps, timeout=timeout,
+                            step_hook=driver_hook)
+        # the driver has every step's LOSS once the last stage finishes,
+        # but earlier stages' backward chains for the final step are still
+        # draining — wait for every active member to apply its last update
+        # so the accounting below judges a completed schedule
+
+        def drained() -> bool:
+            active = [s for s in stages if not s._superseded]
+            if standby_member is not None and standby_member.stage is not None:
+                active.append(standby_member)
+            return all(s.step >= steps for s in active)
+
+        _wait_for(drained, 60, "all stages to drain their final backwards")
+    except TimeoutError as e:
+        errors.append(("driver", repr(e)))
+    finally:
+        driver_client.close()
+
+    for srv in stages:
+        srv.stop()
+    if standby_member is not None:
+        standby_member.stop()
+    coord.stop()
+    coord_thread.join(timeout=30)
+    if restarter is not None:
+        crash_evt.set()
+        restarter.join(timeout=10)
+    for t in stage_threads:
+        t.join(timeout=10)
+
+    # serve-loop crashes are first-class failures (MpmdStage.run records
+    # them instead of dying silently)
+    for srv in stages + retired \
+            + ([standby_member] if standby_member is not None else []):
+        if srv.error is not None:
+            errors.append((f"stage{srv.stage}", srv.error))
+
+    # --- accounting: every (step, mb) applied exactly once per stage, in
+    # the OWNER LINEAGE — prior lives count below the final owner's
+    # announced watermark, the owner above it. A speculation loser's
+    # racing applications past the takeover watermark are DISCARDED work
+    # (Sandblaster's first-wins contract: its ships were suppressed, its
+    # params abandoned), counted separately, never double-counted. -------
+    import collections
+
+    applied_ok = True
+    discarded_applies = 0
+    applied: Dict[int, Dict[Tuple[int, int], int]] = {}
+    all_members = list(stages) + retired \
+        + ([standby_member] if standby_member is not None else [])
+    for i in range(S):
+        entry = coord.placement.entries[i]
+        cutoff = entry.watermark
+        if (standby_member is not None and standby_member.stage == i
+                and not standby_member._superseded):
+            owner = standby_member
+        elif not stages[i]._superseded:
+            owner = stages[i]
+        else:
+            owner = None
+        counts: collections.Counter = collections.Counter()
+        for srv in all_members:
+            if srv.stage != i:
+                continue
+            for key in srv.applied_log:
+                g = key[0] * M + key[1]
+                if (g >= cutoff) == (srv is owner):
+                    counts[key] += 1
+                else:
+                    discarded_applies += 1
+        applied[i] = dict(counts)
+        expected = {(t, mbi) for t in range(steps) for mbi in range(M)}
+        if set(counts) != expected or any(v != 1 for v in counts.values()):
+            applied_ok = False
+
+    stats = {f"stage{i}": dict(stages[i].stats) for i in range(S)}
+    for k, srv in enumerate(retired):
+        stats[f"retired{k}"] = dict(srv.stats)
+    if standby_member is not None:
+        stats["standby"] = dict(standby_member.stats)
+    busy_s = sum(s.get("busy_s", 0.0) for s in stats.values())
+    wall_s = (driver.step_times[-1] - driver.step_times[0]
+              if len(driver.step_times) >= 2 else None)
+
+    # close the RELIABLE wrappers too (they own retry threads — a zombie
+    # wrapper from a finished run keeps retrying into a closed world and
+    # eventually logs spurious breaker opens), then the worlds beneath
+    wrappers = [driver.transport] + [srv.transport for srv in stages]
+    wrappers += [srv.transport for srv in retired]
+    if standby_member is not None:
+        wrappers.append(standby_member.transport)
+    for t in wrappers:
+        close = getattr(t, "close", None)
+        if close is not None:
+            close()
+    for t in data_world.values():
+        close = getattr(t, "close", None)
+        if close is not None:
+            close()
+    for t in coord_world.values():
+        t.close()
+
+    mttr = coord.stage_mttrs[0] if coord.stage_mttrs else None
+    return {
+        "ok": not errors and len(losses) == steps and applied_ok,
+        "errors": errors,
+        "losses": losses,
+        "step_times": list(driver.step_times),
+        "applied_ok": applied_ok,
+        "applied": applied,
+        "discarded_applies": discarded_applies,
+        "stats": stats,
+        "driver_stats": dict(driver.stats),
+        "events": list(coord.events),
+        "placement_version": coord.placement.version,
+        "placement": coord.placement,
+        "stage_mttr_s": mttr,
+        "stage_restarts": coord.stage_restarts,
+        #: wall-clock decomposition of the outage: killed -> vacated
+        #: (lease expiry detection) -> restored (replacement serving)
+        "timings": dict(timings),
+        "chaos_lines": log.lines() if log is not None else "",
+        "chaos_counts": log.counts() if log is not None else {},
+        "busy_s": busy_s,
+        "wall_s": wall_s,
+        "stages": stages,
+        "retired": retired,
+        "standby": standby_member,
+        "coordinator": coord,
+    }
+
+
+def mpmd_demo(seed: int = 0, base_dir: Optional[str] = None) -> Dict:
+    """One self-contained pass of the MPMD acceptance script
+    (``coord/cli.py --mpmd``): 4 stages under drop/dup + weather, the
+    middle stage killed mid-schedule and restarted from its checkpoint."""
+    import tempfile
+
+    base = base_dir or tempfile.mkdtemp(prefix="mpmd_")
+    out = mpmd_scenario(
+        base_dir=base, seed=seed, steps=8, kill_stage=1, kill_at_step=3,
+        snapshot_at_step=1, plan=default_mpmd_plan(seed))
+    return {
+        "ok": out["ok"] and out["stage_restarts"] >= 1,
+        "losses": [round(float(x), 4) for x in out["losses"]],
+        "stage_mttr_ms": (None if out["stage_mttr_s"] is None
+                          else round(out["stage_mttr_s"] * 1e3, 1)),
+        "applied_ok": out["applied_ok"],
+        "chaos": out["chaos_counts"],
+        "events": out["events"],
+        "state_dir": base,
+    }

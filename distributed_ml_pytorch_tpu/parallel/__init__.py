@@ -52,6 +52,12 @@ from distributed_ml_pytorch_tpu.parallel.composite import (
     make_composite_train_step,
     shard_composite_batch,
 )
+from distributed_ml_pytorch_tpu.parallel.mpmd import (
+    MpmdDriver,
+    MpmdLocal,
+    MpmdStage,
+    stage_param_ranges,
+)
 
 __all__ = [
     "composite_specs",
@@ -91,4 +97,8 @@ __all__ = [
     "ParameterServer",
     "make_local_sgd_round",
     "train_local_sgd",
+    "MpmdDriver",
+    "MpmdLocal",
+    "MpmdStage",
+    "stage_param_ranges",
 ]

@@ -25,6 +25,12 @@ from __future__ import annotations
 import os
 
 
+#: process umask, read once at import (single-threaded) — the momentary
+#: os.umask(0) is unsafe to repeat on worker threads writing concurrently
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
+
 def fsync_dir(path: str) -> None:
     """fsync a DIRECTORY so a rename inside it survives power loss. A
     platform that cannot open directories (Windows) degrades to a no-op —
@@ -48,12 +54,34 @@ def atomic_write(path: str, data: bytes) -> None:
     cross filesystems) and is fsync'd before the swap; the directory is
     fsync'd after, so neither the contents nor the rename can be lost to a
     power cut. Readers never observe a torn file at ``path``.
+
+    The temp name is UNIQUE per call (``mkstemp``), so concurrent writers
+    of one path degrade to last-writer-wins instead of corrupting or
+    crashing each other — a fixed ``path + ".tmp"`` let writer B truncate
+    writer A's in-flight temp and made A's ``os.replace`` publish B's
+    partial bytes (or raise FileNotFoundError); the MPMD speculation
+    window (a not-yet-superseded victim and its standby briefly sharing a
+    stage checkpoint) hits exactly this. A failed write unlinks its temp.
     """
+    import tempfile
+
     dirname = os.path.dirname(os.path.abspath(path))
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".", suffix=".tmp", dir=dirname)
+    try:
+        # mkstemp creates 0600; restore the umask-honoring mode a plain
+        # open() would have produced, or every published artifact
+        # (manifests, WALs, checkpoints) silently tightens to owner-only
+        os.fchmod(fd, 0o666 & ~_UMASK)
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     fsync_dir(dirname)

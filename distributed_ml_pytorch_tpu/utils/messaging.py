@@ -124,6 +124,11 @@ class MessageCode(enum.IntEnum):
     UpdateNack = 27
     RollbackRequest = 28
     RollbackDone = 29
+    # --- MPMD pipeline plane (ISSUE 10): stages as fleet members ---
+    ActivationShip = 30
+    ActivationGrad = 31
+    StageReady = 32
+    StageAssign = 33
 
 
 @dataclasses.dataclass(frozen=True)
@@ -307,6 +312,41 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
         doc="shard -> coordinator: range [lo,hi) restored to the manifest "
             "snapshot at apply_seq under this map version; all-reported "
             "completes the rollback barrier (MTTR measured)"),
+    MessageCode.ActivationShip: PayloadSchema(
+        fields=("step_lo", "step_hi", "mb", "kind", "ver_lo", "ver_hi"),
+        rest="payload", rest_min=1, handled_by=("ps",),
+        doc="MPMD pipeline data plane (ISSUE 10): stage s -> s+1 activation "
+            "hand-off for (step, microbatch), stamped with the sender's "
+            "StagePlacement version. kind 0 = activation, 1 = tokens "
+            "(driver -> first stage), 2 = targets (driver -> last stage), "
+            "3 = per-microbatch ce_sum report (last stage -> driver). "
+            "Receivers dedup by (step, mb) so chaos dups, reliability "
+            "redelivery and watermark replay can never double-apply a "
+            "microbatch"),
+    MessageCode.ActivationGrad: PayloadSchema(
+        fields=("step_lo", "step_hi", "mb", "ver_lo", "ver_hi"),
+        rest="payload", rest_min=1, handled_by=("ps",),
+        doc="MPMD backward hand-off: stage s+1 -> s activation cotangent "
+            "for (step, microbatch); same (step, mb) dedup discipline as "
+            "ActivationShip (no microbatch's gradient applied twice)"),
+    MessageCode.StageReady: PayloadSchema(
+        fields=("stage", "inc_lo", "inc_hi", "wm_lo", "wm_hi"),
+        handled_by=("coord",),
+        doc="stage member -> coordinator: I serve pipeline stage `stage` "
+            "at microbatch watermark wm (= step * n_microbatches, the "
+            "global count my checkpoint has applied). A restarted member "
+            "announces its recovery point here; the coordinator assigns "
+            "it into the StagePlacement and broadcasts StageAssign"),
+    MessageCode.StageAssign: PayloadSchema(
+        fields=("ver_lo", "ver_hi", "n_stages", "n_params_lo",
+                "n_params_hi"),
+        rest="entries", handled_by=("coord",),
+        doc="coordinator -> everyone: the versioned StagePlacement "
+            "(coord/stages.py; 10 floats per entry: stage, rank, inc "
+            "halves, lo/hi halves, watermark halves). Neighbors react to "
+            "an entry whose member INCARNATION changed by re-shipping "
+            "retained (step, mb) traffic at or past that entry's "
+            "watermark — the bounded-replay restart contract"),
 }
 
 

@@ -114,6 +114,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "mpmd: MPMD pipeline-plane tests (parallel/mpmd.py + "
+        "coord/stages.py — per-stage compiled programs, StagePlacement, "
+        "stage death/restart with watermark replay, stage speculation — "
+        "ISSUE 10); `make mpmd` selects exactly these — fast units run in "
+        "tier-1, the fleet scenarios are additionally measured into "
+        "slow_tests.txt; the manifest drill variant also carries the "
+        "drill marker",
+    )
+    config.addinivalue_line(
+        "markers",
         "netweather: adaptive-wire tests under network weather "
         "(utils/chaos.WeatherRule + the RTO/window/breaker machinery in "
         "utils/messaging.ReliableTransport); `make netweather` selects "

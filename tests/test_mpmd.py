@@ -1,0 +1,439 @@
+"""MPMD pipeline plane (ISSUE 10): stages as independently compiled fleet
+members that die, restart from per-stage checkpoints, and catch up by
+watermark-bounded microbatch replay.
+
+THE acceptance scenario: a 4-stage MPMD pipeline under seeded drop/dup +
+network weather, the middle stage killed mid-schedule -> lease-expiry
+detection, restart from its stage checkpoint, replay of only the in-flight
+microbatches past the watermark (no microbatch applied twice), a loss
+trajectory EQUAL to the fault-free corridor, byte-identical chaos logs
+across 3 runs, and a measured stage-restart MTTR.
+"""
+
+import os
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.coord.stages import (
+    StageEntry,
+    StagePlacement,
+    default_mpmd_plan,
+    mpmd_scenario,
+)
+from distributed_ml_pytorch_tpu.parallel.mpmd import (
+    MpmdLocal,
+    MpmdStage,
+    load_stage_checkpoint,
+    save_stage_checkpoint,
+    stage_param_ranges,
+)
+from distributed_ml_pytorch_tpu.parallel.pipeline import PipelineLMConfig
+from distributed_ml_pytorch_tpu.parallel.seq_parallel import next_token_targets
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    InProcessTransport,
+    MessageCode,
+    _split16,
+)
+
+pytestmark = pytest.mark.mpmd
+
+
+def cfg4():
+    return PipelineLMConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+        max_len=128)
+
+
+def small_cfg(n_stages=4, seq=8):
+    """The scenario's config (matches mpmd_scenario's default so the
+    process-wide program cache is shared across the fleet tests)."""
+    return PipelineLMConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=n_stages, d_ff=32,
+        max_len=max(64, seq))
+
+
+def make_batch(batch=8, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, 64, size=(batch, seq)).astype(np.int32)
+    return tokens, np.asarray(next_token_targets(tokens))
+
+
+# --------------------------------------------------------------- exactness
+
+def test_mpmd_local_matches_single_stage_reference():
+    """The burn-down proof behind tests/test_pipeline.py: each stage
+    compiled STANDALONE (plain jit + vjp, no shard_map) makes the 4-stage
+    pipeline's loss and updated params equal the single-stage reference on
+    every runtime — the legacy shard_map transpose semantics never enter
+    the program."""
+    cfg = cfg4()
+    tokens, targets = make_batch()
+
+    ref = MpmdLocal(cfg, 1, 1, 0.1, jax.random.key(0))
+    ref_losses = [ref.step(tokens[None], targets[None]) for _ in range(2)]
+
+    pp = MpmdLocal(cfg, 4, 4, 0.1, jax.random.key(0))
+    tok_mb, tgt_mb = tokens.reshape(4, 2, 16), targets.reshape(4, 2, 16)
+    pp_losses = [pp.step(tok_mb, tgt_mb) for _ in range(2)]
+
+    np.testing.assert_allclose(pp_losses, ref_losses, rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(ref.full_params()),
+                    jax.tree.leaves(pp.full_params())):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-5, atol=1e-6)
+
+
+def test_mpmd_schedules_identical():
+    """gpipe vs 1f1b execution order: same per-microbatch values, same
+    mb-ordered accumulation — value-identical updates by construction."""
+    cfg = cfg4()
+    tokens, targets = make_batch()
+    tok_mb, tgt_mb = tokens.reshape(4, 2, 16), targets.reshape(4, 2, 16)
+    g = MpmdLocal(cfg, 4, 4, 0.1, jax.random.key(0))
+    f = MpmdLocal(cfg, 4, 4, 0.1, jax.random.key(0), schedule="1f1b")
+    lg = [g.step(tok_mb, tgt_mb) for _ in range(2)]
+    lf = [f.step(tok_mb, tgt_mb) for _ in range(2)]
+    np.testing.assert_allclose(lf, lg, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g.full_params()),
+                    jax.tree.leaves(f.full_params())):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-8)
+
+
+def test_mpmd_local_loss_matches_shard_map_step():
+    """Cross-validation against the in-process shard_map schedule: losses
+    are exact on every runtime (the dryrun asserts the same), so the two
+    pipeline planes must agree on the forward."""
+    import optax
+    from jax.sharding import Mesh
+
+    from distributed_ml_pytorch_tpu.parallel.pipeline import (
+        create_pp_train_state,
+        make_pp_train_step,
+    )
+
+    cfg = cfg4()
+    tokens, targets = make_batch()
+    tok_mb, tgt_mb = tokens.reshape(4, 2, 16), targets.reshape(4, 2, 16)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("stage",))
+    tx = optax.sgd(0.1)
+    st = create_pp_train_state(cfg, jax.random.key(0), tx, mesh)
+    _, loss_sm = make_pp_train_step(cfg, tx, mesh, n_microbatches=4)(
+        st, tok_mb, tgt_mb)
+    local = MpmdLocal(cfg, 4, 4, 0.1, jax.random.key(0))
+    np.testing.assert_allclose(local.step(tok_mb, tgt_mb), float(loss_sm),
+                               rtol=1e-5)
+
+
+def test_stage_param_ranges_tile():
+    from jax.flatten_util import ravel_pytree
+
+    from distributed_ml_pytorch_tpu.parallel.mpmd import (
+        init_stage_params,
+    )
+
+    cfg = small_cfg()
+    ranges = stage_param_ranges(cfg, 4)
+    assert ranges[0][0] == 0
+    for (lo, hi), (lo2, _hi2) in zip(ranges, ranges[1:]):
+        assert hi == lo2 and hi > lo
+    for s, (lo, hi) in enumerate(ranges):
+        flat, _ = ravel_pytree(
+            init_stage_params(cfg, jax.random.key(0), s, 4))
+        assert flat.size == hi - lo
+
+
+# ------------------------------------------------------------- durability
+
+def test_stage_checkpoint_roundtrip_and_refusals(tmp_path):
+    d = str(tmp_path / "ck")
+    p = np.arange(10, dtype=np.float32)
+    o = np.arange(4, dtype=np.float32)
+    save_stage_checkpoint(d, stage=2, step=7, watermark=28, lo=5, hi=15,
+                          params_flat=p, opt_flat=o)
+    meta, p2, o2 = load_stage_checkpoint(d)
+    assert (meta["stage"], meta["step"], meta["watermark"]) == (2, 7, 28)
+    np.testing.assert_array_equal(p, p2)
+    np.testing.assert_array_equal(o, o2)
+    # CRC damage (a flipped byte in the state blob) is refused loudly
+    path = os.path.join(d, "stage.ckpt")
+    raw = bytearray(open(path, "rb").read())
+    raw[-3] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(raw))
+    with pytest.raises(ValueError, match="CRC"):
+        load_stage_checkpoint(d)
+    # missing checkpoint is refused loudly
+    with pytest.raises(ValueError, match="unreadable"):
+        load_stage_checkpoint(str(tmp_path / "nope"))
+
+
+class _StubCoord:
+    """Just enough CoordClient surface for a transport-level MpmdStage
+    unit (no coordinator, no threads)."""
+
+    def __init__(self):
+        self.on_stage_assign = None
+        self.on_snapshot = None
+        self._on_speculate = None
+        self.incarnation = 1
+
+    def report(self, *a, **k):
+        pass
+
+    def stage_ready(self, *a, **k):
+        pass
+
+    def snapshot_done(self, *a, **k):
+        pass
+
+    def stop(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def _unit_stage(tmp_path, stage=1, n_stages=4, M=2, mb=2, seq=8):
+    cfg = small_cfg(n_stages, seq)
+    world = InProcessTransport.create_world(1 + n_stages)
+    srv = MpmdStage(stage, cfg, n_stages, M, world[1 + stage], _StubCoord(),
+                    mb_size=mb, seq_len=seq, lr=0.1,
+                    ckpt_dir=str(tmp_path / f"stage{stage}"))
+    placement = StagePlacement(1, stage_param_ranges(cfg, n_stages)[-1][1], [
+        StageEntry(stage=s, rank=1 + s, inc=100 + s, lo=lo, hi=hi)
+        for s, (lo, hi) in enumerate(stage_param_ranges(cfg, n_stages))])
+    srv._note_placement(placement)
+    srv._drain_mailboxes()
+    return cfg, world, srv
+
+
+def _ship_frame(step, mbi, kind, body):
+    return np.concatenate([
+        np.asarray([*_split16(step), float(mbi), float(kind), 0.0, 0.0],
+                   np.float32),
+        np.asarray(body, np.float32).ravel()])
+
+
+def _grad_frame(step, mbi, body):
+    return np.concatenate([
+        np.asarray([*_split16(step), float(mbi), 0.0, 0.0], np.float32),
+        np.asarray(body, np.float32).ravel()])
+
+
+def test_duplicate_grad_applied_once(tmp_path):
+    """The no-microbatch-applied-twice core: a duplicated ActivationGrad
+    (chaos dup, reliability redelivery, or replay re-ship) accumulates
+    into the stage's update exactly once."""
+    cfg, world, srv = _unit_stage(tmp_path)
+    act = np.zeros((2, 8, cfg.d_model), np.float32)
+    srv.handle(1, MessageCode.ActivationShip, _ship_frame(0, 0, 0, act))
+    srv._pump()
+    assert srv.stats["fwd"] == 1
+    g = np.ones((2, 8, cfg.d_model), np.float32)
+    srv.handle(3, MessageCode.ActivationGrad, _grad_frame(0, 0, g))
+    srv._pump()
+    assert srv.stats["bwd"] == 1
+    # the dup arrives after the backward was applied: dropped, not redone
+    srv.handle(3, MessageCode.ActivationGrad, _grad_frame(0, 0, g))
+    srv._pump()
+    assert srv.stats["bwd"] == 1
+    assert srv.stats["dup_grads_dropped"] == 1
+    # a duplicated INPUT is dropped the same way
+    srv.handle(1, MessageCode.ActivationShip, _ship_frame(0, 0, 0, act))
+    assert srv.stats["dup_inputs_dropped"] == 1
+    # traffic for an already-applied step is stale
+    srv.handle(1, MessageCode.ActivationShip, _ship_frame(0, 1, 0, act))
+    srv.handle(3, MessageCode.ActivationGrad, _grad_frame(0, 1, g))
+    srv._pump()
+    assert srv.step == 1 and srv.stats["updates"] == 1
+    assert sorted(srv.applied_log) == [(0, 0), (0, 1)]
+    srv.handle(1, MessageCode.ActivationShip, _ship_frame(0, 0, 0, act))
+    assert srv.stats["stale_dropped"] == 1
+
+
+def test_stage_restore_refuses_bad_state(tmp_path):
+    """The manifest restore contract for stages: range mismatch and a
+    checkpoint BEHIND the manifest's promised apply seq are refused."""
+    from distributed_ml_pytorch_tpu.coord.manifest import (
+        FleetManifest,
+        ManifestError,
+        ShardRecord,
+    )
+
+    cfg, world, srv = _unit_stage(tmp_path)
+    act = np.zeros((2, 8, cfg.d_model), np.float32)
+    g = np.ones((2, 8, cfg.d_model), np.float32)
+    for mbi in range(2):
+        srv.handle(1, MessageCode.ActivationShip, _ship_frame(0, mbi, 0, act))
+        srv.handle(3, MessageCode.ActivationGrad, _grad_frame(0, mbi, g))
+    srv._pump()
+    assert srv.step == 1 and srv.watermark == 2  # checkpoint written
+
+    ranges = stage_param_ranges(cfg, 4)
+
+    def manifest(apply_seq, lo, hi):
+        shards = []
+        for s, (slo, shi) in enumerate(ranges):
+            rec_lo, rec_hi = (lo, hi) if s == 1 else (slo, shi)
+            shards.append(ShardRecord(
+                server_id=1 + s, lo=rec_lo, hi=rec_hi, map_version=4,
+                apply_seq=apply_seq if s == 1 else 0, push_count=1))
+        return FleetManifest(snapshot_id=1, map_version=4,
+                             n_params=ranges[-1][1], shards=tuple(shards))
+
+    fresh = MpmdStage(1, cfg, 4, 2, world[2], _StubCoord(),
+                      mb_size=2, seq_len=8, lr=0.1,
+                      ckpt_dir=str(tmp_path / "stage1"))
+    # a checkpoint BEHIND the promised apply seq is refused
+    with pytest.raises(ValueError, match="BEHIND"):
+        fresh.restore(manifest(apply_seq=99, lo=ranges[1][0],
+                               hi=ranges[1][1]))
+    # a range mismatch is refused
+    with pytest.raises(ManifestError, match="range"):
+        fresh.restore(manifest(apply_seq=0, lo=0, hi=1))
+    # the good path restores the promised watermark
+    fresh.restore(manifest(apply_seq=2, lo=ranges[1][0], hi=ranges[1][1]))
+    assert fresh.step == 1 and fresh.watermark == 2
+
+
+def test_stage_placement_codec_roundtrip():
+    p = StagePlacement(7, 999, [
+        StageEntry(stage=0, rank=1, inc=0x12345, lo=0, hi=400, watermark=8),
+        StageEntry(stage=1, rank=-1, inc=0, lo=400, hi=999, watermark=12),
+    ])
+    q = StagePlacement.decode(p.encode())
+    assert q.version == 7 and q.n_params == 999
+    assert q.entries == p.entries
+    assert q.entries[1].vacant and not q.assigned
+    with pytest.raises(ValueError, match="malformed"):
+        StagePlacement.decode(np.zeros(3, np.float32))
+    with pytest.raises(ValueError, match="entries"):
+        StagePlacement.decode(
+            np.asarray([*_split16(1), 5.0, *_split16(10)], np.float32))
+
+
+# ------------------------------------------------------------ fleet (slow)
+
+def _scenario_data(seed, steps, M, mb, seq, vocab):
+    rng = np.random.default_rng(seed)
+    toks, tgts = [], []
+    for _t in range(steps):
+        t = rng.integers(0, vocab, size=(M * mb, seq)).astype(np.int32)
+        toks.append(t.reshape(M, mb, seq))
+        tgts.append(np.asarray(next_token_targets(t)).reshape(M, mb, seq))
+    return toks, tgts
+
+
+def test_mpmd_fleet_matches_local_runner():
+    """The distributed fleet computes exactly what the loopback runner
+    computes: same per-step losses on identical data."""
+    steps = 4
+    out = mpmd_scenario(base_dir=tempfile.mkdtemp(prefix="mpmd_t_"),
+                        seed=3, steps=steps)
+    assert out["ok"], (out["errors"], out["events"])
+    cfg = small_cfg()
+    local = MpmdLocal(cfg, 4, 4, 0.1, jax.random.key(3))
+    toks, tgts = _scenario_data(3, steps, 4, 4, 8, cfg.vocab_size)
+    local_losses = [local.step(t, g) for t, g in zip(toks, tgts)]
+    np.testing.assert_allclose(out["losses"], local_losses, rtol=1e-5)
+
+
+def test_mpmd_acceptance_stage_death_under_chaos(lock_witness):
+    """THE ISSUE 10 acceptance: 4-stage MPMD pipeline under seeded
+    drop/dup + weather, middle stage killed mid-schedule -> lease-expiry
+    detection, restart from its stage checkpoint, watermark-bounded
+    replay with no microbatch applied twice, a loss trajectory EQUAL to
+    the fault-free corridor, 3x byte-identical chaos logs, and a
+    measured stage-restart MTTR."""
+    steps = 8
+    # corridor first: the fault-free trajectory AND the program-cache
+    # warmup (a cold jit compile stalls serve loops long enough to fire
+    # timing-driven retransmits, which would perturb the chaos log)
+    corridor = mpmd_scenario(
+        base_dir=tempfile.mkdtemp(prefix="mpmd_c_"), seed=0, steps=steps)
+    assert corridor["ok"], (corridor["errors"], corridor["events"])
+
+    logs = []
+    for _rep in range(3):
+        out = mpmd_scenario(
+            base_dir=tempfile.mkdtemp(prefix="mpmd_a_"), seed=0,
+            steps=steps, kill_stage=1, kill_at_step=3, snapshot_at_step=1,
+            plan=default_mpmd_plan(0))
+        assert out["ok"], (out["errors"], out["events"])
+        # the kill really happened and really was detected + restored
+        assert out["stage_restarts"] == 1
+        assert out["stage_mttr_s"] is not None and out["stage_mttr_s"] > 0
+        assert any("lease expired" in e for e in out["events"])
+        assert any("restored by rank" in e for e in out["events"])
+        # accounting: every (step, mb) applied exactly once per stage
+        assert out["applied_ok"]
+        assert out["discarded_applies"] == 0
+        # the faults genuinely fired
+        assert out["chaos_counts"].get("drop", 0) > 0
+        assert out["chaos_counts"].get("dup", 0) > 0
+        assert any(k.startswith("weather") for k in out["chaos_counts"])
+        # loss-trajectory equivalence to the fault-free corridor: replay
+        # reconstructs the SAME updates, so the trajectory is numerically
+        # the corridor trajectory, not merely near it
+        np.testing.assert_allclose(out["losses"], corridor["losses"],
+                                   rtol=1e-5, atol=1e-6)
+        logs.append(out["chaos_lines"])
+    assert logs[0] and logs[0] == logs[1] == logs[2], (
+        "mpmd chaos log not byte-identical across runs")
+
+
+@pytest.mark.drill
+def test_mpmd_stage_drill_manifest_restore():
+    """The drill satellite: the snapshot barrier covers STAGE checkpoints
+    (a FleetManifest whose shard records are the stage ranges), a killed
+    stage restores THROUGH the manifest (range + apply-seq validated),
+    and drill accounting proves no microbatch applied twice."""
+    from distributed_ml_pytorch_tpu.coord.manifest import FleetManifest
+
+    base = tempfile.mkdtemp(prefix="mpmd_d_")
+    out = mpmd_scenario(
+        base_dir=base, seed=0, steps=8, kill_stage=2, kill_at_step=4,
+        snapshot_at_step=1, restore_via_manifest=True)
+    assert out["ok"], (out["errors"], out["events"])
+    manifest = FleetManifest.load(os.path.join(base, "fleet_manifest.json"))
+    ranges = stage_param_ranges(small_cfg(), 4)
+    assert [(r.lo, r.hi) for r in manifest.shards] == ranges
+    assert out["stage_restarts"] == 1 and out["applied_ok"]
+    assert any("snapshot 1 complete" in e for e in out["events"])
+    # the restored member's checkpoint covered the manifest's promise
+    # (restore() would have refused otherwise) and replay filled the gap
+    victim_stats = out["stats"]["stage2"]
+    assert victim_stats["updates"] >= 4  # steps 4..7 rebuilt after restore
+
+
+def test_mpmd_speculation_standby_takeover():
+    """Sandblaster speculation applied to stages: a throttled (straggler)
+    stage member is raced by a standby that loads its checkpoint; the
+    placement flips to the winner, the victim goes passive, and the
+    loser's racing applications are DISCARDED work, never double-applied."""
+    # warm the program cache first: cold jit compiles stall every stage
+    # for seconds, which drowns the busy-ms contrast the straggler
+    # detector needs (same discipline as the acceptance's corridor run)
+    warm = mpmd_scenario(base_dir=tempfile.mkdtemp(prefix="mpmd_w_"),
+                         seed=0, steps=2)
+    assert warm["ok"], (warm["errors"], warm["events"])
+    out = mpmd_scenario(
+        base_dir=tempfile.mkdtemp(prefix="mpmd_s_"), seed=0, steps=12,
+        throttle_stage=1, throttle=0.2, standby=True,
+        straggler_factor=5.0, lease=0.5)
+    assert out["ok"], (out["errors"], out["events"])
+    assert any("stage straggler" in e for e in out["events"])
+    # the flip is logged as a TAKEOVER when the victim's lease is still
+    # live, or as a restore when the overloaded victim's lease expired
+    # first — either way the standby must now OWN the stage and have done
+    # the work, with the victim passive and nothing double-applied
+    assert any("TAKEOVER" in e
+               or ("restored by rank" in e and "stage 1" in e)
+               for e in out["events"]), out["events"]
+    assert out["standby"].stats["updates"] > 0
+    assert out["placement"].entries[1].rank == out["standby"].rank
+    assert out["applied_ok"]
