@@ -151,9 +151,20 @@ mpmd-demo:
 	$(PY) -m distributed_ml_pytorch_tpu.coord.cli --mpmd
 
 # MPMD bench phase: steady-state pipeline throughput, bubble fraction,
-# and stage-kill MTTR before/during/after a restart
+# and stage-kill MTTR before/during/after a restart; also leaves the
+# fleet's flight-recorder dumps behind (analyze them with `make timeline`)
 bench-mpmd:
 	$(PY) bench_all.py --only mpmd
+
+# timeline analyzer (ISSUE 12): merge a run's flight-recorder dumps and
+# attribute each stage's wall clock (compute / wait-act / wait-grad /
+# wire-blocked / ckpt) plus the wire's share (retransmits, credit-block,
+# ack frames). Default dir = the newest bench-mpmd run's obs dumps; point
+# it anywhere with: make timeline TIMELINE_DIR=path/to/obs
+TIMELINE_DIR ?= $(shell ls -td "$${TMPDIR:-/tmp}"/bench_mpmd_*/obs 2>/dev/null | head -1)
+timeline:
+	@test -n "$(TIMELINE_DIR)" || (echo "no dump dir found — run 'make bench-mpmd' first or pass TIMELINE_DIR=<dir>"; exit 1)
+	$(PY) -m distributed_ml_pytorch_tpu.analysis timeline $(TIMELINE_DIR)
 
 # adaptive-wire suite (ISSUE 7): RTT-driven retransmission, window/credit
 # backpressure, circuit breakers, and seeded network weather (latency /
@@ -205,4 +216,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-health bench-gate bench-compute bench-mpmd chaos coord drill drill-demo fleet health health-demo mpmd mpmd-demo netweather soak lint test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-health bench-gate bench-compute bench-mpmd timeline chaos coord drill drill-demo fleet health health-demo mpmd mpmd-demo netweather soak lint test test-all verify-real-data graph install dist

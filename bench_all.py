@@ -54,7 +54,7 @@ RESULTS: list = []
 
 
 def emit(config: int, metric: str, value: float, unit: str, hardware: str,
-         note: str) -> None:
+         note: str, extra: dict = None) -> None:
     rec = {
         "config": config,
         "metric": metric,
@@ -70,8 +70,51 @@ def emit(config: int, metric: str, value: float, unit: str, hardware: str,
     if isinstance(value, Rate) and value.tflops is not None:
         rec.update(value.record_fields())
         rec["note"] = f"{note}; {value.mfu_note()}"
+    if extra:
+        # structured side-channel fields (e.g. mpmd_phase's
+        # bubble_attribution, ISSUE 12) — schema-checked by the caller
+        rec.update(extra)
     RESULTS.append(rec)
     print(json.dumps(rec), flush=True)
+
+
+#: the exclusive serve-loop states a bubble_attribution record may name
+#: (utils/obs.StateClock vocabulary for the mpmd plane)
+BUBBLE_STATES = ("compute", "wait-act", "wait-grad", "wire-blocked", "ckpt",
+                 "idle")
+
+
+def check_bubble_attribution(attr: dict) -> dict:
+    """Schema gate for ``mpmd_phase``'s ``bubble_attribution`` JSON field
+    (ISSUE 12; the ``test_bench_gate.py``-style check): fractions over the
+    known exclusive states, summing to ~1, with ``bubble_fraction``
+    consistent with ``1 - compute``. Raises ``ValueError`` on any breach —
+    a malformed attribution must not ship in the bench record."""
+    if not isinstance(attr, dict):
+        raise ValueError(f"bubble_attribution must be a dict, got "
+                         f"{type(attr).__name__}")
+    fractions = attr.get("fractions")
+    if not isinstance(fractions, dict) or not fractions:
+        raise ValueError("bubble_attribution.fractions missing/empty")
+    unknown = sorted(k for k in fractions if k not in BUBBLE_STATES)
+    if unknown:
+        raise ValueError(f"bubble_attribution names unknown state(s) "
+                         f"{unknown} (known: {list(BUBBLE_STATES)})")
+    total = sum(float(v) for v in fractions.values())
+    if not 0.95 <= total <= 1.05:
+        raise ValueError(
+            f"bubble_attribution fractions sum to {total:.4f}, not ~1 — "
+            "the exclusive-state clock contract is broken")
+    bubble = attr.get("bubble_fraction")
+    if not isinstance(bubble, (int, float)) or not 0.0 <= bubble <= 1.0:
+        raise ValueError(f"bubble_fraction {bubble!r} not in [0, 1]")
+    if abs((1.0 - float(fractions.get("compute", 0.0))) - float(bubble)) \
+            > 1e-3:
+        raise ValueError("bubble_fraction != 1 - compute fraction")
+    stages = attr.get("stages")
+    if not isinstance(stages, int) or stages < 1:
+        raise ValueError(f"bubble_attribution.stages {stages!r} invalid")
+    return attr
 
 
 def tpu_phase() -> None:
@@ -1135,10 +1178,29 @@ def mpmd_phase() -> None:
          f"over ReliableTransport), M={M} microbatches of {mb}x{seq} "
          "tokens; driver step cadence, fault-free "
          "(coord/stages.mpmd_scenario)")
+    # the flight-recorder decomposition of that bubble (ISSUE 12): merge
+    # the run's per-member dumps and attribute each stage's wall clock to
+    # its exclusive serve-loop states — schema-gated so a malformed
+    # attribution can never ship in the record
+    attribution = None
+    try:
+        from distributed_ml_pytorch_tpu.analysis import timeline
+
+        report = timeline.analyze(out["obs_dir"])
+        attribution = check_bubble_attribution(
+            report["bubble_attribution"])
+        log(f"mpmd_phase: flight-recorder dumps in {out['obs_dir']} "
+            f"(analyze anytime: make timeline TIMELINE_DIR={out['obs_dir']})")
+    except (ValueError, OSError, KeyError) as e:
+        log(f"mpmd_phase: bubble attribution unavailable: {e!r}")
     emit(3, "mpmd_bubble_fraction", bubble * 100.0, "%",
          "in-process fleet, 1 core",
          "1 - sum(stage busy s) / (stages x wall s) over the steady run — "
-         "idle share of stage-seconds (schedule bubble + wire wait)")
+         "idle share of stage-seconds (schedule bubble + wire wait); "
+         "bubble_attribution decomposes it per flight-recorder state "
+         "(analysis/timeline.py over the run's obs dumps)",
+         extra=({"bubble_attribution": attribution}
+                if attribution is not None else None))
 
     kill_at = 6
     out = mpmd_scenario(base_dir=tempfile.mkdtemp(prefix="bench_mpmd_"),

@@ -8,6 +8,12 @@ unsuppressed, non-baselined finding remains — the ``make lint`` contract.
     python -m distributed_ml_pytorch_tpu.analysis --baseline tests/distcheck_baseline.txt
     python -m distributed_ml_pytorch_tpu.analysis --keys          # baseline keys (regen script)
     python -m distributed_ml_pytorch_tpu.analysis path/to/pkg     # any tree (fixtures)
+
+The ``timeline`` subcommand (ISSUE 12) is the package's first RUNTIME
+analyzer: it merges flight-recorder dumps and attributes the bubble and
+the wire (``analysis/timeline.py``; ``make timeline``):
+
+    python -m distributed_ml_pytorch_tpu.analysis timeline <dump-dir> [--json]
 """
 
 from __future__ import annotations
@@ -49,6 +55,12 @@ def default_root() -> str:
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "timeline":
+        # runtime analyzer (ISSUE 12): its own arg surface, no package scan
+        from distributed_ml_pytorch_tpu.analysis import timeline
+
+        return timeline.main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="distcheck",
         description="protocol / concurrency / tracing-hygiene static "

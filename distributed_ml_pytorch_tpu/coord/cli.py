@@ -92,8 +92,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--snapshot-interval", type=float, default=0.0,
                    help="seconds between automatic fleet snapshot barriers "
                         "(0 = only on demand)")
+    p.add_argument("--metrics-dump", type=str, default="", metavar="PATH",
+                   help="write the metrics-registry snapshot JSON "
+                        "(utils/metrics.get_registry, ISSUE 12) to PATH at "
+                        "exit — decision-log totals, fleet telemetry, "
+                        "attached component stats; '-' prints to stdout")
     p.add_argument("--seed", type=int, default=0)
     return p
+
+
+def dump_metrics(path: str) -> None:
+    """Shared ``--metrics-dump`` tail for the three CLIs (ISSUE 12)."""
+    if not path:
+        return
+    from distributed_ml_pytorch_tpu.utils.metrics import get_registry
+
+    reg = get_registry()
+    if path == "-":
+        print(reg.dump_json())
+    else:
+        reg.dump_json(path)
+        print(f"metrics snapshot -> {path}")
 
 
 def _n_params(args) -> int:
@@ -179,6 +198,22 @@ def main(argv=None) -> int:
         auto_rollback=args.auto_rollback,
         rollback_loss_factor=args.rollback_loss_factor,
         reputation_nacks=args.reputation_nacks)
+    if args.metrics_dump:
+        from distributed_ml_pytorch_tpu.coord.coordinator import (
+            FLEET_METRICS_FIELDS,
+        )
+        from distributed_ml_pytorch_tpu.utils.metrics import get_registry
+
+        get_registry().attach(
+            "coord", lambda: {
+                "events_total": coord.events.total,
+                "events_dropped": coord.events.dropped,
+                "rollbacks_done": coord.rollbacks_done,
+                "manifests_written": coord.manifests_written,
+                **{f"fleet.{k}": v for k, v in zip(
+                    FLEET_METRICS_FIELDS,
+                    coord.fleet_state()["fleet_metrics"])},
+            })
     print(f"coordinator on {args.master}:{args.port} "
           f"({n_params} params, lease {args.lease:.1f}s)")
     try:
@@ -189,7 +224,11 @@ def main(argv=None) -> int:
         transport.close()
         for line in coord.events[-20:]:
             print("event:", line)
+        if coord.events.dropped:
+            print(f"({coord.events.total} decisions total, "
+                  f"{coord.events.dropped} aged out of the ring)")
         print("fleet at exit:", coord.fleet_state())
+        dump_metrics(args.metrics_dump)
     return 0
 
 

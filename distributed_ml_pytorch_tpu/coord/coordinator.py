@@ -50,6 +50,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from distributed_ml_pytorch_tpu.coord.shardmap import ShardMap, rebalance
+from distributed_ml_pytorch_tpu.utils import obs
 from distributed_ml_pytorch_tpu.utils.messaging import (
     MessageCode,
     Transport,
@@ -126,28 +127,55 @@ def encode_rollback_done(rollback_id: int, map_version: int, lo: int,
          *_split16(hi), *_split16(apply_seq)], np.float32)
 
 
+#: order of the ``fleet_metrics`` floats behind the -1 separator in a
+#: FleetState tail (ISSUE 12): the coordinator-side registry summary every
+#: member sees for free on the broadcast it already consumes
+FLEET_METRICS_FIELDS = (
+    "events_total",    # decisions ever logged (the ring's total counter)
+    "mean_ewma_ms",    # fleet-mean member step/busy latency EWMA
+    "wire_open",       # summed open circuit breakers across members
+    "nacks",           # summed admission nacks across members
+)
+
+
 def encode_fleet(version: int, n_workers: int, n_shards: int, n_engines: int,
-                 workers_done: bool, engine_ranks=()) -> np.ndarray:
+                 workers_done: bool, engine_ranks=(),
+                 fleet_metrics=()) -> np.ndarray:
     """The compact fleet broadcast; the tail lists the LIVE engine members'
     coordinator ranks, so a serving router can tell WHICH engine's lease
-    expired, not just that a count dropped (per-engine health, ISSUE 6)."""
+    expired, not just that a count dropped (per-engine health, ISSUE 6).
+    ``fleet_metrics`` (ISSUE 12) rides BEHIND a ``-1`` separator — engine
+    ranks are non-negative, so the split is unambiguous, and a frame
+    without the separator (the pre-ISSUE-12 form) still decodes with an
+    empty metrics tail."""
+    tail = [float(r) for r in engine_ranks]
+    metrics = [float(m) for m in fleet_metrics]
+    if metrics:
+        tail += [-1.0] + metrics
     return np.asarray(
         [*_split16(version), float(n_workers), float(n_shards),
-         float(n_engines), 1.0 if workers_done else 0.0,
-         *(float(r) for r in engine_ranks)], np.float32)
+         float(n_engines), 1.0 if workers_done else 0.0, *tail], np.float32)
 
 
 def decode_fleet(payload: np.ndarray) -> dict:
     if payload.size < 6 or not np.isfinite(payload[:6]).all():
         raise ValueError(f"malformed FleetState frame (size {payload.size})")
     tail = payload[6:]
+    tail = tail[np.isfinite(tail)]
+    neg = np.nonzero(tail < 0)[0]
+    if neg.size:
+        ranks, metrics = tail[:neg[0]], tail[neg[0] + 1:]
+    else:
+        ranks, metrics = tail, tail[:0]
     return {
         "version": _join16(payload[0], payload[1]),
         "n_workers": int(payload[2]),
         "n_shards": int(payload[3]),
         "n_engines": int(payload[4]),
         "workers_done": bool(payload[5]),
-        "engine_ranks": [int(r) for r in tail[np.isfinite(tail)]],
+        "engine_ranks": [int(r) for r in ranks],
+        "fleet_metrics": dict(zip(FLEET_METRICS_FIELDS,
+                                  (float(m) for m in metrics))),
     }
 
 
@@ -228,7 +256,19 @@ class Coordinator:
         self.speculated: Dict[int, int] = {}  # victim rank -> task id
         self._next_task = 1
         self._stop = threading.Event()
-        self.events: List[str] = []  # human-readable decision log (tests/CLI)
+        #: human-readable decision log (tests/CLI). A capped RING since
+        #: ISSUE 12 — the old unbounded List[str] leaked memory linearly
+        #: on day-long soaks. List-like iteration/slicing is preserved
+        #: (``events[-20:]`` renders unchanged); ``events.total`` counts
+        #: everything ever logged, ``events.dropped`` what the ring forgot.
+        self.events = obs.BoundedEvents(maxlen=1024)
+        #: optional flight recorder (``utils/obs.SpanRecorder``), attached
+        #: post-construction: every decision-log line doubles as a
+        #: structured event on the fleet timeline, and rollback barriers
+        #: dump the ring to ``obs_dir`` (set it alongside) so each MTTR
+        #: ships with the window that explains it (ISSUE 12)
+        self.recorder = None
+        self.obs_dir: Optional[str] = None
         # --- snapshot barrier (ISSUE 5): coordinator-aligned fleet ckpts ---
         self.manifest_dir = manifest_dir
         self.snapshot_interval = float(snapshot_interval)
@@ -307,6 +347,10 @@ class Coordinator:
     # ------------------------------------------------------------ bookkeeping
     def _log(self, msg: str) -> None:
         self.events.append(msg)
+        if self.recorder is not None:
+            # the string log PROMOTED: same content, structured, on the
+            # same recorder every other plane writes to (ISSUE 12)
+            self.recorder.event("coord", corr=0, msg=msg)
         _LOGGER.info("coordinator: %s", msg)
 
     def _live(self, kind: Optional[int] = None) -> List[MemberInfo]:
@@ -317,7 +361,19 @@ class Coordinator:
     def fleet_state(self) -> dict:
         workers = self._live(KIND_WORKER)
         engines = self._live(KIND_ENGINE)
+        live = self._live()
+        reported = [m for m in live if m.reported]
+        fleet_metrics = [
+            float(self.events.total),
+            (sum(m.ewma_ms for m in reported) / len(reported)
+             if reported else 0.0),
+            float(sum(m.wire_open for m in live)),
+            float(sum(m.nacks for m in live)),
+        ]
         return {
+            # registry-style fleet telemetry tail (ISSUE 12), wire order =
+            # FLEET_METRICS_FIELDS; rides every FleetState broadcast
+            "fleet_metrics": fleet_metrics,
             "version": self.shard_map.version,
             "n_workers": len(workers),
             "n_shards": len(self._live(KIND_SHARD)),
@@ -388,7 +444,7 @@ class Coordinator:
         fs = self.fleet_state()
         self._broadcast(MessageCode.FleetState, encode_fleet(
             fs["version"], fs["n_workers"], fs["n_shards"], fs["n_engines"],
-            fs["workers_done"], fs["engine_ranks"]))
+            fs["workers_done"], fs["engine_ranks"], fs["fleet_metrics"]))
 
     # -------------------------------------------------------------- handle
     def handle(self, sender: int, code: MessageCode,
@@ -460,7 +516,7 @@ class Coordinator:
                 self._send(sender, MessageCode.FleetState, encode_fleet(
                     fs["version"], fs["n_workers"], fs["n_shards"],
                     fs["n_engines"], fs["workers_done"],
-                    fs["engine_ranks"]))
+                    fs["engine_ranks"], fs["fleet_metrics"]))
             return
         if member is None:
             return  # pre-join (or post-expiry) chatter: the join retry fixes it
@@ -609,6 +665,7 @@ class Coordinator:
                 self._roll["id"], self._roll["snapshot_id"],
                 self._roll["map_version"], 1))
             self.rollbacks_abandoned += 1
+            self._flight_dump(f"rollback{self._roll['id']}-abandoned")
             self._roll = None
         return bool(expired)
 
@@ -885,6 +942,15 @@ class Coordinator:
             self._broadcast_rollback(encode_rollback_request(
                 roll["id"], roll["snapshot_id"], roll["map_version"], 1))
             self._roll = None
+            self._flight_dump(f"rollback{roll['id']}")
+
+    def _flight_dump(self, reason: str) -> None:
+        """Automatic black-box write (ISSUE 12): when a recorder and an
+        ``obs_dir`` are attached, persist the decision timeline covering
+        the fault window — every rollback/restore MTTR number ships with
+        the trace that explains it."""
+        if self.recorder is not None and self.obs_dir:
+            obs.flight_dump(self.recorder, self.obs_dir, reason)
 
     # ------------------------------------------------------- engine scaling
     def check_engine_scaling(self, now: Optional[float] = None) -> Optional[str]:

@@ -245,6 +245,15 @@ def health_scenario(
         rollback_timeout=rollback_timeout,
         reputation_nacks=reputation_nacks,
         reputation_cooldown=reputation_cooldown)
+    # flight recorder (ISSUE 12): the rollback barrier auto-dumps the
+    # decision timeline into base_dir/obs — every rollback MTTR ships
+    # with its window. Observational only: the 3x byte-identical
+    # chaos-log acceptance runs WITH this attached (the recorder-
+    # determinism guard for the health scenario).
+    from distributed_ml_pytorch_tpu.utils import obs as _obs
+
+    coord.recorder = _obs.SpanRecorder("coord", "coord")
+    coord.obs_dir = os.path.join(base_dir, "obs")
     coord_thread = threading.Thread(
         target=coord.run, kwargs={"timeout": 600}, daemon=True)
     coord_thread.start()

@@ -100,6 +100,13 @@ class FleetView:
         s = self.state
         return s is not None and s["workers_done"]
 
+    def fleet_metrics(self) -> dict:
+        """The coordinator's registry summary from the latest FleetState
+        tail (ISSUE 12) — ``{}`` before the first report or from a
+        pre-metrics coordinator (fail open, like the rank view)."""
+        s = self.state
+        return {} if s is None else dict(s.get("fleet_metrics") or {})
+
     def note_rollback(self, active: bool, ttl: float = 15.0) -> None:
         """Record a rollback-barrier phase transition (ISSUE 8). ``active``
         holds admission for at most ``ttl`` seconds — the fail-open bound

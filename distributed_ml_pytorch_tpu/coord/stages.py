@@ -299,6 +299,8 @@ class StageCoordinator(Coordinator):
                 f"stage {stage} restored by rank {sender} after "
                 f"{mttr * 1e3:.0f} ms vacancy (watermark {watermark}: "
                 f"neighbors replay in-flight microbatches past it)")
+            # stage-death MTTR ships with its timeline (ISSUE 12)
+            self._flight_dump(f"stage{stage}-restored")
         self._set_entry(entry, why)
 
     # ----------------------------------------------------------------- tick
@@ -565,9 +567,25 @@ def mpmd_scenario(
     def rel(rank: int) -> ReliableTransport:
         return ReliableTransport(data_world[rank], **rel_opts)
 
+    # --- flight recorders (ISSUE 12): one per member, dumped into
+    # base_dir/obs on stop/death so `analysis timeline` can merge them.
+    # Purely observational — the 3x byte-identical chaos-log acceptance
+    # runs WITH these on (the recorder-determinism guard).
+    from distributed_ml_pytorch_tpu.utils import obs as _obs
+
+    obs_dir = os.path.join(base_dir, "obs")
+
+    def make_recorder(member: str, transport) -> "_obs.SpanRecorder":
+        rec = _obs.SpanRecorder(member, "mpmd")
+        if hasattr(transport, "recorder"):
+            transport.recorder = rec  # wire-blocked / retransmit spans
+        return rec
+
     coord = StageCoordinator(
         coord_world[0], ranges, lease=lease,
         manifest_dir=base_dir, straggler_factor=straggler_factor)
+    coord.recorder = _obs.SpanRecorder("coord", "coord")
+    coord.obs_dir = obs_dir
     coord_thread = threading.Thread(
         target=coord.run, kwargs={"timeout": timeout + 60}, daemon=True)
     coord_thread.start()
@@ -597,7 +615,9 @@ def mpmd_scenario(
             mb_size=mb, seq_len=seq, lr=lr, seed=seed,
             ckpt_dir=os.path.join(base_dir, f"stage{i}"),
             throttle=(throttle if throttle_stage == i else 0.0),
-            step_hook=hook)
+            step_hook=hook,
+            recorder=make_recorder(f"stage{i}", transport),
+            obs_dir=obs_dir)
 
     stages: List[MpmdStage] = []
     stage_threads: List[threading.Thread] = []
@@ -613,9 +633,12 @@ def mpmd_scenario(
     if standby:
         client = CoordClient(coord_world[standby_rank], "stage",
                              renew_interval=lease / 4)
+        standby_transport = rel(standby_rank)
         standby_member = MpmdStage(
-            None, cfg, S, M, rel(standby_rank), client,
-            mb_size=mb, seq_len=seq, lr=lr, seed=seed, ckpt_root=base_dir)
+            None, cfg, S, M, standby_transport, client,
+            mb_size=mb, seq_len=seq, lr=lr, seed=seed, ckpt_root=base_dir,
+            recorder=make_recorder("standby", standby_transport),
+            obs_dir=obs_dir)
         t = threading.Thread(target=standby_member.run,
                              kwargs={"timeout": timeout + 60}, daemon=True)
         t.start()
@@ -664,7 +687,10 @@ def mpmd_scenario(
     # --- driver -----------------------------------------------------------
     driver_client = CoordClient(coord_world[driver_coord_rank], "worker",
                                 renew_interval=lease / 4)
-    driver = MpmdDriver(rel(0), driver_client, S, M)
+    driver_transport = rel(0)
+    driver = MpmdDriver(driver_transport, driver_client, S, M,
+                        recorder=make_recorder("driver", driver_transport),
+                        obs_dir=obs_dir)
 
     def driver_hook(t: int, _loss: float) -> None:
         if snapshot_at_step is not None and t == snapshot_at_step:
@@ -779,9 +805,14 @@ def mpmd_scenario(
     for t in coord_world.values():
         t.close()
 
+    # final black-box write: the coordinator's decision timeline joins the
+    # members' dumps so `analysis timeline` sees the whole fleet
+    _obs.flight_dump(coord.recorder, obs_dir, "stop")
+
     mttr = coord.stage_mttrs[0] if coord.stage_mttrs else None
     return {
         "ok": not errors and len(losses) == steps and applied_ok,
+        "obs_dir": obs_dir,
         "errors": errors,
         "losses": losses,
         "step_times": list(driver.step_times),

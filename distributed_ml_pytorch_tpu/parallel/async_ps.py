@@ -88,6 +88,7 @@ class ParameterServer:
         wal: bool = False,
         wal_group_n: int = 8,
         admission=None,
+        recorder=None,
     ):
         if params is not None:
             self.central = np.asarray(params, dtype=np.float32).copy()
@@ -116,6 +117,13 @@ class ParameterServer:
         #: never a WAL record (a logged poisoned record would be replayed
         #: on every recovery, forever)
         self.admission = admission
+        # --- observability plane (ISSUE 12) -----------------------------
+        #: optional flight recorder (``utils/obs.SpanRecorder``): the PS
+        #: side of the worker-push timeline — admission verdicts, WAL
+        #: append/fsync spans, the apply span — all under the correlation
+        #: id the delivering envelope restored into the serve thread.
+        #: Purely observational (never consulted for a decision).
+        self.recorder = recorder
         self.quarantined = 0
         self.quarantined_by_sender: dict = {}
         self.nacks_sent = 0
@@ -446,8 +454,16 @@ class ParameterServer:
         """Group commit: fsync the WAL batch, then release the delivery
         acks deferred behind it (``ReliableTransport.ack_delivered``) —
         log-before-ack is what upgrades "acked" to "survives a crash"."""
+        rec = self.recorder
         if self.wal is not None:
+            had_pending = self.wal.pending > 0
+            t0 = time.monotonic_ns() if rec is not None else 0
             self.wal.sync()
+            if rec is not None and had_pending:
+                # only real fsyncs land on the timeline — the idle-loop
+                # commit() with an empty group is a no-op, not a span
+                rec.record("wal-fsync", "wal", t0, time.monotonic_ns(),
+                           corr=0)
         ack = getattr(self.transport, "ack_delivered", None)
         if ack is not None:
             ack()
@@ -477,6 +493,7 @@ class ParameterServer:
                     self._quarantine_update(sender, verdict)
                     return
             # workers pre-scale by -lr (Asynchronous.py:55) → server-side add
+            rec = self.recorder
             staleness = self.staleness.on_push(sender)
             if self.staleness_damping > 0.0 and staleness > 0:
                 delta = (payload / (1.0 + self.staleness_damping * staleness)
@@ -492,12 +509,23 @@ class ParameterServer:
                 # is fsync'd (commit()) the delivery ack is released and
                 # the update can never be lost
                 env_inc, env_seq = self._envelope or (0, 0)
+                t0 = time.monotonic_ns() if rec is not None else 0
                 self.wal.append(self._apply_seq, delta, sender=sender,
                                 env_inc=env_inc, env_seq=env_seq)
+                if rec is not None:
+                    rec.record("wal-append", "wal", t0, time.monotonic_ns(),
+                               meta={"sender": sender,
+                                     "seq": self._apply_seq})
                 if env_inc or env_seq:
                     self._recent_envelopes.append(
                         (sender, env_inc, env_seq))
+            t0 = time.monotonic_ns() if rec is not None else 0
             self.central += delta
+            if rec is not None:
+                # the corr id the delivering envelope restored into this
+                # thread stitches push -> admission -> WAL -> apply -> ack
+                rec.record("apply", "apply", t0, time.monotonic_ns(),
+                           meta={"sender": sender, "seq": self._apply_seq})
             self._push_count += 1
             if self.ckpt_dir and self.ckpt_every and (
                 self._push_count % self.ckpt_every == 0
@@ -546,6 +574,10 @@ class ParameterServer:
         self.quarantined_by_sender[sender] = (
             self.quarantined_by_sender.get(sender, 0) + 1)
         self.quarantine.append((sender, int(reason), float(norm), float(z)))
+        if self.recorder is not None:
+            self.recorder.event(
+                "quarantine", sender=sender, reason=int(reason),
+                norm=clamp_finite32(norm), z=clamp_finite32(z))
         _LOGGER.warning(
             "quarantined GradientUpdate #%d from worker %d: %s "
             "(norm %.3g, z %.2f) — nacking",

@@ -83,6 +83,7 @@ from distributed_ml_pytorch_tpu.parallel.pipeline import (
     _stage_forward,
     init_pp_params,
 )
+from distributed_ml_pytorch_tpu.utils import obs
 from distributed_ml_pytorch_tpu.utils.durability import atomic_write
 from distributed_ml_pytorch_tpu.utils.messaging import (
     MessageCode,
@@ -90,6 +91,7 @@ from distributed_ml_pytorch_tpu.utils.messaging import (
     _join16,
     _split16,
 )
+from distributed_ml_pytorch_tpu.utils.metrics import Ewma
 
 _LOGGER = logging.getLogger(__name__)
 
@@ -464,6 +466,8 @@ class MpmdStage:
         throttle: float = 0.0,
         retain_steps: int = 3,
         step_hook: Optional[Callable[["MpmdStage", int], None]] = None,
+        recorder: Optional["obs.SpanRecorder"] = None,
+        obs_dir: Optional[str] = None,
     ):
         self.cfg = cfg
         self.S = int(n_stages)
@@ -504,7 +508,10 @@ class MpmdStage:
         self.applied_log: List[Tuple[int, int]] = []
         self._placement = None
         self._superseded = False
-        self._ewma_ms = 0.0
+        #: per-update busy-ms EWMA — the shared implementation
+        #: (``utils/metrics.Ewma``, ISSUE 12; bit-identical to the old
+        #: hand-rolled 0.7/0.3 idiom so LeaseRenew floats are unchanged)
+        self._ewma = Ewma()
         self._busy_at_update = 0.0
         self.stats = {
             "fwd": 0, "bwd": 0, "updates": 0, "dup_inputs_dropped": 0,
@@ -512,6 +519,21 @@ class MpmdStage:
             "send_failed": 0, "snapshots": 0, "malformed_dropped": 0,
             "busy_s": 0.0,
         }
+        # --- flight recorder (ISSUE 12) ---------------------------------
+        #: spans + exclusive-state attribution for THIS member's serve
+        #: loop (compute / wait-act / wait-grad / wire-blocked / ckpt /
+        #: idle); dumps ride stage death and normal stop so every MTTR
+        #: number ships with its timeline. Purely observational: the
+        #: recorder reads clocks only and never steers a decision (the
+        #: chaos-determinism guard in tests/test_obs.py).
+        self.recorder = recorder
+        self.obs_dir = obs_dir
+        self._clock = (obs.StateClock(recorder, "idle")
+                       if recorder is not None else None)
+        #: per-(step, mb) correlation ids: one microbatch = one id across
+        #: every member that touches it (adopted from inbound frames,
+        #: allocated fresh only at the first touch)
+        self._mb_corr: Dict[Tuple[int, int], int] = {}
 
         #: mailboxes the coord listener thread fills, the serve loop drains
         self._mu = threading.Lock()
@@ -657,6 +679,11 @@ class MpmdStage:
         else:
             head = np.asarray(
                 [*_split16(step), float(mbi), *_split16(ver)], np.float32)
+        # credit-blocked send time is the WIRE's fault, not compute's:
+        # carve it out of the serve loop's current state (ISSUE 12)
+        stats = getattr(self.transport, "stats", None)
+        blocked0 = (stats.get("window_blocked_s", 0.0)
+                    if isinstance(stats, dict) else 0.0)
         try:
             self.transport.send(
                 code, np.concatenate([head, body.ravel()]), dst=dst_rank)
@@ -664,13 +691,19 @@ class MpmdStage:
             # a dead/vacant peer: the retained buffer + the placement
             # re-ship own recovery, the send path must not die
             self.stats["send_failed"] += 1
+        if self._clock is not None and isinstance(stats, dict):
+            blocked = stats.get("window_blocked_s", 0.0) - blocked0
+            if blocked > 0:
+                self._clock.carve("wire-blocked", blocked)
 
     def _ship(self, dirn: str, step: int, mbi: int,
               body: np.ndarray) -> None:
         """Retain-then-send one outbound hand-off; holds (retained only)
         when the destination stage is currently vacant. Loss reports are
         NOT retained: the driver never restarts (and a restarted last
-        stage recomputes + re-sends them; the driver dedups)."""
+        stage recomputes + re-sends them; the driver dedups). The send
+        rides the microbatch's correlation id, so the envelope carries it
+        to the neighbor (ISSUE 12)."""
         body = np.asarray(body, np.float32).ravel()
         if dirn in ("fwd", "bwd"):
             self._retained[dirn][(step, mbi)] = body
@@ -687,7 +720,8 @@ class MpmdStage:
             code, kind = MessageCode.ActivationShip, SHIP_LOSS
         if dst is None:
             return
-        self._send_frame(dst, code, step, mbi, kind, body)
+        with obs.corr_scope(self._mb_corr.get((step, mbi), 0)):
+            self._send_frame(dst, code, step, mbi, kind, body)
 
     # -------------------------------------------------------------- receive
     def handle(self, sender: int, code: MessageCode,
@@ -698,13 +732,26 @@ class MpmdStage:
             step = _join16(payload[0], payload[1])
             mbi = int(payload[2])
             kind = int(payload[3])
+            self._adopt_corr(step, mbi)
             self._on_ship(step, mbi, kind, payload[6:])
         elif code == MessageCode.ActivationGrad and payload.size >= 6:
             if not np.isfinite(payload[:5]).all():
                 return
             step = _join16(payload[0], payload[1])
             mbi = int(payload[2])
+            self._adopt_corr(step, mbi)
             self._on_grad(step, mbi, payload[5:])
+
+    def _adopt_corr(self, step: int, mbi: int) -> None:
+        """Bind the envelope's correlation id (restored into the thread-
+        local by ReliableTransport on delivery) to this (step, mb), so the
+        member's own compute spans and onward ships carry the SAME id the
+        driver stamped — one microbatch, one timeline (ISSUE 12)."""
+        if self.recorder is None:
+            return
+        corr = obs.current_corr()
+        if corr and (step, mbi) not in self._mb_corr:
+            self._mb_corr[(step, mbi)] = corr
 
     def _on_ship(self, step: int, mbi: int, kind: int,
                  body: np.ndarray) -> None:
@@ -827,6 +874,9 @@ class MpmdStage:
                     targets = jnp.asarray(
                         np.rint(tgt).astype(np.int32).reshape(
                             self.mb_size, self.seq_len))
+                    if self._clock is not None:
+                        self._clock.set("compute",
+                                        corr=self._mb_corr.get((t, mbi), 0))
                     t0 = time.perf_counter()
                     ce_sum, d_params, d_x = prog.loss_bwd(
                         self.params, self._decode_input(inputs[mbi]),
@@ -843,6 +893,9 @@ class MpmdStage:
                     self._ship("loss", t, mbi,
                                np.asarray([ce_sum], np.float32))
                 else:
+                    if self._clock is not None:
+                        self._clock.set("compute",
+                                        corr=self._mb_corr.get((t, mbi), 0))
                     t0 = time.perf_counter()
                     h_out = prog.fwd(
                         self.params, self._decode_input(inputs[mbi]))
@@ -862,6 +915,9 @@ class MpmdStage:
                     if mbi in done_b or mbi not in done_f or mbi not in gin:
                         continue
                     g = jnp.asarray(gin[mbi].reshape(self._act_shape()))
+                    if self._clock is not None:
+                        self._clock.set("compute",
+                                        corr=self._mb_corr.get((t, mbi), 0))
                     t0 = time.perf_counter()
                     d_params, d_x = prog.bwd(
                         self.params, self._decode_input(inputs[mbi]), g)
@@ -885,6 +941,8 @@ class MpmdStage:
         acc = grads[0]
         for mbi in range(1, self.M):  # mb order: deterministic sum
             acc = jax.tree.map(jnp.add, acc, grads[mbi])
+        if self._clock is not None:
+            self._clock.set("compute")
         t0 = time.perf_counter()
         self.params, self.opt_state = self.programs.update(
             self.params, self.opt_state, acc)
@@ -900,8 +958,13 @@ class MpmdStage:
         # the coordinator WHICH stage is the straggler
         busy_ms = (self.stats["busy_s"] - self._busy_at_update) * 1e3
         self._busy_at_update = self.stats["busy_s"]
-        self._ewma_ms = (busy_ms if self._ewma_ms == 0.0
-                         else 0.7 * self._ewma_ms + 0.3 * busy_ms)
+        self._ewma.update(busy_ms)
+        if self.recorder is not None:
+            self.recorder.event("update", corr=0, step=self.step,
+                                busy_ms=round(busy_ms, 3))
+            # correlation keys for the retired step are done with
+            self._mb_corr = {k: v for k, v in self._mb_corr.items()
+                             if k[0] >= self.step - self.retain_steps}
         for d in (self._inputs, self._targets, self._gin, self._mb_grads,
                   self._done_fwd, self._done_bwd):
             d.pop(t, None)
@@ -910,7 +973,7 @@ class MpmdStage:
             for key in [k for k in dirn if k[0] < floor]:
                 del dirn[key]
         self._save_ckpt()
-        self.coord.report(self.watermark, self.step, self._ewma_ms)
+        self.coord.report(self.watermark, self.step, self._ewma.value)
         if self.step_hook is not None:
             self.step_hook(self, self.step)
 
@@ -923,11 +986,17 @@ class MpmdStage:
     def _save_ckpt(self) -> None:
         if not self.ckpt_dir or self._superseded:
             return
+        if self._clock is not None:
+            self._clock.set("ckpt")
         pflat, oflat = self._flat_state()
         save_stage_checkpoint(
             self.ckpt_dir, stage=self.stage, step=self.step,
             watermark=self.watermark, lo=self.lo, hi=self.hi,
             params_flat=pflat, opt_flat=oflat)
+        if self._clock is not None:
+            # back to compute until the loop's next wait classification —
+            # attribution stays exclusive (the ckpt stretch just closed)
+            self._clock.set("compute")
 
     def restore(self, manifest=None) -> None:
         """Restore params + optimizer + watermark from this stage's
@@ -1012,12 +1081,34 @@ class MpmdStage:
             task_id, self.rank, victim_stage, self.watermark, victim_rank)
         self.coord.stage_ready(self.stage, self.watermark)
 
+    def _wait_state(self) -> str:
+        """Classify what the serve loop is ABOUT to wait on (called when
+        :meth:`_pump` found nothing computable): missing activation/data
+        inputs -> ``wait-act``; all forwards done but cotangents missing
+        -> ``wait-grad``; unassigned / superseded / pre-placement ->
+        ``idle``. Exclusive states are what makes bubble attribution sum
+        to the wall clock (``analysis/timeline.py``)."""
+        if self.stage is None or self._superseded or self._placement is None:
+            return "idle"
+        t = self.step
+        done_f = self._done_fwd.get(t, set())
+        if len(done_f) >= self.M:
+            return "idle" if self.programs.last else "wait-grad"
+        if (self.programs is not None and not self.programs.last
+                and len(self._done_bwd.get(t, set())) < len(done_f)):
+            # forwards still owed AND cotangents outstanding: the schedule
+            # is blocked on the downstream neighbor first (1F1B drain)
+            return "wait-grad"
+        return "wait-act"
+
     # ------------------------------------------------------------ serve loop
     def run(self, timeout: Optional[float] = None) -> None:
         """Serve until ``stop()``/``crash()`` (or ``timeout``). A crash of
         the serve logic itself is recorded in ``self.error`` and stops the
         member — a silently dead thread would wedge the whole pipeline
-        with no diagnosis."""
+        with no diagnosis. On exit the flight recorder (when attached)
+        flushes its attribution and, for a death/crash, dumps the ring to
+        ``obs_dir`` — the MTTR number's black box (ISSUE 12)."""
         try:
             self._run(timeout)
         except Exception as e:  # noqa: BLE001 — surfaced via self.error
@@ -1025,6 +1116,19 @@ class MpmdStage:
             _LOGGER.exception("stage %s member rank %d serve loop died",
                               self.stage, self.rank)
             self._stop.set()
+        finally:
+            if self.recorder is not None:
+                if self._clock is not None:
+                    self._clock.flush()
+                # the transport's counters join the ring BEFORE the dump,
+                # so the flight file carries the wire attribution inputs
+                emit = getattr(self.transport, "emit_wire_stats", None)
+                if emit is not None:
+                    emit()
+                reason = ("error" if self.error is not None
+                          else "death" if self._crashed else "stop")
+                if self.obs_dir:
+                    obs.flight_dump(self.recorder, self.obs_dir, reason)
 
     def _run(self, timeout: Optional[float] = None) -> None:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -1036,6 +1140,8 @@ class MpmdStage:
             now = time.monotonic()
             if deadline is not None and now >= deadline:
                 break
+            if self._clock is not None:
+                self._clock.set(self._wait_state())
             msg = self.transport.recv(timeout=0.02)
             if msg is not None:
                 try:
@@ -1071,7 +1177,9 @@ class MpmdDriver:
     """
 
     def __init__(self, transport: Transport, coord, n_stages: int,
-                 n_microbatches: int):
+                 n_microbatches: int,
+                 recorder: Optional["obs.SpanRecorder"] = None,
+                 obs_dir: Optional[str] = None):
         self.transport = transport
         self.coord = coord
         self.S = int(n_stages)
@@ -1088,6 +1196,19 @@ class MpmdDriver:
         self.step_times: List[float] = []
         self.stats = {"reshipped": 0, "dup_loss_dropped": 0,
                       "send_failed": 0}
+        # --- flight recorder (ISSUE 12): the driver MINTS the microbatch
+        # correlation ids — every (step, mb) gets one id that rides the
+        # envelope through every stage's fwd/bwd and back on the loss
+        # report, which is what lets the timeline analyzer stitch one
+        # microbatch's whole journey. The map is PRUNED as steps complete
+        # (corr_retain_steps behind the frontier — comfortably past the
+        # stages' own retain window) so a day-long run cannot grow it
+        # without bound; a re-ship of an already-pruned (step, mb) mints a
+        # fresh id, which the analyzer just reads as a new unit of work.
+        self.recorder = recorder
+        self.obs_dir = obs_dir
+        self.corr_retain_steps = 8
+        self._mb_corr: Dict[Tuple[int, int], int] = {}
 
     def _note_placement(self, placement) -> None:
         with self._mu:
@@ -1108,10 +1229,16 @@ class MpmdDriver:
         head = np.asarray(
             [*_split16(step), float(mbi), float(kind), *_split16(ver)],
             np.float32)
+        # one correlation id per (step, mb), minted at first ship and
+        # reused by re-ships — the envelope carries it fleet-wide
+        corr = self._mb_corr.get((step, mbi))
+        if corr is None:
+            corr = self._mb_corr[(step, mbi)] = obs.next_corr()
         try:
-            self.transport.send(
-                MessageCode.ActivationShip,
-                np.concatenate([head, body.ravel()]), dst=dst)
+            with obs.corr_scope(corr):
+                self.transport.send(
+                    MessageCode.ActivationShip,
+                    np.concatenate([head, body.ravel()]), dst=dst)
         except (OSError, ConnectionError, KeyError):
             self.stats["send_failed"] += 1
 
@@ -1202,7 +1329,20 @@ class MpmdDriver:
                 loss = ce / float(n_mask * self.M)
                 self.losses.append(loss)
                 self.step_times.append(time.monotonic())
+                if self.recorder is not None:
+                    self.recorder.event("step-complete", corr=0,
+                                        step=next_step,
+                                        loss=round(float(loss), 6))
                 if step_hook is not None:
                     step_hook(next_step, loss)
                 next_step += 1
+                floor = next_step - self.corr_retain_steps
+                if floor > 0:
+                    self._mb_corr = {k: v for k, v in self._mb_corr.items()
+                                     if k[0] >= floor}
+        if self.recorder is not None and self.obs_dir:
+            emit = getattr(self.transport, "emit_wire_stats", None)
+            if emit is not None:
+                emit()
+            obs.flight_dump(self.recorder, self.obs_dir, "stop")
         return self.losses

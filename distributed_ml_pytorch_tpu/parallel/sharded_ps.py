@@ -170,7 +170,13 @@ class ShardedAsynchronous:
         self._owned: set = set()  # server ids whose transports WE created
         self.idx = 0
         self._last_step_t: Optional[float] = None
-        self._ewma_ms = 0.0  # inter-step latency EWMA fed to the coordinator
+        from distributed_ml_pytorch_tpu.utils.metrics import Ewma
+
+        #: inter-step latency EWMA fed to the coordinator — the shared
+        #: implementation (``utils/metrics.Ewma``, ISSUE 12: decay
+        #: constants live in one place; update rule bit-identical to the
+        #: old hand-rolled 0.7/0.3 idiom, LeaseRenew floats unchanged)
+        self._ewma = Ewma()
         # --- numerical health telemetry (ISSUE 8) -----------------------
         #: admission nacks received across all shards (rides LeaseRenew —
         #: the coordinator's reputation input)
@@ -178,8 +184,8 @@ class ShardedAsynchronous:
         #: nonfinite losses observed (observe_loss) — the hard rollback
         #: signal; loss/grad-norm EWMAs ride the renewals too
         self._bad_loss = 0
-        self._loss_ewma = 0.0
-        self._gnorm_ewma = 0.0  # written by the flusher thread (GIL-atomic)
+        self._loss_ewma = Ewma()
+        self._gnorm_ewma = Ewma()  # updated by the flusher thread (GIL-atomic)
         #: rollback-barrier mailbox: set by the coord listener on a phase-0
         #: RollbackRequest, consumed at the next step boundary (drop the
         #: in-flight accumulator, pull fresh params)
@@ -280,8 +286,7 @@ class ShardedAsynchronous:
         # coordinator's numerical-health telemetry
         norm = float(np.linalg.norm(arr.astype(np.float64, copy=False)))
         if np.isfinite(norm):
-            self._gnorm_ewma = (norm if self._gnorm_ewma == 0.0
-                                else 0.7 * self._gnorm_ewma + 0.3 * norm)
+            self._gnorm_ewma.update(norm)
         if self.coord is not None:
             from distributed_ml_pytorch_tpu.utils.messaging import _split16
 
@@ -407,8 +412,7 @@ class ShardedAsynchronous:
         if not np.isfinite(loss):
             self._bad_loss += 1
             return
-        self._loss_ewma = (float(loss) if self._loss_ewma == 0.0
-                           else 0.7 * self._loss_ewma + 0.3 * float(loss))
+        self._loss_ewma.update(loss)
 
     def _note_rollback(self, rollback_id: int, phase: int) -> None:
         """Coord-listener callback: park a phase-0 rollback barrier for the
@@ -458,7 +462,7 @@ class ShardedAsynchronous:
         self._fresh_installed = set()
         # the loss telemetry anchored the OLD (diverged) regime; reset so
         # post-restore renewals describe the restored one
-        self._loss_ewma = 0.0
+        self._loss_ewma.reset()
         print(
             "worker: fleet ROLLBACK barrier — dropped the in-flight "
             "accumulator, pulling restored params from every shard",
@@ -558,9 +562,7 @@ class ShardedAsynchronous:
 
             now = _time.monotonic()
             if self._last_step_t is not None:
-                dt_ms = (now - self._last_step_t) * 1e3
-                self._ewma_ms = (dt_ms if self._ewma_ms == 0.0
-                                 else 0.7 * self._ewma_ms + 0.3 * dt_ms)
+                self._ewma.update((now - self._last_step_t) * 1e3)
             self._last_step_t = now
             # wire health rides the lease renewal (ISSUE 7): how many of
             # this worker's shard links have an open circuit breaker — the
@@ -571,10 +573,10 @@ class ShardedAsynchronous:
                 if counter is not None:
                     wire_open += counter()
             self.coord.report(self.idx // self.n_push, self.idx,
-                              self._ewma_ms, wire_open=wire_open,
+                              self._ewma.value, wire_open=wire_open,
                               nacks=self.nacks, bad_loss=self._bad_loss,
-                              loss_ewma=self._loss_ewma,
-                              gnorm_ewma=self._gnorm_ewma)
+                              loss_ewma=self._loss_ewma.value,
+                              gnorm_ewma=self._gnorm_ewma.value)
         self._maybe_rollback()
         self._resync_on_nacks()
         self._maybe_cutover(params)

@@ -113,6 +113,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "capped at --brownout-max-new (degrade before "
                         "shedding; 0 = off)")
     p.add_argument("--brownout-max-new", type=int, default=0)
+    p.add_argument("--metrics-dump", type=str, default="", metavar="PATH",
+                   help="write the metrics-registry snapshot JSON "
+                        "(utils/metrics.get_registry, ISSUE 12) at exit — "
+                        "engine SLO summary, transport counters; '-' "
+                        "prints to stdout")
     p.add_argument("--seed", type=int, default=0)
     return p
 
@@ -170,7 +175,13 @@ def _make_engine(lm, params, args):
 
 def _build_engine(args, parser):
     lm, params = _build_model(args, parser)
-    return _make_engine(lm, params, args)
+    engine = _make_engine(lm, params, args)
+    # observability (ISSUE 12): the engine's SLO summary rides the
+    # process registry, so --metrics-dump sees serving health for free
+    from distributed_ml_pytorch_tpu.utils.metrics import get_registry
+
+    get_registry().attach("engine", engine.slo_summary)
+    return engine
 
 
 def _build_fleet(args, parser, coord_factory=None):
@@ -374,6 +385,17 @@ def _main_fleet(args, parser) -> int:
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+    try:
+        return _main(args, parser)
+    finally:
+        # observability plane (ISSUE 12): one registry snapshot at exit
+        if getattr(args, "metrics_dump", ""):
+            from distributed_ml_pytorch_tpu.coord.cli import dump_metrics
+
+            dump_metrics(args.metrics_dump)
+
+
+def _main(args, parser) -> int:
     print(args)
     if args.fleet:
         return _main_fleet(args, parser)

@@ -78,6 +78,10 @@ class Request:
     max_new_tokens: int
     sampling: SamplingParams
     eos_token: Optional[int] = None
+    #: flight-recorder correlation id (ISSUE 12): minted at submit, so the
+    #: queue -> prefill -> decode -> done journey is one timeline — and a
+    #: MIGRATED request's resubmission keeps the original id across engines
+    corr: int = 0
     #: sampling-key schedule offset: this request's token ``g`` is drawn
     #: with ``fold_in(key(seed), gen_offset + g)`` — nonzero only for a
     #: RESUMED request (fleet migration re-prefills prompt + generated-so-
@@ -130,13 +134,18 @@ class ServingEngine:
                  cache_size: int = 256, decode_block: int = DECODE_BLOCK,
                  kv_quant: bool = False, max_queue: int = 64,
                  prefill_bucket: int = 16,
-                 on_tokens: Optional[Callable] = None):
+                 on_tokens: Optional[Callable] = None,
+                 recorder=None):
         self.pool = SlotKVPool(
             model, params, slots=slots, cache_size=cache_size,
             decode_block=decode_block, kv_quant=kv_quant)
         self.max_queue = int(max_queue)
         self.prefill_bucket = max(1, int(prefill_bucket))
         self.on_tokens = on_tokens
+        #: optional flight recorder (``utils/obs.SpanRecorder``, ISSUE 12):
+        #: queue/prefill/decode spans per request correlation id — the
+        #: serving plane's side of the fleet timeline. Observational only.
+        self.recorder = recorder
         self._lock = threading.Lock()
         self._queue: Deque[Request] = collections.deque()
         self._ids = itertools.count()
@@ -194,12 +203,18 @@ class ServingEngine:
                 f"-> bucket {bucket}, {max_new_tokens} new tokens in "
                 f"{self.pool.decode_block}-token blocks) but slots hold "
                 f"{self.pool.cache_size}")
+        from distributed_ml_pytorch_tpu.utils import obs
+
         req = Request(
             request_id=(request_id if request_id is not None
                         else next(self._ids)),
             prompt=prompt, max_new_tokens=int(max_new_tokens),
             sampling=SamplingParams(temperature, top_k, top_p, seed),
             eos_token=eos_token, gen_offset=max(0, int(gen_offset)),
+            # adopt the submitting thread's active correlation id (a
+            # frontend relaying an enveloped SubmitRequest, or a migration
+            # resubmit) — mint a fresh one only at a true origin
+            corr=obs.current_corr() or obs.next_corr(),
             t_submit=time.perf_counter())
         with self._lock:
             # cancelled entries (e.g. overload-shed work awaiting its
@@ -208,9 +223,15 @@ class ServingEngine:
             if sum(1 for r in self._queue
                    if not r.cancelled) >= self.max_queue:
                 self._rejected += 1
+                if self.recorder is not None:
+                    self.recorder.event("queue-reject", corr=req.corr,
+                                        id=req.request_id)
                 raise QueueFullError(
                     f"queue at max_queue={self.max_queue}; retry later")
             self._queue.append(req)
+        if self.recorder is not None:
+            self.recorder.event("submit", corr=req.corr, id=req.request_id,
+                                prompt_len=int(prompt.size))
         return req
 
     def _bucket_len(self, prompt_len: int) -> int:
@@ -305,10 +326,19 @@ class ServingEngine:
                 r is not None for r in self._slot_req)
             req.slot = slot  # with it, "slot is None" == waiting, exactly
             self._slot_req[slot] = req
+            rec = self.recorder
+            t0 = time.monotonic_ns() if rec is not None else 0
             tok0 = self.pool.admit(
                 slot, padded, p, seed=sp.seed, temperature=sp.temperature,
                 top_k=sp.top_k, top_p=sp.top_p, gen_offset=req.gen_offset)
             req.t_admit = time.perf_counter()
+            if rec is not None:
+                # queue wait ended here; the prefill span carries the
+                # request's correlation id through slot admission
+                rec.record("prefill", "prefill", t0, time.monotonic_ns(),
+                           corr=req.corr,
+                           meta={"id": req.request_id, "slot": slot,
+                                 "bucket": bucket})
             self._tok[slot] = tok0
             # the per-slot sampling clock continues the request's OWN
             # schedule: a resumed request's next draw is fold_in(key,
@@ -328,11 +358,16 @@ class ServingEngine:
         return admitted
 
     def _decode(self, active: np.ndarray) -> None:
+        rec = self.recorder
+        t0 = time.monotonic_ns() if rec is not None else 0
         self._block_timer.start()
         toks = self.pool.decode_block_step(
             self._tok, self._n_gen, self._seeds, self._temps,
             self._top_ks, self._top_ps, active)  # [S, T] host array (syncs)
         self._block_timer.tick()
+        if rec is not None:
+            rec.record("decode-block", "decode", t0, time.monotonic_ns(),
+                       corr=0, meta={"active": int(active.sum())})
         T = toks.shape[1]
         for slot, req in enumerate(self._slot_req):
             if req is None:

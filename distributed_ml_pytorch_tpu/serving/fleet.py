@@ -193,6 +193,10 @@ class FleetRouter(ServingFrontend):
         self._affinity: Dict[Tuple[int, int], int] = {}
         self.migrations = 0          # streams moved across an engine death
         self.migration_failures = 0  # a healthy survivor refused the stream
+        #: optional flight recorder (``utils/obs.SpanRecorder``, ISSUE 12):
+        #: migration windows land on the serving timeline alongside the
+        #: engines' queue/prefill/decode spans. Observational only.
+        self.recorder = None
         self.parked = 0              # submits parked awaiting ANY engine
         self._mttr: List[float] = []  # per-death seconds: detect -> resumed
         for m in members:
@@ -357,6 +361,11 @@ class FleetRouter(ServingFrontend):
             elif route.engine_id != ORPHANED_ENGINE:
                 resumed += 1
                 self._note_resumed(route)
+        if self.recorder is not None and moving:
+            self.recorder.event(
+                "migrate", corr=0, dead_engine=dead_id,
+                moved=len(moving), resumed=resumed,
+                window_ms=round((time.monotonic() - now) * 1e3, 3))
         if resumed:
             print(f"fleet: migrated {resumed}/{len(moving)} stream(s) off "
                   f"engine {dead_id} in "

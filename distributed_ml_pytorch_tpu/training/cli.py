@@ -154,6 +154,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="global step at which the trace window opens")
     p.add_argument("--profile-steps", type=int, default=10, metavar="N",
                    help="number of steps the trace window covers")
+    p.add_argument("--metrics-dump", type=str, default="", metavar="PATH",
+                   help="write the metrics-registry snapshot JSON "
+                        "(utils/metrics.get_registry, ISSUE 12) at exit — "
+                        "reliable-transport counters, component stats; "
+                        "'-' prints to stdout")
     p.add_argument("--rejoin", action="store_true", default=False,
                    help="PS-mode worker restart: reconnect to a running "
                         "server and ADOPT its central params instead of "
@@ -237,6 +242,19 @@ def _apply_backend(args) -> None:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    try:
+        return _main(args)
+    finally:
+        # observability plane (ISSUE 12): whatever the run registered or
+        # attached (reliable-transport counters via make_transport, any
+        # component providers) is dumped in one JSON snapshot
+        if getattr(args, "metrics_dump", ""):
+            from distributed_ml_pytorch_tpu.coord.cli import dump_metrics
+
+            dump_metrics(args.metrics_dump)
+
+
+def _main(args) -> int:
     print(args)
     _apply_backend(args)
 

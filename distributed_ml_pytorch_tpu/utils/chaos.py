@@ -492,11 +492,11 @@ class FaultyTransport(Transport):
         )
 
         arr = np.asarray(payload, np.float32).ravel()
-        enveloped = (code == MessageCode.ReliableFrame and arr.size >= 8
-                     and bool(np.isfinite(arr[:7]).all()))
+        enveloped = (code == MessageCode.ReliableFrame and arr.size >= 10
+                     and bool(np.isfinite(arr[:9]).all()))
         if enveloped:
             inner = int(arr[6])
-            body_off = 7
+            body_off = 9  # 9-field envelope incl. the corr id (ISSUE 12)
             # the envelope seq IS the frame identity: retransmits re-derive
             # the same decision/draws instead of rolling fresh ones
             index = _join16(arr[2], arr[3])
@@ -534,7 +534,8 @@ class FaultyTransport(Transport):
             # the frame must arrive CRC-clean — bit-perfect on the wire,
             # numerically poisonous (only the admission gate can see it)
             inc = _join16(out[0], out[1])
-            crc = _frame_crc(inc, index, inner, out[7:])
+            corr = _join16(out[7], out[8])
+            crc = _frame_crc(inc, index, inner, out[9:], corr)
             out[4], out[5] = _split16(crc)
         log_key = (self.rank, dst, inner, index)
         with self._lock:

@@ -36,11 +36,12 @@ an explicit ``UpdateNack`` wire frame — a reject is never a silent drop.
 
 from __future__ import annotations
 
-import dataclasses
 import math
 from typing import Dict, Optional, Tuple
 
 import numpy as np
+
+from distributed_ml_pytorch_tpu.utils.metrics import EwmaMeanVar
 
 #: UpdateNack reason codes (wire values; float32-exact small ints)
 NACK_NONFINITE = 1
@@ -59,15 +60,6 @@ def clamp_finite32(x: float) -> float:
     plane), so every sender of norms/z-scores/EWMAs — the very quantities
     that go NaN/Inf when things break — clamps through here."""
     return float(np.nan_to_num(np.float32(min(x, 3e38))))
-
-
-@dataclasses.dataclass
-class _SenderStats:
-    """Per-sender EWMA of log1p(norm): mean, variance, admitted count."""
-
-    mean: float = 0.0
-    var: float = 0.0
-    count: int = 0
 
 
 class GradientAdmission:
@@ -89,7 +81,10 @@ class GradientAdmission:
         self.warmup = int(warmup)
         self.alpha = float(alpha)
         self.sigma_floor = float(sigma_floor)
-        self._stats: Dict[int, _SenderStats] = {}
+        #: per-sender winsorized EWMA mean/variance of log1p(norm) — the
+        #: shared implementation (``utils/metrics.EwmaMeanVar``, ISSUE 12:
+        #: decay + winsorization semantics live in one place)
+        self._stats: Dict[int, EwmaMeanVar] = {}
         self.admitted = 0
         self.rejected = 0
 
@@ -103,11 +98,13 @@ class GradientAdmission:
             self.rejected += 1
             return (NACK_NONFINITE, norm, 0.0)
         x = math.log1p(norm)
-        st = self._stats.setdefault(sender, _SenderStats())
+        st = self._stats.get(sender)
+        if st is None:  # not setdefault: no throwaway alloc per push
+            st = self._stats[sender] = EwmaMeanVar(alpha=self.alpha)
         z = 0.0
         clamp = None
         if st.count >= self.warmup:
-            sigma = max(math.sqrt(max(st.var, 0.0)), self.sigma_floor)
+            sigma = st.sigma(self.sigma_floor)
             z = (x - st.mean) / sigma
             if z > self.z_max:
                 self.rejected += 1
@@ -121,16 +118,7 @@ class GradientAdmission:
             # scores far outside the gate and is rejected
             clamp = 2.0 * sigma
         # admit: fold the (winsorized) sample into the running statistics
-        if st.count == 0:
-            st.mean = x
-            st.var = 0.0
-        else:
-            d = x - st.mean
-            if clamp is not None:
-                d = max(-clamp, min(clamp, d))
-            st.mean += self.alpha * d
-            st.var = (1.0 - self.alpha) * (st.var + self.alpha * d * d)
-        st.count += 1
+        st.update(x, winsor=clamp)
         self.admitted += 1
         return None
 

@@ -61,6 +61,8 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from distributed_ml_pytorch_tpu.utils import obs as _obs
+
 _LOGGER = logging.getLogger(__name__)
 
 _HEADER = struct.Struct("<iiq")
@@ -192,9 +194,14 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
         fields=("id",), handled_by=("serving",), doc="client -> engine"),
     MessageCode.ReliableFrame: PayloadSchema(
         fields=("inc_lo", "inc_hi", "seq_lo", "seq_hi", "crc_lo", "crc_hi",
-                "code"),
+                "code", "corr_lo", "corr_hi"),
         rest="payload", handled_by=("transport",),
-        doc="reliability envelope; CRC covers header + body"),
+        doc="reliability envelope; CRC covers header + body. corr (ISSUE "
+            "12) is the flight-recorder CORRELATION id riding the "
+            "envelope: the sender stamps its thread's active id "
+            "(utils/obs.current_corr, 0 = none), the receiver restores it "
+            "on delivery — one GradientUpdate / microbatch is followable "
+            "across members without touching any inner payload layout"),
     MessageCode.ReliableAck: PayloadSchema(
         fields=("seq_lo", "seq_hi", "inc_lo", "inc_hi"),
         handled_by=("transport",),
@@ -231,7 +238,11 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
                 "n_engines", "workers_done"),
         rest="engine_ranks", handled_by=("coord",),
         doc="compact fleet broadcast the serving frontend consumes; the "
-            "tail lists live engine coord-ranks (per-engine lease health)"),
+            "tail lists live engine coord-ranks (per-engine lease health) "
+            "and, behind a -1 separator (ranks are non-negative, so the "
+            "split is unambiguous; a tail without one decodes as "
+            "pre-ISSUE-12), the fleet_metrics registry summary in "
+            "coord/coordinator.FLEET_METRICS_FIELDS order"),
     MessageCode.SpeculateTask: PayloadSchema(
         fields=("task_id", "victim_rank", "from_step"),
         handled_by=("coord",),
@@ -756,11 +767,13 @@ _LAST_INC = 0
 _BULK_SUM_BYTES = 1 << 16
 
 
-def _frame_crc(inc: int, seq: int, code: int, body) -> int:
-    """Checksum over the WHOLE envelope (incarnation, seq, code, body): a
-    wire flip in any header field must fail the check, or e.g. a corrupted
-    incarnation would be adopted as a 'newer life' and blackhole every
-    subsequent legitimate frame as stale.
+def _frame_crc(inc: int, seq: int, code: int, body, corr: int = 0) -> int:
+    """Checksum over the WHOLE envelope (incarnation, seq, code,
+    correlation id, body): a wire flip in any header field must fail the
+    check, or e.g. a corrupted incarnation would be adopted as a 'newer
+    life' and blackhole every subsequent legitimate frame as stale (and a
+    flipped correlation id would stitch the flight-recorder timeline to
+    the wrong unit of work).
 
     ``body`` is any buffer — bytes, memoryview, or a contiguous float32
     array — and is NEVER copied (ISSUE 7: the old ``tobytes()`` cost ~9 ms
@@ -778,8 +791,8 @@ def _frame_crc(inc: int, seq: int, code: int, body) -> int:
     layer TCP's own checksum already screens the wire, so the residual
     risk is compensating application-level corruption — accepted for a
     ~4x cheaper hot path."""
-    head = struct.pack("<III", inc & 0xFFFFFFFF, seq & 0xFFFFFFFF,
-                       code & 0xFFFFFFFF)
+    head = struct.pack("<IIII", inc & 0xFFFFFFFF, seq & 0xFFFFFFFF,
+                       code & 0xFFFFFFFF, corr & 0xFFFFFFFF)
     h = zlib.crc32(head)
     if isinstance(body, np.ndarray):
         mv = memoryview(np.ascontiguousarray(body)).cast("B")
@@ -802,11 +815,13 @@ def _frame_crc(inc: int, seq: int, code: int, body) -> int:
     return zlib.crc32(mv, h) & 0xFFFFFFFF
 
 
-def _frame_crc_legacy(inc: int, seq: int, code: int, body) -> int:
+def _frame_crc_legacy(inc: int, seq: int, code: int, body,
+                      corr: int = 0) -> int:
     """The pre-ISSUE-7 envelope checksum — whole-payload crc32 over a
     ``tobytes()`` copy. Kept ONLY as the bench's honest BEFORE
     (``ReliableTransport(legacy_envelope=True)``); nothing on a default
-    code path uses it."""
+    code path uses it. ``corr`` is accepted for call-site uniformity but
+    NOT covered (the before never knew it)."""
     head = struct.pack("<III", inc & 0xFFFFFFFF, seq & 0xFFFFFFFF,
                        code & 0xFFFFFFFF)
     if isinstance(body, np.ndarray):
@@ -828,14 +843,16 @@ def _next_incarnation() -> int:
 
 class _Pending:
     __slots__ = ("parts", "dst", "deadline", "attempt", "code",
-                 "first_sent", "retransmitted")
+                 "first_sent", "retransmitted", "corr")
 
-    def __init__(self, parts, dst: int, deadline: float, code: int = -1):
+    def __init__(self, parts, dst: int, deadline: float, code: int = -1,
+                 corr: int = 0):
         self.parts = parts  # (header, body) — re-sent via sendv, zero-copy
         self.dst = dst
         self.deadline = deadline
         self.attempt = 1
         self.code = code  # inner MessageCode (per-code ack accounting)
+        self.corr = corr  # flight-recorder correlation id (ISSUE 12)
         self.first_sent = 0.0
         #: Karn's rule: an RTT sample is only taken from a frame that was
         #: never retransmitted (an ack for a retransmitted frame is
@@ -1006,7 +1023,12 @@ class ReliableTransport(Transport):
         self._next_seq: Dict[int, int] = {}
         self._pending: Dict[Tuple[int, int], _Pending] = {}
         self._peers: Dict[int, _PeerState] = {}
-        self._requeue: "collections.deque[Message]" = collections.deque()
+        #: frames surfaced while a blocked send()/flush() pumped the inner
+        #: transport, parked for the next recv(). Each entry carries the
+        #: correlation id its delivery installed, so popping RESTORES it —
+        #: without this, a later delivery's corr would leak onto a parked
+        #: frame's handler (the wrong-timeline stitch)
+        self._requeue: "collections.deque" = collections.deque()
         self._seen: Dict[int, "collections.OrderedDict"] = {}
         self._peer_inc: Dict[int, int] = {}
         self._rx: Dict[int, _RxState] = {}
@@ -1032,7 +1054,16 @@ class ReliableTransport(Transport):
             "cum_acked": 0, "acks_tx": 0, "cum_acks_tx": 0,
             "rto_expired": 0, "window_blocked": 0, "breaker_opens": 0,
             "probes": 0,
+            # observability plane (ISSUE 12): cumulative seconds sends
+            # spent BLOCKED at the credit window — serve loops carve this
+            # out of their compute attribution (utils/obs.StateClock)
+            "window_blocked_s": 0.0,
         }
+        #: optional flight recorder (``utils/obs.SpanRecorder``), attached
+        #: post-construction: wire-blocked spans, retransmit / breaker /
+        #: give-up events, ack releases — the wire plane's side of the
+        #: timeline. Never consulted for any protocol decision.
+        self.recorder = None
         self._retry_wake = threading.Event()
         self._retry_thread = threading.Thread(
             target=self._retry_loop, name="reliable-retry", daemon=True)
@@ -1101,6 +1132,10 @@ class ReliableTransport(Transport):
             self.inner.send(code, payload, dst=dst)
             return
         arr = np.ascontiguousarray(np.asarray(payload, dtype=np.float32).ravel())
+        # flight-recorder correlation (ISSUE 12): the sender's thread-local
+        # id rides the envelope so the receiver's handler inherits it; 0
+        # means "no active unit of work" and costs nothing downstream
+        corr = _obs.current_corr()
         # sliding window: block while the peer's in-flight frames fill
         # min(send_window, advertised credit) — the backpressure that keeps
         # a slow/jittery link from growing pending without bound. The
@@ -1109,6 +1144,7 @@ class ReliableTransport(Transport):
         # sender would deadlock at its own window; data frames that arrive
         # meanwhile are requeued for the next recv().
         blocked = False
+        block_t0 = 0
         while True:
             with self._lock:
                 st = self._peer(dst)
@@ -1130,17 +1166,29 @@ class ReliableTransport(Transport):
                     break
                 if not blocked:
                     blocked = True
+                    block_t0 = time.monotonic_ns()
                     self.stats["window_blocked"] += 1
             delivered = self._process(self.inner.recv(timeout=0.02))
             if delivered is not None:
-                self._requeue.append(delivered)
+                self._requeue.append((_obs.current_corr(), delivered))
+        if blocked:
+            # credit-blocked time is a first-class wait state: the serve
+            # loop carves it out of whatever state it was in, and the span
+            # itself lands on the wire plane's timeline
+            now_ns = time.monotonic_ns()
+            with self._lock:
+                self.stats["window_blocked_s"] += (now_ns - block_t0) / 1e9
+            rec = self.recorder
+            if rec is not None:
+                rec.record("wire-blocked", "wire-blocked", block_t0, now_ns,
+                           corr=corr, meta={"dst": dst})
         try:
             checksum = (_frame_crc_legacy if self.legacy_envelope
                         else _frame_crc)
-            crc = checksum(self.incarnation, seq, int(code), arr)
+            crc = checksum(self.incarnation, seq, int(code), arr, corr)
             header = np.asarray(
                 [*_split16(self.incarnation), *_split16(seq), *_split16(crc),
-                 float(int(code))], np.float32)
+                 float(int(code)), *_split16(corr)], np.float32)
             parts = ((np.concatenate([header, arr]),) if self.legacy_envelope
                      else (header, arr))
         except Exception:
@@ -1151,7 +1199,7 @@ class ReliableTransport(Transport):
         now = time.monotonic()
         with self._lock:
             st = self._peer(dst)
-            p = _Pending(parts, dst, now + st.rto, code=int(code))
+            p = _Pending(parts, dst, now + st.rto, code=int(code), corr=corr)
             p.first_sent = now
             self._pending[(dst, seq)] = p
             self.stats["sent"] += 1
@@ -1272,13 +1320,19 @@ class ReliableTransport(Transport):
                         self.breaker_cooldown * (2.0 ** (st.opens - 1)),
                         4.0 * self.max_backoff)
                     self.stats["breaker_opens"] += 1
+                    if self.recorder is not None:
+                        self.recorder.event("breaker-open", corr=0, dst=dst)
                     _LOGGER.warning(
                         "reliable: circuit to peer %d OPEN after %d "
                         "consecutive RTO blowups (rto %.0f ms) — pausing "
                         "retransmits, probe in %.2f s", dst,
                         st.consec_timeouts, st.rto * 1e3,
                         st.probe_at - now)
+        rec = self.recorder
         for p in resend:
+            if rec is not None:
+                rec.event("retransmit", corr=p.corr, dst=p.dst,
+                          attempt=p.attempt, code=p.code)
             try:
                 self.inner.sendv(MessageCode.ReliableFrame, p.parts,
                                  dst=p.dst)
@@ -1371,24 +1425,26 @@ class ReliableTransport(Transport):
             with self._lock:
                 self.stats["passthrough"] += 1
                 self._last_delivery = None  # no envelope to remember
+            _obs.set_corr(0)  # no envelope: never inherit a stale id
             return msg  # plain frame from an unwrapped peer
-        if payload.size < 7:
+        if payload.size < 9:
             return None  # truncated envelope: unacked → sender retries
         try:
             inc = _join16(payload[0], payload[1])
             seq = _join16(payload[2], payload[3])
             crc = _join16(payload[4], payload[5])
             inner_code = int(payload[6])
+            corr = _join16(payload[7], payload[8])
         except (ValueError, OverflowError):
             # corruption turned a header float non-finite: unparseable,
             # unacked → the sender's retry delivers a clean copy
             with self._lock:
                 self.stats["crc_dropped"] += 1
             return None
-        body = payload[7:]
+        body = payload[9:]
         checksum = (_frame_crc_legacy if self.legacy_envelope
                     else _frame_crc)
-        if checksum(inc, seq, inner_code, body) != crc:
+        if checksum(inc, seq, inner_code, body, corr) != crc:
             with self._lock:
                 self.stats["crc_dropped"] += 1
             return None  # corrupt: no ack, the retry delivers a clean copy
@@ -1430,6 +1486,10 @@ class ReliableTransport(Transport):
             with self._lock:
                 self._deferred_acks[key] = True
                 self._last_delivery = (inc, seq)
+            # the envelope's correlation id becomes the recv thread's
+            # active id: the handler about to run inherits the sender's
+            # unit of work (ISSUE 12)
+            _obs.set_corr(corr)
             return sender, mcode, body
         send_individual = False
         flush_now = False
@@ -1469,6 +1529,7 @@ class ReliableTransport(Transport):
         if deliver and not dup:
             with self._lock:
                 self._last_delivery = (inc, seq)
+            _obs.set_corr(corr)  # handler inherits the sender's unit of work
             return sender, mcode, body
         return None
 
@@ -1539,9 +1600,15 @@ class ReliableTransport(Transport):
         ack batching pipelined with the group fsync); out-of-order stragglers
         keep their individual acks."""
         individual = []
+        rec = self.recorder
         with self._lock:
             due = list(self._deferred_acks.keys())
             self._deferred_acks.clear()
+        if rec is not None and due:
+            # the durability commit just released these delivery acks —
+            # the "ack release" instant of the worker-push timeline
+            rec.event("ack-release", corr=0, n=len(due))
+        with self._lock:
             for sender, seq, inc in due:
                 rx = self._rx.get(sender)
                 if rx is None or rx.inc != inc or not self.batched_acks:
@@ -1654,11 +1721,27 @@ class ReliableTransport(Transport):
             st = self._peers.get(dst)
             return self.ack_timeout if st is None else st.rto
 
+    def emit_wire_stats(self) -> None:
+        """One summary event at teardown: the counters the timeline
+        analyzer turns into wire attribution (retransmit share, ack frames
+        per data frame, credit-block seconds) — cheap, once, instead of a
+        per-send hot-path event (ISSUE 12)."""
+        rec = self.recorder
+        if rec is None:
+            return
+        with self._lock:
+            stats = dict(self.stats)
+        rec.event("wire-stats", corr=0,
+                  **{k: (round(v, 6) if isinstance(v, float) else v)
+                     for k, v in stats.items()})
+
     def detach(self) -> None:
         """Stop this wrapper (retry thread exits, ``recv`` returns None)
         WITHOUT closing the inner transport — for handing the endpoint to a
         replacement wrapper (the server-restart path in ``coord/drill.py``;
         a real restart replaces the process, here only the wrapper dies)."""
+        if not self._closed:
+            self.emit_wire_stats()
         self._closed = True
         self._retry_wake.set()
 
@@ -1668,7 +1751,11 @@ class ReliableTransport(Transport):
             if self._closed:
                 return None
             try:
-                return self._requeue.popleft()  # frames surfaced by flush()
+                # frames surfaced by a blocked send()/flush(): re-install
+                # the correlation id their delivery recorded
+                corr, parked = self._requeue.popleft()
+                _obs.set_corr(corr)
+                return parked
             except IndexError:
                 pass
             slice_t = 0.1
@@ -1698,12 +1785,13 @@ class ReliableTransport(Transport):
                 return True
             delivered = self._process(self.inner.recv(timeout=0.02))
             if delivered is not None:
-                self._requeue.append(delivered)
+                self._requeue.append((_obs.current_corr(), delivered))
         return False
 
     def close(self) -> None:
         if not self._closed:
             self.flush(timeout=min(2.0, self.max_backoff))
+            self.emit_wire_stats()
         self._closed = True
         self._retry_wake.set()
         self.inner.close()
@@ -1760,8 +1848,17 @@ def make_transport(
     if t is None:
         t = TCPTransport(rank, world_size, master, int(port), connect_timeout)
     if reliable:
-        return ReliableTransport(t, ack_on_delivery=not durable_acks,
-                                 **(reliable_opts or {}))
+        rt = ReliableTransport(t, ack_on_delivery=not durable_acks,
+                               **(reliable_opts or {}))
+        # CLI-process observability (ISSUE 12): the wrapper's counters are
+        # visible in `--metrics-dump` snapshots without any caller wiring
+        # (attach replaces any previous same-rank provider, so restarts
+        # re-point it at the live instance)
+        from distributed_ml_pytorch_tpu.utils.metrics import get_registry
+
+        get_registry().attach(f"wire.rank{rank}",
+                              lambda rt=rt: dict(rt.stats))
+        return rt
     return t
 
 

@@ -218,7 +218,8 @@ def test_reliable_transport_no_double_apply_across_lives():
                      arr.tobytes())
     stale = _np.concatenate([
         _np.asarray([*_split16(old_life.incarnation), *_split16(0),
-                     *_split16(crc), float(int(MessageCode.GradientUpdate))],
+                     *_split16(crc), float(int(MessageCode.GradientUpdate)),
+                     *_split16(0)],  # corr id (ISSUE 12): none
                     _np.float32), arr])
     boxes[1].send(MessageCode.ReliableFrame, stale, dst=0)
     assert server.recv(timeout=0.5) is None  # acked-dropped, NOT delivered

@@ -140,7 +140,8 @@ def test_reliable_round_trip_and_dedup():
             np.concatenate([
                 np.asarray([*_split16(b.incarnation), *_split16(0),
                             *_split16(crc),
-                            float(int(MessageCode.GradientUpdate))],
+                            float(int(MessageCode.GradientUpdate)),
+                            *_split16(0)],  # corr id (ISSUE 12): none
                            np.float32),
                 body]))
         assert a.recv(timeout=0.3) is None  # dropped as duplicate

@@ -1,0 +1,583 @@
+"""Flight recorder / metrics registry / bounded decision log (ISSUE 12).
+
+Covers the observability plane's contracts:
+
+- SpanRecorder ring bounds + drop accounting, exporters (JSONL + Chrome
+  trace), correlation-id thread-local plumbing;
+- the correlation id RIDING the reliability envelope (sender stamps,
+  receiver's handler thread inherits it) and surviving the CRC;
+- StateClock exclusive-state attribution summing to the wall clock;
+- BoundedEvents: the coordinator's decision log as a capped ring whose
+  ``[-20:]`` rendering and iteration are unchanged;
+- Registry: owned metrics + attached providers in one snapshot;
+- EWMA migration safety: the shared Ewma/EwmaMeanVar are BIT-identical to
+  the hand-rolled idioms they replaced (LeaseRenew float layout pinned);
+- the chaos-determinism guard: enabling a recorder cannot perturb a
+  chaos log by one byte.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.utils import obs
+from distributed_ml_pytorch_tpu.utils.metrics import (
+    Counter,
+    Ewma,
+    EwmaMeanVar,
+    Registry,
+)
+
+
+# ------------------------------------------------------------ SpanRecorder
+
+def test_recorder_ring_bounds_and_drop_accounting():
+    rec = obs.SpanRecorder("m", "mpmd", capacity=8)
+    for i in range(20):
+        rec.event(f"e{i}")
+    assert rec.total == 20
+    assert len(rec.snapshot()) == 8
+    assert rec.dropped == 12
+    # the ring keeps the NEWEST window (the one that explains a crash)
+    assert rec.snapshot()[-1]["name"] == "e19"
+    rec.clear()
+    assert rec.total == 0 and rec.snapshot() == []
+
+
+def test_disabled_recorder_records_nothing():
+    rec = obs.SpanRecorder("m", "mpmd", enabled=False)
+    rec.event("e")
+    with rec.span("s"):
+        pass
+    assert rec.total == 0 and rec.snapshot() == []
+
+
+def test_span_context_times_and_survives_raise():
+    rec = obs.SpanRecorder("m", "mpmd")
+    with pytest.raises(RuntimeError):
+        with rec.span("boom", state="compute"):
+            raise RuntimeError("x")
+    (s,) = rec.snapshot()
+    assert s["name"] == "boom" and s["state"] == "compute"
+    assert s["t1_ns"] >= s["t0_ns"]
+
+
+def test_corr_thread_local_and_scope_nesting():
+    obs.set_corr(0)
+    assert obs.current_corr() == 0
+    with obs.corr_scope(111):
+        assert obs.current_corr() == 111
+        with obs.corr_scope(222):
+            assert obs.current_corr() == 222
+        assert obs.current_corr() == 111
+    assert obs.current_corr() == 0
+    # ids are per-thread: another thread sees its own (empty) slot
+    seen = {}
+
+    def other():
+        seen["corr"] = obs.current_corr()
+
+    with obs.corr_scope(333):
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+    assert seen["corr"] == 0
+
+
+def test_recorder_adopts_thread_corr_and_explicit_overrides():
+    rec = obs.SpanRecorder("m", "mpmd")
+    with obs.corr_scope(42):
+        rec.event("implicit")
+        rec.event("explicit", corr=7)
+    rows = rec.snapshot()
+    assert rows[0]["corr"] == 42 and rows[1]["corr"] == 7
+
+
+def test_exports_jsonl_and_chrome_trace(tmp_path):
+    rec = obs.SpanRecorder("stage1", "mpmd")
+    with rec.span("fwd", state="compute", corr=5):
+        pass
+    rec.event("mark", corr=5, step=3)
+    p = rec.dump_jsonl(str(tmp_path / "d.jsonl"))
+    lines = [json.loads(x) for x in open(p).read().splitlines()]
+    assert lines[0]["kind"] == "meta" and lines[0]["member"] == "stage1"
+    assert lines[1]["name"] == "fwd" and lines[1]["corr"] == 5
+    ct = rec.chrome_trace(str(tmp_path / "t.json"))
+    trace = json.load(open(ct))
+    phases = {e["name"]: e["ph"] for e in trace["traceEvents"]}
+    assert phases["fwd"] == "X" and phases["mark"] == "i"
+
+
+def test_flight_dump_sanitizes_and_writes(tmp_path):
+    rec = obs.SpanRecorder("stage/1 bad", "mpmd")
+    rec.event("e")
+    paths = obs.flight_dump(rec, str(tmp_path), "why: because!")
+    assert len(paths) == 1
+    import os
+
+    name = os.path.basename(paths[0])
+    assert name.startswith("flight_") and "/" not in name and " " not in name
+    assert json.loads(open(paths[0]).readline())["reason"] == "why: because!"
+    # None recorders are skipped, not an error
+    assert obs.flight_dump(None, str(tmp_path), "x") == []
+
+
+# -------------------------------------------------------------- StateClock
+
+def test_state_clock_attribution_sums_to_wall():
+    rec = obs.SpanRecorder("m", "mpmd")
+    clk = obs.StateClock(rec, "idle", min_span_us=0)
+    t0 = time.monotonic_ns()
+    clk.set("compute")
+    time.sleep(0.02)
+    clk.set("wait-act")
+    time.sleep(0.01)
+    seconds = clk.flush()
+    wall = (time.monotonic_ns() - t0) / 1e9
+    assert set(seconds) <= {"idle", "compute", "wait-act"}
+    assert seconds["compute"] >= 0.015
+    # exclusive states: the total equals the wall clock (within timer slop)
+    assert abs(sum(seconds.values())
+               - (wall + seconds.get("idle", 0.0))) < 0.05
+    attr = [e for e in rec.snapshot() if e["name"] == "attribution"]
+    assert attr and attr[-1]["meta"]["wall_s"] > 0
+
+
+def test_state_clock_carve_moves_seconds():
+    clk = obs.StateClock(None, "compute", min_span_us=0)
+    time.sleep(0.01)
+    clk.carve("wire-blocked", 0.004)
+    seconds = clk.flush()
+    assert seconds["wire-blocked"] == pytest.approx(0.004)
+    # the carved time came OUT of the open stretch: no double counting
+    assert seconds["compute"] >= 0.005
+    assert sum(seconds.values()) < 0.2
+
+
+# ----------------------------------------------------------- BoundedEvents
+
+def test_bounded_events_caps_and_keeps_rendering():
+    ev = obs.BoundedEvents(maxlen=16)
+    for i in range(100):
+        ev.append(f"decision {i}")
+    assert ev.total == 100 and len(ev) == 16 and ev.dropped == 84
+    # the CLI's last-20 rendering works unchanged on the retained window
+    tail = ev[-20:]
+    assert tail[-1] == "decision 99" and len(tail) == 16
+    assert any("decision 99" in e for e in ev)
+    assert ev[0] == "decision 84"
+    assert bool(ev)
+    assert "total=100" in repr(ev)
+
+
+def test_coordinator_decision_log_is_bounded():
+    from distributed_ml_pytorch_tpu.coord.coordinator import Coordinator
+
+    c = Coordinator(None, 16, lease=10.0)
+    for i in range(5000):
+        c._log(f"event {i}")
+    assert c.events.total == 5000
+    assert len(c.events) == c.events.maxlen
+    assert list(c.events)[-1] == "event 4999"
+
+
+def test_coordinator_log_promotes_to_recorder():
+    from distributed_ml_pytorch_tpu.coord.coordinator import Coordinator
+
+    c = Coordinator(None, 16, lease=10.0)
+    c.recorder = obs.SpanRecorder("coord", "coord")
+    c._log("hello plane")
+    rows = [e for e in c.recorder.snapshot() if e["name"] == "coord"]
+    assert rows and rows[-1]["meta"]["msg"] == "hello plane"
+
+
+# ---------------------------------------------------------------- Registry
+
+def test_registry_owned_metrics_and_kind_clash():
+    r = Registry("t")
+    r.counter("pushes").inc(3)
+    r.gauge("occupancy").set(0.5)
+    r.ewma("lat_ms").update(10.0)
+    with pytest.raises(ValueError):
+        r.gauge("pushes")
+    snap = r.snapshot()
+    assert snap["pushes"] == 3 and snap["occupancy"] == 0.5
+    assert snap["lat_ms"] == 10.0
+
+
+def test_registry_attach_providers_and_failure_isolation(tmp_path):
+    r = Registry("t")
+    stats = {"sent": 7, "acked": 6}
+    r.attach("wire", lambda: stats)
+    r.attach("bad", lambda: 1 / 0)
+    snap = r.snapshot()
+    assert snap["wire.sent"] == 7 and snap["wire.acked"] == 6
+    assert "division" in snap["bad.error"]
+    path = tmp_path / "m.json"
+    text = r.dump_json(str(path))
+    assert json.loads(path.read_text()) == json.loads(text)
+    r.detach("bad")
+    assert "bad.error" not in r.snapshot()
+
+
+def test_counter_and_gauge_primitives():
+    c = Counter()
+    assert c.inc() == 1 and c.inc(4) == 5
+    e = Ewma(alpha=0.5)
+    assert e.update(2.0) == 2.0  # first sample seeds
+    assert e.update(4.0) == pytest.approx(3.0)
+    e.reset()
+    assert e.value == 0.0
+
+
+# --------------------------------------------- EWMA migration bit-identity
+
+def test_ewma_bit_identical_to_hand_rolled_idiom():
+    """The migrated sites computed ``x if e == 0.0 else 0.7*e + 0.3*x``.
+    The shared Ewma must reproduce those floats EXACTLY (1.0 - 0.3 == 0.7
+    in IEEE double), or telemetry wire frames would drift."""
+    rng = np.random.default_rng(0)
+    xs = rng.uniform(0.1, 50.0, size=200)
+    hand = 0.0
+    e = Ewma()  # TELEMETRY_ALPHA
+    for x in xs:
+        x = float(x)
+        hand = x if hand == 0.0 else 0.7 * hand + 0.3 * x
+        e.update(x)
+        assert e.value == hand  # exact, not approx
+
+
+def test_lease_renew_floats_byte_unchanged_after_migration():
+    """Regression for the ISSUE 12 telemetry-drift satellite: a LeaseRenew
+    frame built from the shared-Ewma values is byte-identical to one built
+    from the legacy hand-rolled chain."""
+    from distributed_ml_pytorch_tpu.coord.coordinator import encode_renew
+
+    rng = np.random.default_rng(7)
+    steps = rng.uniform(1.0, 30.0, size=64)
+    losses = rng.uniform(0.01, 4.0, size=64)
+    hand_ms, hand_loss = 0.0, 0.0
+    ew_ms, ew_loss = Ewma(), Ewma()
+    for dt, loss in zip(steps, losses):
+        dt, loss = float(dt), float(loss)
+        hand_ms = dt if hand_ms == 0.0 else 0.7 * hand_ms + 0.3 * dt
+        hand_loss = loss if hand_loss == 0.0 else 0.7 * hand_loss + 0.3 * loss
+        ew_ms.update(dt)
+        ew_loss.update(loss)
+    old = encode_renew(123, 4, 5, hand_ms, 1, 2, 0, hand_loss, 6.0)
+    new = encode_renew(123, 4, 5, ew_ms.value, 1, 2, 0, ew_loss.value, 6.0)
+    assert old.tobytes() == new.tobytes()
+
+
+def test_ewma_mean_var_matches_legacy_admission_stats():
+    """utils/health's _SenderStats math, now in EwmaMeanVar: mean/var
+    updates with the 2-sigma winsor clamp are bit-identical."""
+    rng = np.random.default_rng(3)
+    xs = [float(x) for x in rng.uniform(0.0, 10.0, size=100)]
+    mean, var, count = 0.0, 0.0, 0
+    st = EwmaMeanVar(alpha=0.2)
+    for x in xs:
+        clamp = None
+        if count >= 8:
+            import math
+
+            sigma = max(math.sqrt(max(var, 0.0)), 0.5)
+            clamp = 2.0 * sigma
+            assert st.sigma(0.5) == sigma
+        # legacy inline update
+        if count == 0:
+            mean, var = x, 0.0
+        else:
+            d = x - mean
+            if clamp is not None:
+                d = max(-clamp, min(clamp, d))
+            mean += 0.2 * d
+            var = (1.0 - 0.2) * (var + 0.2 * d * d)
+        count += 1
+        st.update(x, winsor=clamp)
+        assert (st.mean, st.var, st.count) == (mean, var, count)
+
+
+def test_admission_gate_snapshot_shape_survived_migration():
+    from distributed_ml_pytorch_tpu.utils.health import GradientAdmission
+
+    gate = GradientAdmission(warmup=2)
+    for _ in range(4):
+        assert gate.evaluate(1, np.ones(8, np.float32)) is None
+    snap = gate.snapshot()
+    mean, var, count = snap[1]
+    assert count == 4 and var == pytest.approx(0.0) and mean > 0
+
+
+# ------------------------------------------- corr id rides the envelope
+
+def _pair(reliable_opts=None):
+    from distributed_ml_pytorch_tpu.utils.messaging import make_world
+
+    world, _ = make_world(2, reliable=True,
+                          reliable_opts=reliable_opts or {})
+    return world[0], world[1]
+
+
+def test_corr_id_rides_reliability_envelope():
+    from distributed_ml_pytorch_tpu.utils.messaging import MessageCode
+
+    a, b = _pair()
+    try:
+        with obs.corr_scope(31337):
+            b.send(MessageCode.GradientUpdate,
+                   np.arange(4, dtype=np.float32))
+        obs.set_corr(0)
+        msg = a.recv(timeout=5)
+        assert msg is not None and msg[1] == MessageCode.GradientUpdate
+        # delivery restored the sender's correlation id on THIS thread
+        assert obs.current_corr() == 31337
+    finally:
+        obs.set_corr(0)
+        a.close()
+        b.close()
+
+
+def test_corr_id_survives_crc_and_is_covered_by_it():
+    """The CRC covers the corr halves: flipping one drops the frame."""
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        MessageCode,
+        _frame_crc,
+        _split16,
+    )
+
+    a, b = _pair()
+    try:
+        body = np.arange(4, dtype=np.float32)
+        crc = _frame_crc(b.incarnation, 0, int(MessageCode.GradientUpdate),
+                         body.tobytes(), 99)
+        frame = np.concatenate([
+            np.asarray([*_split16(b.incarnation), *_split16(0),
+                        *_split16(crc),
+                        float(int(MessageCode.GradientUpdate)),
+                        *_split16(98)],  # corr flipped vs the CRC
+                       np.float32), body])
+        b.inner.send(MessageCode.ReliableFrame, frame, dst=0)
+        assert a.recv(timeout=0.3) is None
+        assert a.stats["crc_dropped"] == 1
+        # the honest frame (corr matching its crc) delivers
+        frame[7:9] = np.asarray(_split16(99), np.float32)
+        b.inner.send(MessageCode.ReliableFrame, frame, dst=0)
+        msg = a.recv(timeout=5)
+        assert msg is not None and obs.current_corr() == 99
+    finally:
+        obs.set_corr(0)
+        a.close()
+        b.close()
+
+
+def test_requeued_frames_keep_their_corr_id():
+    """Review regression: frames surfaced while flush()/a blocked send
+    pumped the transport are parked for the next recv — popping one must
+    restore ITS delivery's correlation id, not whatever a later delivery
+    left on the thread-local."""
+    from distributed_ml_pytorch_tpu.utils.messaging import MessageCode
+
+    a, b = _pair()
+    try:
+        for corr in (101, 202):
+            with obs.corr_scope(corr):
+                b.send(MessageCode.GradientUpdate,
+                       np.full(4, float(corr), np.float32))
+        # a's flush() pumps its inner transport: both inbound frames get
+        # delivered during the pump and parked in the requeue, each
+        # delivery overwriting the thread-local corr
+        assert a.flush(timeout=5)
+        obs.set_corr(0)
+        first = a.recv(timeout=1)
+        assert first is not None and obs.current_corr() == int(first[2][0])
+        second = a.recv(timeout=1)
+        assert second is not None and obs.current_corr() == int(second[2][0])
+        assert {int(first[2][0]), int(second[2][0])} == {101, 202}
+    finally:
+        obs.set_corr(0)
+        a.close()
+        b.close()
+
+
+def test_registry_ewma_alpha_is_honored_and_clash_raises():
+    from distributed_ml_pytorch_tpu.utils.metrics import Registry
+
+    r = Registry("t")
+    e = r.ewma("x", alpha=0.5)
+    assert e.alpha == 0.5
+    assert r.ewma("x", alpha=0.5) is e
+    with pytest.raises(ValueError, match="alpha"):
+        r.ewma("x", alpha=0.25)
+
+
+def test_transport_emits_wire_stats_event_on_close():
+    from distributed_ml_pytorch_tpu.utils.messaging import MessageCode
+
+    a, b = _pair()
+    rec = obs.SpanRecorder("w", "wire")
+    b.recorder = rec
+    b.send(MessageCode.GradientUpdate, np.ones(4, np.float32))
+    assert a.recv(timeout=5) is not None
+    assert b.flush(timeout=5)
+    a.close()
+    b.close()
+    stats = [e for e in rec.snapshot() if e["name"] == "wire-stats"]
+    assert stats and stats[-1]["meta"]["sent"] == 1
+
+
+# ------------------------------------------------- chaos-determinism guard
+
+def _chaos_log_lines(with_recorder: bool) -> str:
+    """One fixed send script through a faulty world; returns the chaos
+    log rendering. The recorder must not move a single byte of it."""
+    from distributed_ml_pytorch_tpu.utils.chaos import ChaosPlan, FaultRule
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        MessageCode,
+        make_world,
+    )
+
+    plan = ChaosPlan(
+        [FaultRule(code=int(MessageCode.ReliableFrame), drop=0.2, dup=0.2)],
+        seed=11)
+    # RTO far above the pump window: no retransmit ever fires, so the
+    # faulted channel's send sequence is EXACTLY the 30 scripted sends —
+    # the log is a pure function of the seed by construction
+    world, log = make_world(
+        2, reliable=True, plan=plan,
+        reliable_opts=dict(ack_timeout=30.0, max_backoff=60.0))
+    a, b = world[0], world[1]
+    recs = []
+    if with_recorder:
+        for i, t in enumerate((a, b)):
+            rec = obs.SpanRecorder(f"r{i}", "wire")
+            t.recorder = rec
+            recs.append(rec)
+    got = 0
+    try:
+        for i in range(30):
+            with obs.corr_scope():
+                b.send(MessageCode.GradientUpdate,
+                       np.full(8, float(i), np.float32))
+        idle_since = time.monotonic()
+        while time.monotonic() - idle_since < 0.3:
+            if a.recv(timeout=0.1) is not None:
+                got += 1
+                idle_since = time.monotonic()
+    finally:
+        obs.set_corr(0)
+        # detach, don't close: close()'s flush would wait on the frames
+        # the chaos layer deliberately dropped
+        a.detach()
+        b.detach()
+    assert got > 0
+    if with_recorder:
+        assert sum(r.total for r in recs) > 0  # it DID observe something
+    return log.lines()
+
+
+def test_recorder_never_perturbs_chaos_log():
+    """THE determinism guard (ISSUE 12): fault decisions are drawn from
+    seeded per-channel streams keyed by send indices; the recorder reads
+    clocks only. Same script, recorder on vs off -> byte-identical log."""
+    without = _chaos_log_lines(with_recorder=False)
+    with_rec = _chaos_log_lines(with_recorder=True)
+    assert without == with_rec
+    assert "drop" in without or "dup" in without  # chaos actually fired
+
+
+# ---------------------------------------------------- FleetState metrics
+
+def test_fleet_state_metrics_tail_roundtrip():
+    from distributed_ml_pytorch_tpu.coord.coordinator import (
+        decode_fleet,
+        encode_fleet,
+    )
+
+    frame = encode_fleet(3, 2, 2, 2, False, engine_ranks=[4, 5],
+                         fleet_metrics=[120.0, 33.5, 1.0, 2.0])
+    got = decode_fleet(frame)
+    assert got["engine_ranks"] == [4, 5]
+    assert got["fleet_metrics"] == {
+        "events_total": 120.0, "mean_ewma_ms": 33.5,
+        "wire_open": 1.0, "nacks": 2.0}
+    # the pre-ISSUE-12 form (no separator) still decodes, metrics empty
+    legacy = encode_fleet(3, 2, 2, 2, False, engine_ranks=[4, 5])
+    got = decode_fleet(legacy)
+    assert got["engine_ranks"] == [4, 5]
+    assert got["fleet_metrics"] == {}
+
+
+def test_rollback_completion_writes_flight_dump(tmp_path):
+    """ISSUE 12 acceptance slice: a completed rollback barrier persists
+    the coordinator's timeline automatically — the MTTR number ships with
+    its black box."""
+    from distributed_ml_pytorch_tpu.coord.coordinator import (
+        KIND_SHARD,
+        KIND_WORKER,
+        Coordinator,
+        encode_join,
+        encode_renew,
+        encode_rollback_done,
+        encode_snapshot_done,
+    )
+    from distributed_ml_pytorch_tpu.utils.messaging import MessageCode
+
+    clock = [0.0]
+    c = Coordinator(None, 100, lease=100.0, speculation=False,
+                    clock=lambda: clock[0], manifest_dir=str(tmp_path),
+                    auto_rollback=True, rollback_loss_factor=1.5,
+                    rollback_cooldown=50.0, rollback_timeout=20.0)
+    c.recorder = obs.SpanRecorder("coord", "coord")
+    c.obs_dir = str(tmp_path / "obs")
+    c.handle(1, MessageCode.CoordJoin, encode_join(KIND_SHARD, 3))
+    c.handle(4, MessageCode.CoordJoin, encode_join(KIND_WORKER, 5))
+    mv = c.shard_map.version
+    c.trigger_snapshot()
+    c.tick()
+    c.handle(1, MessageCode.SnapshotDone,
+             encode_snapshot_done(1, mv, 0, 100, 12, 12))
+    c.handle(4, MessageCode.LeaseRenew, encode_renew(5, 1, 1, 1.0,
+                                                     loss_ewma=2.0))
+    clock[0] = 1.0
+    c.tick()
+    c.handle(4, MessageCode.LeaseRenew, encode_renew(5, 2, 2, 1.0,
+                                                     loss_ewma=3.5))
+    clock[0] = 2.0
+    c.tick()
+    assert c._roll is not None
+    c.handle(1, MessageCode.RollbackDone,
+             encode_rollback_done(1, mv, 0, 100, 12))
+    assert c.rollbacks_done == 1
+    dumps = os.listdir(c.obs_dir)
+    assert any("rollback1" in d for d in dumps), dumps
+    # the dump covers the fault window: the ROLLBACK decision is in it
+    path = os.path.join(c.obs_dir, [d for d in dumps if "rollback1" in d][0])
+    text = open(path).read()
+    assert "ROLLBACK 1 started" in text and "complete" in text
+
+
+def test_coordinator_broadcasts_fleet_metrics():
+    from distributed_ml_pytorch_tpu.coord.coordinator import (
+        Coordinator,
+        encode_join,
+        encode_renew,
+    )
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        InProcessTransport,
+        MessageCode,
+    )
+
+    world = InProcessTransport.create_world(2)
+    c = Coordinator(world[0], 16, lease=10.0)
+    c.handle(1, MessageCode.CoordJoin, encode_join(0, 100))
+    c.handle(1, MessageCode.LeaseRenew,
+             encode_renew(100, 3, 4, 25.0, 1, 2, 0, 1.5, 0.5))
+    fs = c.fleet_state()
+    assert fs["fleet_metrics"][0] == float(c.events.total)
+    assert fs["fleet_metrics"][1] == 25.0  # the one reporter's ewma
+    assert fs["fleet_metrics"][2] == 1.0 and fs["fleet_metrics"][3] == 2.0
