@@ -187,10 +187,21 @@ bench-wire:
 lint:
 	$(PY) -m distributed_ml_pytorch_tpu.analysis --baseline tests/distcheck_baseline.txt
 
-# fast core signal: distcheck + the MFU-gate smoke + everything that runs
-# in-process (no subprocess worlds, no end-to-end example trainings) —
-# minutes on one core
-test: lint bench-gate
+# bounded protocol model checker (ISSUE 13, analysis/distmodel.py):
+# exhaustively explores small configurations of the extracted wire
+# protocol (2 workers x 2 updates PS; 2-life lease plane; 2x2 MPMD
+# hand-off) under drop/dup/reorder/crash/restart schedules and fails on
+# any exactly-once / acked=>applied / lease-monotonicity /
+# watermark-replay violation. Seconds on one core; counterexamples (from
+# `--mutate <name>`) are written as ChaosPlan JSON + pytest repro stubs:
+#   python -m distributed_ml_pytorch_tpu.analysis distmodel --mutate no_dedup --out /tmp/ce
+distmodel:
+	$(PY) -m distributed_ml_pytorch_tpu.analysis distmodel
+
+# fast core signal: distcheck + the bounded model check + the MFU-gate
+# smoke + everything that runs in-process (no subprocess worlds, no
+# end-to-end example trainings) — minutes on one core
+test: lint distmodel bench-gate
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 
 # the whole suite, subprocess worlds included (tens of minutes on one core)
@@ -216,4 +227,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-health bench-gate bench-compute bench-mpmd timeline chaos coord drill drill-demo fleet health health-demo mpmd mpmd-demo netweather soak lint test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-health bench-gate bench-compute bench-mpmd timeline chaos coord drill drill-demo fleet health health-demo mpmd mpmd-demo netweather soak lint distmodel test test-all verify-real-data graph install dist

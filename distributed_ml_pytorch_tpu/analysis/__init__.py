@@ -1,6 +1,6 @@
 """distcheck — AST-based static analysis for the whole stack (ISSUE 4).
 
-Three checker families over one findings engine:
+Four checker families over one findings engine:
 
 - ``analysis.wire`` (DC1xx): the ``MessageCode`` registry, the declarative
   ``WIRE_SCHEMAS`` payload table, and every send/handler site cross-checked
@@ -14,6 +14,13 @@ Three checker families over one findings engine:
 - ``analysis.tracing_hygiene`` (DC3xx): inside jit/shard_map programs —
   Python branching on traced values, host-state reads frozen at trace
   time, PRNG key reuse without split/fold_in, donated-buffer reuse.
+- ``analysis.protomodel`` (DC4xx, ISSUE 13): the wire protocol as a
+  checkable artifact — dedup-key / durability / delivery annotations on
+  ``WIRE_SCHEMAS`` cross-checked against the real send, handler, WAL and
+  ack sites (reliable-send-without-dedup, apply-before-WAL,
+  ack-before-fsync, ungated incarnation updates, separator-less tail
+  evolution). The same extracted model feeds ``analysis.distmodel``, the
+  bounded explicit-state checker behind ``make distmodel``.
 
 Run it: ``python -m distributed_ml_pytorch_tpu.analysis`` or ``make lint``.
 Suppress a finding: ``# distcheck: ignore[DC2xx] <required reason>``.

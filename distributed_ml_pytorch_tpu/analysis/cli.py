@@ -1,12 +1,13 @@
 """distcheck CLI — ``python -m distributed_ml_pytorch_tpu.analysis``.
 
-Runs the three checker families over a package tree, applies inline
+Runs the four checker families over a package tree, applies inline
 suppressions and the checked-in baseline, and exits non-zero when any
 unsuppressed, non-baselined finding remains — the ``make lint`` contract.
 
     python -m distributed_ml_pytorch_tpu.analysis                 # the package
     python -m distributed_ml_pytorch_tpu.analysis --baseline tests/distcheck_baseline.txt
     python -m distributed_ml_pytorch_tpu.analysis --keys          # baseline keys (regen script)
+    python -m distributed_ml_pytorch_tpu.analysis --json          # machine-readable findings
     python -m distributed_ml_pytorch_tpu.analysis path/to/pkg     # any tree (fixtures)
 
 The ``timeline`` subcommand (ISSUE 12) is the package's first RUNTIME
@@ -14,6 +15,13 @@ analyzer: it merges flight-recorder dumps and attributes the bubble and
 the wire (``analysis/timeline.py``; ``make timeline``):
 
     python -m distributed_ml_pytorch_tpu.analysis timeline <dump-dir> [--json]
+
+The ``distmodel`` subcommand (ISSUE 13) model-checks the extracted
+protocol: bounded exhaustive exploration of the exactly-once / lease /
+watermark-replay invariants, with every counterexample emitted as a
+replayable chaos schedule (``analysis/distmodel.py``; ``make distmodel``):
+
+    python -m distributed_ml_pytorch_tpu.analysis distmodel [--json] [--mutate NAME] [--out DIR]
 """
 
 from __future__ import annotations
@@ -23,7 +31,12 @@ import os
 import sys
 from typing import List, Optional, Tuple
 
-from distributed_ml_pytorch_tpu.analysis import concurrency, tracing_hygiene, wire
+from distributed_ml_pytorch_tpu.analysis import (
+    concurrency,
+    protomodel,
+    tracing_hygiene,
+    wire,
+)
 from distributed_ml_pytorch_tpu.analysis.core import (
     Finding,
     Package,
@@ -33,7 +46,8 @@ from distributed_ml_pytorch_tpu.analysis.core import (
     read_baseline,
 )
 
-CHECKERS = (wire.check, concurrency.check, tracing_hygiene.check)
+CHECKERS = (wire.check, protomodel.check, concurrency.check,
+            tracing_hygiene.check)
 
 
 def analyze(pkg: Package) -> Tuple[List[Finding], List[Finding]]:
@@ -61,6 +75,11 @@ def main(argv=None) -> int:
         from distributed_ml_pytorch_tpu.analysis import timeline
 
         return timeline.main(argv[1:])
+    if argv and argv[0] == "distmodel":
+        # bounded model checker (ISSUE 13): its own arg surface
+        from distributed_ml_pytorch_tpu.analysis import distmodel
+
+        return distmodel.main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="distcheck",
         description="protocol / concurrency / tracing-hygiene static "
@@ -80,6 +99,10 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--show-suppressed", action="store_true",
         help="also list findings silenced by inline suppressions")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="machine-readable findings on stdout (CI / bench_all "
+             "consume lint results without scraping text)")
     args = parser.parse_args(argv)
 
     root = args.root or default_root()
@@ -93,6 +116,28 @@ def main(argv=None) -> int:
         for key in keys:
             print(key)
         return 0
+    if args.json:
+        import json as _json
+
+        def row(f, key, baselined):
+            return {"path": f.path, "line": f.line, "code": f.code,
+                    "message": f.message, "baseline_key": key,
+                    "baselined": baselined}
+
+        payload = {
+            "clean": not new,
+            "counts": {"new": len(new), "baselined": len(known),
+                       "suppressed": len(suppressed)},
+            "findings": [row(f, k, k in baseline)
+                         for f, k in zip(active, keys)],
+        }
+        if args.show_suppressed:
+            payload["suppressed"] = [
+                {"path": f.path, "line": f.line, "code": f.code,
+                 "message": f.message} for f in suppressed]
+        _json.dump(payload, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0 if not new else 1
 
     for f in new:
         print(f.render())

@@ -1,0 +1,538 @@
+"""distcheck DC4xx — the wire protocol as a checkable artifact (ISSUE 13).
+
+The DC1xx wire checker proves the two ENDS of each message agree on its
+layout. This module lifts the next level: a per-plane *protocol model* —
+which handler consumes each code, which dedup key guards it against
+at-least-once redelivery, which sends are ack-released vs fire-and-forget,
+and which state mutations are WAL-covered — extracted from the declarative
+``WIRE_SCHEMAS`` annotations (``dedup_key`` / ``durability`` / ``delivery``
+/ ``rest_sections``, ``utils/messaging.py``) plus the real send and handler
+sites the DC1xx extraction already locates. :func:`extract_protocol`
+returns the model; :func:`check` cross-checks it against the code:
+
+- **DC401** — model soundness of delivery/dedup: a reliably-sent code
+  whose schema declares no dedup key (at-least-once delivery with no
+  exactly-once guard), an annotation outside the declared vocabulary, or
+  a ``delivery`` claim that disagrees with the
+  ``ReliableTransport.unreliable_codes`` default (the code says one thing,
+  the wire does another).
+- **DC402** — a ``durability="wal_before_ack"`` mutation applied before
+  its WAL append: in a function that appends to a WAL, a ``self.<attr>``
+  mutation consuming one of the append's own arguments ABOVE the append —
+  a crash between the two loses an applied update the log never saw
+  (log-before-apply inverted).
+- **DC403** — an ack released before the group fsync on a durable-acks
+  path: a function that both releases deferred delivery acks
+  (``ack_delivered``) and fsyncs a WAL must order the fsync first, or
+  "acked" stops meaning "survives a crash".
+- **DC404** — a ``dedup_key="incarnation"`` code (lease / membership /
+  placement updates) whose declared plane has positive handlers but none
+  of them compares incarnations: a stale life's frame can evict or roll
+  back a newer life.
+- **DC405** — schema rest-tail evolution that breaks old-frame decode: a
+  multi-section ``rest`` tail (the ``fleet_metrics`` pattern) must declare
+  its sentinel ``rest_separator``, and some module of the handled plane
+  must actually split on it — otherwise pre-evolution frames decode into
+  the wrong section.
+
+Like DC105/DC107/DC108, the family is opt-in: it stays silent on a
+package whose schema table carries no protocol-model annotations, so the
+DC1xx fixture corpora (and third-party trees) are unaffected.
+
+The extracted :class:`ProtocolModel` is also the input of the bounded
+explicit-state model checker (``analysis/distmodel.py``), which explores
+small configurations of these rules under drop/dup/reorder/crash/restart
+schedules and replays every counterexample as a chaos schedule.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from distributed_ml_pytorch_tpu.analysis.core import (
+    Finding,
+    Package,
+    SourceFile,
+    call_name,
+    dotted_name,
+    walk_list,
+)
+from distributed_ml_pytorch_tpu.analysis.wire import (
+    HandlerSite,
+    SchemaInfo,
+    SendSite,
+    extract_builders,
+    extract_enum,
+    extract_handlers,
+    extract_schemas,
+    extract_sends,
+)
+
+#: the annotation vocabularies the extractor accepts — mirrored from
+#: ``utils/messaging.py`` as LITERALS so the checker never imports its
+#: analysis target (the fixture corpora carry broken registries on purpose)
+DEDUP_KEYS = ("env_seq", "step_mb", "request_id", "incarnation",
+              "version", "idempotent")
+DURABILITY = ("none", "wal_before_ack")
+DELIVERY = ("reliable", "best_effort", "envelope")
+
+#: the module that IS the reliability layer (exempt from DC403: its own
+#: plumbing defines the ack machinery the rule polices elsewhere)
+_LAYER_MODULE = "utils/messaging.py"
+
+
+@dataclasses.dataclass
+class MessageSpec:
+    """One message type of the extracted protocol model."""
+
+    code: str
+    value: Optional[int]
+    schema: Optional[SchemaInfo]
+    sends: List[SendSite]
+    handlers: List[HandlerSite]
+
+    @property
+    def dedup_key(self) -> Optional[str]:
+        return self.schema.dedup_key if self.schema else None
+
+    @property
+    def delivery(self) -> str:
+        return self.schema.delivery if self.schema else "reliable"
+
+    @property
+    def durability(self) -> str:
+        return self.schema.durability if self.schema else "none"
+
+    @property
+    def planes(self) -> Tuple[str, ...]:
+        return self.schema.handled_by if self.schema else ()
+
+
+@dataclasses.dataclass
+class ProtocolModel:
+    """The package's wire protocol as data: every message type with its
+    layout, guard, durability and delivery class, plus the send/handler
+    sites that realize it. ``adopted`` is False for trees whose schema
+    table carries no protocol annotations (DC4xx stays silent there)."""
+
+    specs: Dict[str, MessageSpec]
+    adopted: bool
+    unreliable_default: Optional[Set[str]]
+
+    def spec(self, code: str) -> Optional[MessageSpec]:
+        return self.specs.get(code)
+
+
+def _unreliable_default(pkg: Package) -> Optional[Set[str]]:
+    """Code names in ``ReliableTransport.__init__``'s ``unreliable_codes``
+    default tuple — the ground truth DC401 cross-checks ``delivery``
+    annotations against. None when the package has no such class."""
+    for src in pkg:
+        for node in walk_list(src.tree):
+            if not (isinstance(node, ast.ClassDef)
+                    and node.name == "ReliableTransport"):
+                continue
+            for fn in node.body:
+                if not (isinstance(fn, ast.FunctionDef)
+                        and fn.name == "__init__"):
+                    continue
+                args = fn.args.kwonlyargs + fn.args.args
+                defaults = list(fn.args.kw_defaults) + list(fn.args.defaults)
+                for arg in args:
+                    if arg.arg != "unreliable_codes":
+                        continue
+                    names: Set[str] = set()
+                    for d in defaults:
+                        if d is None:
+                            continue
+                        for sub in ast.walk(d):
+                            if isinstance(sub, ast.Attribute) and \
+                                    isinstance(sub.value, ast.Name) and \
+                                    sub.value.id == "MessageCode":
+                                names.add(sub.attr)
+                    # only the tuple default adjacent to the arg matters,
+                    # but collecting across defaults is safe: the only
+                    # MessageCode attrs in the signature ARE that tuple
+                    return names
+    return None
+
+
+def extract_protocol(pkg: Package) -> ProtocolModel:
+    """Lift the per-plane protocol model from the schema table plus the
+    real send/handler sites (shared extraction with the DC1xx checker)."""
+    enum, _ = extract_enum(pkg)
+    schemas = extract_schemas(pkg)
+    builders = extract_builders(pkg)
+    sends = extract_sends(pkg, builders)
+    handlers = extract_handlers(pkg)
+    adopted = any(
+        s.dedup_key is not None or s.durability != "none"
+        or s.delivery != "reliable" or s.rest_sections
+        for s in schemas.values())
+    specs: Dict[str, MessageSpec] = {}
+    for code in set(enum) | set(schemas):
+        specs[code] = MessageSpec(
+            code=code,
+            value=enum.get(code),
+            schema=schemas.get(code),
+            sends=[s for s in sends if s.code == code],
+            handlers=[h for h in handlers if h.code == code],
+        )
+    return ProtocolModel(specs, adopted, _unreliable_default(pkg))
+
+
+# --------------------------------------------------------------- DC401
+
+def _check_delivery_dedup(model: ProtocolModel) -> List[Finding]:
+    findings: List[Finding] = []
+    for code in sorted(model.specs):
+        spec = model.specs[code]
+        sch = spec.schema
+        if sch is None:
+            continue
+        if sch.dedup_key is not None and sch.dedup_key not in DEDUP_KEYS:
+            findings.append(Finding(
+                sch.path, sch.line, "DC401",
+                f"MessageCode.{code} declares dedup_key="
+                f"{sch.dedup_key!r} — not in the declared vocabulary "
+                f"{DEDUP_KEYS}; the protocol model cannot reason about it"))
+            continue
+        if sch.durability not in DURABILITY:
+            findings.append(Finding(
+                sch.path, sch.line, "DC401",
+                f"MessageCode.{code} declares durability="
+                f"{sch.durability!r} — not in {DURABILITY}"))
+        if sch.delivery not in DELIVERY:
+            findings.append(Finding(
+                sch.path, sch.line, "DC401",
+                f"MessageCode.{code} declares delivery="
+                f"{sch.delivery!r} — not in {DELIVERY}"))
+            continue
+        if sch.delivery == "reliable" and spec.sends \
+                and sch.dedup_key is None:
+            first = min(spec.sends, key=lambda s: (s.path, s.line))
+            findings.append(Finding(
+                first.path, first.line, "DC401",
+                f"MessageCode.{code} is sent reliably (at-least-once "
+                "redelivery) but its schema declares no dedup_key — "
+                "nothing makes a duplicate safe to apply; declare the "
+                "guard (env_seq / step_mb / request_id / incarnation / "
+                "version / idempotent) or delivery='best_effort'"))
+        if model.unreliable_default is not None:
+            if sch.delivery == "best_effort" \
+                    and code not in model.unreliable_default:
+                findings.append(Finding(
+                    sch.path, sch.line, "DC401",
+                    f"MessageCode.{code} is annotated "
+                    "delivery='best_effort' but is NOT in "
+                    "ReliableTransport's unreliable_codes default — the "
+                    "wire will envelope and retry it; the model and the "
+                    "code disagree"))
+            elif sch.delivery == "reliable" \
+                    and code in model.unreliable_default:
+                findings.append(Finding(
+                    sch.path, sch.line, "DC401",
+                    f"MessageCode.{code} is annotated delivery='reliable' "
+                    "but ReliableTransport's unreliable_codes default "
+                    "skips the envelope for it — its frames get no "
+                    "retry/dedup service; annotate delivery="
+                    "'best_effort' or remove it from the set"))
+    return findings
+
+
+# --------------------------------------------------------------- DC402
+
+def _wal_receiver(node: ast.Call) -> bool:
+    """``<...>.wal.append(...)`` / ``<...>_wal.append(...)`` — an append
+    whose receiver is wal-named (``self._recent_envelopes.append`` etc.
+    must not count)."""
+    f = node.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "append"):
+        return False
+    recv = dotted_name(f.value)
+    if not recv:
+        return False
+    last = recv.split(".")[-1]
+    return last == "wal" or last.endswith("_wal")
+
+
+def _self_mutations(fn: ast.AST) -> List[Tuple[int, ast.AST, Set[str]]]:
+    """``self.X += ...`` / ``self.X = ...`` statements with the Name ids
+    their RHS reads: (line, node, rhs_names)."""
+    out = []
+    for node in walk_list(fn):
+        target = value = None
+        if isinstance(node, ast.AugAssign):
+            target, value = node.target, node.value
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target, value = node.targets[0], node.value
+        if target is None or value is None:
+            continue
+        if not (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            continue
+        rhs = {n.id for n in ast.walk(value) if isinstance(n, ast.Name)}
+        out.append((node.lineno, node, rhs))
+    return out
+
+
+def _check_wal_before_apply(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in pkg:
+        for fn in walk_list(src.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            appends = [n for n in walk_list(fn)
+                       if isinstance(n, ast.Call) and _wal_receiver(n)]
+            if not appends:
+                continue
+            muts = _self_mutations(fn)
+            for app in appends:
+                arg_names = {n.id for a in list(app.args)
+                             + [kw.value for kw in app.keywords]
+                             for n in ast.walk(a) if isinstance(n, ast.Name)}
+                arg_names.discard("self")
+                if not arg_names:
+                    continue
+                for line, _node, rhs in muts:
+                    if line < app.lineno and rhs & arg_names:
+                        findings.append(Finding(
+                            src.path, line, "DC402",
+                            f"durable state mutated from "
+                            f"{sorted(rhs & arg_names)} BEFORE the WAL "
+                            f"append at line {app.lineno} that logs it — "
+                            "a crash in between applies an update the "
+                            "log never saw (log-before-apply inverted)"))
+    return findings
+
+
+# --------------------------------------------------------------- DC403
+
+def _ack_release_lines(fn: ast.AST) -> List[int]:
+    """Lines releasing deferred delivery acks: ``x.ack_delivered()`` or a
+    call of a local bound via ``getattr(..., "ack_delivered", ...)``."""
+    bound: Set[str] = set()
+    for node in walk_list(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and call_name(node.value) == "getattr" \
+                and any(isinstance(a, ast.Constant)
+                        and a.value == "ack_delivered"
+                        for a in node.value.args):
+            bound.add(node.targets[0].id)
+    lines = []
+    for node in walk_list(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "ack_delivered":
+            lines.append(node.lineno)
+        elif isinstance(f, ast.Name) and f.id in bound:
+            lines.append(node.lineno)
+    return lines
+
+
+def _wal_sync_lines(fn: ast.AST) -> List[int]:
+    lines = []
+    for node in walk_list(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "sync":
+            recv = dotted_name(f.value)
+            last = recv.split(".")[-1] if recv else ""
+            if last == "wal" or last.endswith("_wal"):
+                lines.append(node.lineno)
+    return lines
+
+
+def _check_fsync_before_ack(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in pkg:
+        if src.path.endswith(_LAYER_MODULE):
+            continue  # the ack machinery's own plumbing
+        for fn in walk_list(src.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            acks = _ack_release_lines(fn)
+            syncs = _wal_sync_lines(fn)
+            if not acks or not syncs:
+                continue
+            for ack_line in acks:
+                if any(ack_line < s for s in syncs):
+                    findings.append(Finding(
+                        src.path, ack_line, "DC403",
+                        f"delivery acks released at line {ack_line} "
+                        f"BEFORE the WAL group-fsync at line "
+                        f"{min(s for s in syncs if s > ack_line)} in "
+                        f"{fn.name}() — 'acked' no longer survives a "
+                        "crash (log-before-ack inverted)"))
+    return findings
+
+
+# --------------------------------------------------------------- DC404
+
+def _followed_walk(site: HandlerSite, src: SourceFile) -> List[ast.AST]:
+    """The handler body's nodes plus one level of same-file ``self.m()``
+    delegation — coordinator handlers commonly dispatch inline but gate
+    inside a helper method."""
+    nodes: List[ast.AST] = []
+    if site.body is None:
+        return nodes
+    called: Set[str] = set()
+    for stmt in site.body:
+        for node in ast.walk(stmt):
+            nodes.append(node)
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self":
+                called.add(node.func.attr)
+    if called:
+        for node in walk_list(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in called:
+                nodes.extend(walk_list(node))
+    return nodes
+
+
+def _has_incarnation_compare(nodes: List[ast.AST]) -> bool:
+    for node in nodes:
+        if not isinstance(node, ast.Compare):
+            continue
+        for side in (node.left, *node.comparators):
+            name = dotted_name(side)
+            if name and "inc" in name.lower():
+                return True
+    return False
+
+
+def _check_incarnation_gate(model: ProtocolModel,
+                            pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    by_path = {src.path: src for src in pkg}
+    for code in sorted(model.specs):
+        spec = model.specs[code]
+        if spec.dedup_key != "incarnation":
+            continue
+        for plane in spec.planes:
+            sites = [h for h in spec.handlers
+                     if h.plane == plane and h.body is not None]
+            if not sites:
+                continue  # DC102 owns missing handlers
+            gated = any(
+                _has_incarnation_compare(
+                    _followed_walk(h, by_path[h.path]))
+                for h in sites if h.path in by_path)
+            if not gated:
+                first = min(sites, key=lambda h: (h.path, h.line))
+                findings.append(Finding(
+                    first.path, first.line, "DC404",
+                    f"MessageCode.{code} is dedup_key='incarnation' but "
+                    f"no {plane}-plane handler compares incarnations — a "
+                    "stale life's frame can evict or roll back a newer "
+                    "live member (lease/placement update not gated on "
+                    "incarnation)"))
+    return findings
+
+
+# --------------------------------------------------------------- DC405
+
+def _section_codecs(src: SourceFile,
+                    sections: Tuple[str, ...]) -> List[ast.AST]:
+    """Functions that handle the evolved tail: they reference a section
+    name as a string constant (the decoder's dict keys) or take a
+    parameter named after one (the encoder's signature). Only THESE
+    functions are required to split on the separator — a stray ``< 0``
+    elsewhere on the plane must not satisfy the rule."""
+    out = []
+    wanted = set(sections)
+    for node in walk_list(src.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        args = {a.arg for a in node.args.args + node.args.kwonlyargs}
+        if args & wanted:
+            out.append(node)
+            continue
+        for sub in walk_list(node):
+            if isinstance(sub, ast.Constant) and sub.value in wanted:
+                out.append(node)
+                break
+    return out
+
+
+def _guards_separator(fn: ast.AST, separator: float) -> bool:
+    """Does this function compare anything against the separator (or, for
+    a negative sentinel, against 0 — the ``tail < 0`` split idiom)?"""
+    from distributed_ml_pytorch_tpu.analysis.wire import _const_num
+
+    for node in walk_list(fn):
+        if not isinstance(node, ast.Compare):
+            continue
+        for side in (node.left, *node.comparators):
+            val = _const_num(side)
+            if val is None:
+                continue
+            if val == separator:
+                return True
+            if separator < 0 and val == 0 and any(
+                    isinstance(op, (ast.Lt, ast.GtE))
+                    for op in node.ops):
+                return True
+    return False
+
+
+def _check_tail_evolution(model: ProtocolModel,
+                          pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    for code in sorted(model.specs):
+        spec = model.specs[code]
+        sch = spec.schema
+        if sch is None or len(sch.rest_sections) < 2:
+            continue
+        if sch.rest_separator is None:
+            findings.append(Finding(
+                sch.path, sch.line, "DC405",
+                f"MessageCode.{code} declares a multi-section rest tail "
+                f"{sch.rest_sections} without a rest_separator — an "
+                "old frame (shorter tail) decodes into the wrong "
+                "section; declare the sentinel that splits them"))
+            continue
+        planes = sch.handled_by or ()
+        codecs = [fn for s in pkg if s.plane in planes
+                  for fn in _section_codecs(s, sch.rest_sections)]
+        # the SPLIT lives in the decoder; hold the decode-named codecs to
+        # the rule when the plane follows the decode_*/…decode convention
+        # (this package does), else any section-referencing function
+        decoders = [fn for fn in codecs if "decode" in fn.name.lower()]
+        codecs = decoders or codecs
+        if codecs and not any(
+                _guards_separator(fn, sch.rest_separator)
+                for fn in codecs):
+            findings.append(Finding(
+                sch.path, sch.line, "DC405",
+                f"MessageCode.{code} declares rest_separator="
+                f"{sch.rest_separator:g} for its "
+                f"{sch.rest_sections} tail but no {' or '.join(planes)}-"
+                "plane codec (the functions naming those sections) ever "
+                "splits on it — the evolved tail decodes old frames "
+                "into the wrong section"))
+    return findings
+
+
+# --------------------------------------------------------------- entry
+
+def check(pkg: Package) -> List[Finding]:
+    model = extract_protocol(pkg)
+    if not model.adopted:
+        return []  # this tree never opted into protocol-model annotations
+    findings = _check_delivery_dedup(model)
+    findings.extend(_check_wal_before_apply(pkg))
+    findings.extend(_check_fsync_before_ack(pkg))
+    findings.extend(_check_incarnation_gate(model, pkg))
+    findings.extend(_check_tail_evolution(model, pkg))
+    return findings

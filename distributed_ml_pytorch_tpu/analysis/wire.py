@@ -82,6 +82,13 @@ class SchemaInfo:
     handled_by: Tuple[str, ...]
     path: str
     line: int
+    # protocol-model annotations (ISSUE 13) — consumed by the DC4xx
+    # checkers in analysis/protomodel.py; defaults mirror PayloadSchema
+    dedup_key: Optional[str] = None
+    durability: str = "none"
+    delivery: str = "reliable"
+    rest_sections: Tuple[str, ...] = ()
+    rest_separator: Optional[float] = None
 
     @property
     def head(self) -> int:
@@ -156,6 +163,17 @@ def extract_enum(pkg: Package) -> Tuple[Dict[str, int], List[Finding]]:
     return values, findings
 
 
+def _const_num(node: ast.AST) -> Optional[float]:
+    """A literal int/float, including a unary-minus one (``-1.0``)."""
+    if isinstance(node, ast.Constant) and isinstance(
+            node.value, (int, float)) and not isinstance(node.value, bool):
+        return float(node.value)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = _const_num(node.operand)
+        return None if inner is None else -inner
+    return None
+
+
 def extract_schemas(pkg: Package) -> Dict[str, SchemaInfo]:
     schemas: Dict[str, SchemaInfo] = {}
     for src in pkg:
@@ -171,24 +189,40 @@ def extract_schemas(pkg: Package) -> Dict[str, SchemaInfo]:
                 rest = None
                 rest_min = 0
                 handled_by: Tuple[str, ...] = ()
+                info = SchemaInfo(code, fields, rest, rest_min, handled_by,
+                                  src.path, val.lineno)
                 for kw in val.keywords:
                     if kw.arg == "fields" and isinstance(
                             kw.value, (ast.Tuple, ast.List)):
-                        fields = tuple(
+                        info.fields = tuple(
                             e.value for e in kw.value.elts
                             if isinstance(e, ast.Constant))
                     elif kw.arg == "rest" and isinstance(kw.value, ast.Constant):
-                        rest = kw.value.value
+                        info.rest = kw.value.value
                     elif kw.arg == "rest_min":
-                        rest_min = const_int(kw.value) or 0
+                        info.rest_min = const_int(kw.value) or 0
                     elif kw.arg == "handled_by" and isinstance(
                             kw.value, (ast.Tuple, ast.List)):
-                        handled_by = tuple(
+                        info.handled_by = tuple(
                             e.value for e in kw.value.elts
                             if isinstance(e, ast.Constant))
-                schemas[code] = SchemaInfo(
-                    code, fields, rest, rest_min, handled_by,
-                    src.path, val.lineno)
+                    elif kw.arg == "dedup_key" and isinstance(
+                            kw.value, ast.Constant):
+                        info.dedup_key = kw.value.value
+                    elif kw.arg == "durability" and isinstance(
+                            kw.value, ast.Constant):
+                        info.durability = kw.value.value
+                    elif kw.arg == "delivery" and isinstance(
+                            kw.value, ast.Constant):
+                        info.delivery = kw.value.value
+                    elif kw.arg == "rest_sections" and isinstance(
+                            kw.value, (ast.Tuple, ast.List)):
+                        info.rest_sections = tuple(
+                            e.value for e in kw.value.elts
+                            if isinstance(e, ast.Constant))
+                    elif kw.arg == "rest_separator":
+                        info.rest_separator = _const_num(kw.value)
+                schemas[code] = info
     return schemas
 
 

@@ -127,7 +127,15 @@ def encode_rollback_done(rollback_id: int, map_version: int, lo: int,
          *_split16(hi), *_split16(apply_seq)], np.float32)
 
 
-#: order of the ``fleet_metrics`` floats behind the -1 separator in a
+#: the FleetState tail's section sentinel (ISSUE 12/13): engine ranks are
+#: non-negative, so one negative float unambiguously splits the evolved
+#: ``(engine_ranks, fleet_metrics)`` tail — and a pre-evolution frame
+#: without it still decodes with an empty metrics section. The value is
+#: DECLARED in WIRE_SCHEMAS[FleetState].rest_separator; distcheck DC405
+#: checks that the decoder really splits on it.
+FLEET_TAIL_SEPARATOR = -1.0
+
+#: order of the ``fleet_metrics`` floats behind the separator in a
 #: FleetState tail (ISSUE 12): the coordinator-side registry summary every
 #: member sees for free on the broadcast it already consumes
 FLEET_METRICS_FIELDS = (
@@ -151,7 +159,7 @@ def encode_fleet(version: int, n_workers: int, n_shards: int, n_engines: int,
     tail = [float(r) for r in engine_ranks]
     metrics = [float(m) for m in fleet_metrics]
     if metrics:
-        tail += [-1.0] + metrics
+        tail += [FLEET_TAIL_SEPARATOR] + metrics
     return np.asarray(
         [*_split16(version), float(n_workers), float(n_shards),
          float(n_engines), 1.0 if workers_done else 0.0, *tail], np.float32)

@@ -101,6 +101,21 @@ SHIP_TOKENS = 1   # microbatch token ids, driver -> stage 0
 SHIP_TARGETS = 2  # microbatch target ids, driver -> last stage
 SHIP_LOSS = 3     # [ce_sum] report, last stage -> driver
 
+
+def replay_covers(step: int, mbi: int, n_microbatches: int,
+                  watermark: int) -> bool:
+    """The watermark-replay eligibility predicate: a retained ``(step,
+    mb)`` hand-off is re-shipped to a restarted neighbor iff its global
+    microbatch index is AT OR PAST the neighbor's announced recovery
+    watermark. ``>=`` is load-bearing: the checkpoint at watermark ``w``
+    covers indices ``< w``, so index ``w`` itself is the restarted
+    member's first hole — re-shipping strictly above it leaves a
+    permanent gap. This is the exact rule the bounded model checker
+    explores (``analysis/distmodel.MpmdModel``; its
+    ``watermark_off_by_one`` mutation is this predicate with ``>``), and
+    tests/test_distmodel.py tethers the two together."""
+    return step * n_microbatches + mbi >= watermark
+
 CKPT_FILE = "stage.ckpt"
 
 
@@ -653,7 +668,7 @@ class MpmdStage:
         else:
             return
         for (step, mbi), body in sorted(self._retained[dirn].items()):
-            if step * self.M + mbi < entry.watermark:
+            if not replay_covers(step, mbi, self.M, entry.watermark):
                 continue
             self._send_frame(entry.rank, code, step, mbi, kind, body)
             self.stats["reshipped"] += 1

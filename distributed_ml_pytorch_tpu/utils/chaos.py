@@ -235,6 +235,55 @@ class ChaosPlan:
         return None
 
 
+#: rule kinds of a serialized plan, in field order — the JSON round-trip
+#: (ISSUE 13) is what lets the bounded model checker (analysis/distmodel)
+#: emit every counterexample as a concrete, runnable chaos schedule
+_RULE_KINDS = (("rules", FaultRule), ("weather", WeatherRule),
+               ("sdc", SDCRule))
+
+
+def plan_to_json(plan: ChaosPlan) -> dict:
+    """A :class:`ChaosPlan` as a plain-JSON dict (dataclass fields only,
+    defaults omitted) — the counterexample interchange format. Inverse of
+    :func:`plan_from_json`; ``plan_from_json(plan_to_json(p)) == p``."""
+    out: dict = {"seed": plan.seed}
+    for key, cls in _RULE_KINDS:
+        rows = []
+        for rule in getattr(plan, key):
+            row = {}
+            for f in dataclasses.fields(cls):
+                val = getattr(rule, f.name)
+                if val != f.default:
+                    row[f.name] = val
+            rows.append(row)
+        if rows:
+            out[key] = rows
+    return out
+
+
+def plan_from_json(data: dict) -> ChaosPlan:
+    """Rebuild a :class:`ChaosPlan` from :func:`plan_to_json` output.
+    Unknown keys fail loudly (a typo'd field must not silently weaken a
+    replayed counterexample into a no-op plan)."""
+    known = {key for key, _cls in _RULE_KINDS} | {"seed"}
+    extra = set(data) - known
+    if extra:
+        raise ValueError(f"unknown ChaosPlan fields: {sorted(extra)}")
+    kw: dict = {"seed": int(data.get("seed", 0))}
+    for key, cls in _RULE_KINDS:
+        rows = data.get(key, [])
+        names = {f.name for f in dataclasses.fields(cls)}
+        rules = []
+        for row in rows:
+            bad = set(row) - names
+            if bad:
+                raise ValueError(
+                    f"unknown {cls.__name__} fields: {sorted(bad)}")
+            rules.append(cls(**row))
+        kw[key] = tuple(rules)
+    return ChaosPlan(kw["rules"], kw["seed"], kw["weather"], kw["sdc"])
+
+
 class ChaosLog:
     """Thread-safe record of every fault that fired.
 

@@ -133,9 +133,44 @@ class MessageCode(enum.IntEnum):
     StageAssign = 33
 
 
+#: dedup-key vocabulary (ISSUE 13): WHICH receiver-side guard makes an
+#: at-least-once redelivery of this code safe to apply.
+#:
+#: - ``env_seq``      — the reliability envelope's per-peer (incarnation,
+#:   seq) dedup window, re-seeded across receiver restarts from the WAL /
+#:   checkpoint meta (``ReliableTransport.seed_dedup``).
+#: - ``step_mb``      — application-level ``(step, microbatch)`` dedup
+#:   (the MPMD replay contract: chaos dups, redelivery and watermark
+#:   replay can never double-apply a microbatch).
+#: - ``request_id``   — an explicit id in the payload head (serving
+#:   request ids, speculation task ids, snapshot / rollback ids):
+#:   first-wins or offset-resumable per id.
+#: - ``incarnation``  — lives of a rank are ordered by incarnation; stale
+#:   lives' frames are ignored or merely re-acked (membership plane).
+#: - ``version``      — versioned last-write-wins install (shard maps,
+#:   stage placements, fleet views): an older version never rolls a
+#:   consumer back, a duplicate of the current one is a no-op.
+#: - ``idempotent``   — re-applying is harmless by construction (reads,
+#:   whole-state installs, set-adds).
+DEDUP_KEYS = ("env_seq", "step_mb", "request_id", "incarnation",
+              "version", "idempotent")
+
+#: durability vocabulary: ``wal_before_ack`` marks a code whose applied
+#: state mutation must be WAL-logged before its delivery ack is released
+#: (log-before-ack; the DC402/DC403 contract). Everything else is "none".
+DURABILITY = ("none", "wal_before_ack")
+
+#: delivery vocabulary: ``reliable`` rides the ReliableTransport envelope
+#: (retry until acked), ``best_effort`` is deliberately un-enveloped
+#: (periodic + self-healing: the ``unreliable_codes`` set), ``envelope``
+#: is the reliability layer's own wire (the mechanism, not a user).
+DELIVERY = ("reliable", "best_effort", "envelope")
+
+
 @dataclasses.dataclass(frozen=True)
 class PayloadSchema:
-    """Declarative wire layout of one :class:`MessageCode` (ISSUE 4).
+    """Declarative wire layout AND protocol contract of one
+    :class:`MessageCode` (ISSUE 4; protocol-model annotations ISSUE 13).
 
     Every payload is ``[*fields, *rest]`` on the tagged-float32 wire:
     ``fields`` names the fixed head positions (``*_lo``/``*_hi`` pairs are
@@ -145,6 +180,23 @@ class PayloadSchema:
     ``handled_by`` declares WHICH plane's modules must dispatch on the
     code — ``ps`` (parallel/, training/), ``serving``, ``coord``, or
     ``transport`` (utils/, native/).
+
+    Protocol-model annotations (ISSUE 13) — the semantic half the
+    ``analysis/protomodel.py`` extractor reads and cross-checks against
+    the real handler/send sites (the DC4xx family):
+
+    - ``dedup_key`` — one of :data:`DEDUP_KEYS`: the guard that makes
+      at-least-once redelivery safe. A reliably-sent code with no dedup
+      key is DC401.
+    - ``durability`` — one of :data:`DURABILITY`: ``wal_before_ack``
+      codes must log before they mutate (DC402) and fsync before they
+      ack (DC403).
+    - ``delivery`` — one of :data:`DELIVERY`; cross-checked against the
+      ``ReliableTransport.unreliable_codes`` default (DC401).
+    - ``rest_sections`` / ``rest_separator`` — a ``rest`` tail that
+      EVOLVED into multiple sections must declare the sentinel separator
+      old frames lack (the ``fleet_metrics`` ``-1`` pattern), and some
+      handler on the declared plane must actually split on it (DC405).
 
     This table is the single source of truth the ``distcheck`` wire
     checker (``analysis/wire.py``) validates send sites, handler guards
@@ -158,6 +210,29 @@ class PayloadSchema:
     rest_min: int = 0
     handled_by: Tuple[str, ...] = ()
     doc: str = ""
+    dedup_key: Optional[str] = None
+    durability: str = "none"
+    delivery: str = "reliable"
+    rest_sections: Tuple[str, ...] = ()
+    rest_separator: Optional[float] = None
+
+    def __post_init__(self):
+        if self.dedup_key is not None and self.dedup_key not in DEDUP_KEYS:
+            raise ValueError(
+                f"unknown dedup_key {self.dedup_key!r} (vocabulary: "
+                f"{DEDUP_KEYS})")
+        if self.durability not in DURABILITY:
+            raise ValueError(
+                f"unknown durability {self.durability!r} (vocabulary: "
+                f"{DURABILITY})")
+        if self.delivery not in DELIVERY:
+            raise ValueError(
+                f"unknown delivery {self.delivery!r} (vocabulary: "
+                f"{DELIVERY})")
+        if len(self.rest_sections) >= 2 and self.rest_separator is None:
+            raise ValueError(
+                "a multi-section rest tail needs a declared rest_separator "
+                "(old frames must still decode — the DC405 contract)")
 
     @property
     def min_size(self) -> int:
@@ -167,35 +242,46 @@ class PayloadSchema:
 WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
     MessageCode.ParameterUpdate: PayloadSchema(
         rest="params", handled_by=("ps", "coord"),
+        dedup_key="idempotent",
         doc="central flat params (server push / construction install)"),
     MessageCode.ParameterRequest: PayloadSchema(
         handled_by=("ps", "coord"),
+        dedup_key="idempotent",
         doc="empty pull request (also the TCP hello frame)"),
     MessageCode.GradientUpdate: PayloadSchema(
         rest="params", handled_by=("ps", "coord"),
+        dedup_key="env_seq", durability="wal_before_ack",
         doc="lr-pre-scaled accumulated update; server ADDS it"),
     MessageCode.WorkerDone: PayloadSchema(
-        handled_by=("ps", "coord"), doc="clean worker exit"),
+        handled_by=("ps", "coord"), dedup_key="idempotent",
+        doc="clean worker exit"),
     MessageCode.Heartbeat: PayloadSchema(
-        handled_by=("ps", "coord"), doc="liveness only; never retried"),
+        handled_by=("ps", "coord"), dedup_key="idempotent",
+        delivery="best_effort",
+        doc="liveness only; never retried"),
     MessageCode.SubmitRequest: PayloadSchema(
         fields=("id", "max_new", "temperature", "top_k", "top_p", "seed",
                 "eos"),
         rest="prompt", rest_min=1, handled_by=("serving",),
+        dedup_key="request_id",
         doc="client -> engine; eos < 0 means none"),
     MessageCode.StreamTokens: PayloadSchema(
         fields=("id", "done_flag", "start_index"), rest="tokens",
         handled_by=("serving",),
+        dedup_key="request_id",
         doc="engine -> client; start_index enables gap arithmetic"),
     MessageCode.ServeReject: PayloadSchema(
         fields=("id",), handled_by=("serving",),
+        dedup_key="request_id",
         doc="queue full, or a resume the engine cannot serve"),
     MessageCode.CancelRequest: PayloadSchema(
-        fields=("id",), handled_by=("serving",), doc="client -> engine"),
+        fields=("id",), handled_by=("serving",), dedup_key="request_id",
+        doc="client -> engine"),
     MessageCode.ReliableFrame: PayloadSchema(
         fields=("inc_lo", "inc_hi", "seq_lo", "seq_hi", "crc_lo", "crc_hi",
                 "code", "corr_lo", "corr_hi"),
         rest="payload", handled_by=("transport",),
+        delivery="envelope",
         doc="reliability envelope; CRC covers header + body. corr (ISSUE "
             "12) is the flight-recorder CORRELATION id riding the "
             "envelope: the sender stamps its thread's active id "
@@ -205,23 +291,29 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
     MessageCode.ReliableAck: PayloadSchema(
         fields=("seq_lo", "seq_hi", "inc_lo", "inc_hi"),
         handled_by=("transport",),
+        delivery="envelope",
         doc="ack echoes the frame's incarnation (stale-life acks ignored)"),
     MessageCode.StreamAck: PayloadSchema(
         fields=("id", "n_received"), handled_by=("serving",),
+        dedup_key="request_id",
         doc="client progress + liveness"),
     MessageCode.ResumeStream: PayloadSchema(
         fields=("id", "n_received"), handled_by=("serving",),
+        dedup_key="request_id",
         doc="re-send the stream from offset (gap recovery / reconnect)"),
     MessageCode.CoordJoin: PayloadSchema(
         fields=("kind", "inc_lo", "inc_hi"), handled_by=("coord",),
+        dedup_key="incarnation",
         doc="member -> coordinator; idempotent, retried until answered"),
     MessageCode.CoordLeave: PayloadSchema(
         fields=("inc_lo", "inc_hi"), handled_by=("coord",),
+        dedup_key="incarnation",
         doc="explicit leave; stale incarnations cannot evict newer lives"),
     MessageCode.LeaseRenew: PayloadSchema(
         fields=("inc_lo", "inc_hi", "push_count", "step", "ewma_ms",
                 "wire_open", "nacks", "bad_loss", "loss_ewma", "gnorm_ewma"),
         handled_by=("coord",),
+        dedup_key="incarnation", delivery="best_effort",
         doc="lease refresh carrying the straggler-detector progress report, "
             "the member's open-circuit-breaker count (wire health) and the "
             "numerical-health telemetry (ISSUE 8): cumulative admission "
@@ -232,11 +324,14 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
         fields=("n_entries", "version_lo", "version_hi", "n_params_lo",
                 "n_params_hi"),
         rest="entries", handled_by=("coord",),
+        dedup_key="version",
         doc="encoded ShardMap; 9 floats per entry (coord/shardmap.py)"),
     MessageCode.FleetState: PayloadSchema(
         fields=("version_lo", "version_hi", "n_workers", "n_shards",
                 "n_engines", "workers_done"),
         rest="engine_ranks", handled_by=("coord",),
+        dedup_key="version",
+        rest_sections=("engine_ranks", "fleet_metrics"), rest_separator=-1.0,
         doc="compact fleet broadcast the serving frontend consumes; the "
             "tail lists live engine coord-ranks (per-engine lease health) "
             "and, behind a -1 separator (ranks are non-negative, so the "
@@ -246,21 +341,25 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
     MessageCode.SpeculateTask: PayloadSchema(
         fields=("task_id", "victim_rank", "from_step"),
         handled_by=("coord",),
+        dedup_key="request_id",
         doc="coordinator -> backup AND victim; same id for dedup"),
     MessageCode.SpeculativeUpdate: PayloadSchema(
         fields=("task_lo", "task_hi", "ver_lo", "ver_hi", "lo_lo", "lo_hi",
                 "hi_lo", "hi_hi"),
         rest="payload", handled_by=("coord",),
+        dedup_key="request_id",
         doc="Sandblaster backup-task result stamped like ShardPush; first "
             "task id wins at the PS, wrong-offset traffic dropped"),
     MessageCode.RangeInstall: PayloadSchema(
         fields=("lo_lo", "lo_hi", "hi_lo", "hi_hi"), rest="values",
         handled_by=("coord",),
+        dedup_key="idempotent",
         doc="worker seeds a freshly-acquired shard range; first install "
             "wins"),
     MessageCode.SnapshotRequest: PayloadSchema(
         fields=("snap_lo", "snap_hi", "map_lo", "map_hi"),
         handled_by=("coord",),
+        dedup_key="request_id",
         doc="coordinator -> shard servers: checkpoint at your next version "
             "boundary under this snapshot id / shard-map version"),
     MessageCode.SnapshotDone: PayloadSchema(
@@ -268,18 +367,21 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
                 "hi_lo", "hi_hi", "apply_lo", "apply_hi", "push_lo",
                 "push_hi"),
         handled_by=("coord",),
+        dedup_key="request_id",
         doc="shard -> coordinator: checkpoint taken (range + apply seq + "
             "push count); the coordinator assembles the FleetManifest"),
     MessageCode.SubmitRequestV2: PayloadSchema(
         fields=("id", "max_new", "temperature", "top_k", "top_p", "seed",
                 "eos", "priority", "deadline_ms", "session"),
         rest="prompt", rest_min=1, handled_by=("serving",),
+        dedup_key="request_id",
         doc="client -> engine with overload-plane metadata: priority "
             "(higher wins admission under shed), deadline_ms (0 = none; "
             "relative to submit) and session (affinity hint)"),
     MessageCode.ShardPush: PayloadSchema(
         fields=("ver_lo", "ver_hi", "lo_lo", "lo_hi", "hi_lo", "hi_hi"),
         rest="params", rest_min=1, handled_by=("coord",),
+        dedup_key="env_seq", durability="wal_before_ack",
         doc="elastic worker -> shard server: GradientUpdate stamped with "
             "the sender's shard-map version AND the absolute [lo,hi) it "
             "sliced — the RANGE is the correctness gate (closes the "
@@ -288,12 +390,14 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
     MessageCode.ShardParams: PayloadSchema(
         fields=("ver_lo", "ver_hi", "lo_lo", "lo_hi", "hi_lo", "hi_hi"),
         rest="params", rest_min=1, handled_by=("ps",),
+        dedup_key="version",
         doc="elastic shard server -> worker: pull reply stamped like "
             "ShardPush (the versioned ParameterUpdate); the worker applies "
             "only a reply whose range matches its current expectation"),
     MessageCode.CumAck: PayloadSchema(
         fields=("inc_lo", "inc_hi", "cum_lo", "cum_hi", "credit"),
         handled_by=("transport",),
+        delivery="envelope",
         doc="batched cumulative ack: every seq <= cum of the echoed "
             "incarnation is acknowledged at once, and the receiver "
             "piggybacks its advertised send-window credit (the "
@@ -301,6 +405,7 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
             "instead of one ReliableAck per frame"),
     MessageCode.UpdateNack: PayloadSchema(
         fields=("reason", "norm", "z"), handled_by=("ps",),
+        dedup_key="env_seq",
         doc="server -> worker: your GradientUpdate/ShardPush was QUARANTINED "
             "by the admission gate (utils/health.py) — reason is a NACK_* "
             "code, norm/z the offending magnitude (clamped finite for the "
@@ -310,6 +415,7 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
         fields=("roll_lo", "roll_hi", "snap_lo", "snap_hi", "map_lo",
                 "map_hi", "phase"),
         handled_by=("coord",),
+        dedup_key="request_id",
         doc="coordinator -> everyone: the auto-rollback barrier (ISSUE 8). "
             "phase 0 = start (shards restore the named FleetManifest "
             "snapshot in place, workers drop in-flight accumulators and "
@@ -320,12 +426,14 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
         fields=("roll_lo", "roll_hi", "map_lo", "map_hi", "lo_lo", "lo_hi",
                 "hi_lo", "hi_hi", "apply_lo", "apply_hi"),
         handled_by=("coord",),
+        dedup_key="request_id",
         doc="shard -> coordinator: range [lo,hi) restored to the manifest "
             "snapshot at apply_seq under this map version; all-reported "
             "completes the rollback barrier (MTTR measured)"),
     MessageCode.ActivationShip: PayloadSchema(
         fields=("step_lo", "step_hi", "mb", "kind", "ver_lo", "ver_hi"),
         rest="payload", rest_min=1, handled_by=("ps",),
+        dedup_key="step_mb",
         doc="MPMD pipeline data plane (ISSUE 10): stage s -> s+1 activation "
             "hand-off for (step, microbatch), stamped with the sender's "
             "StagePlacement version. kind 0 = activation, 1 = tokens "
@@ -337,12 +445,14 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
     MessageCode.ActivationGrad: PayloadSchema(
         fields=("step_lo", "step_hi", "mb", "ver_lo", "ver_hi"),
         rest="payload", rest_min=1, handled_by=("ps",),
+        dedup_key="step_mb",
         doc="MPMD backward hand-off: stage s+1 -> s activation cotangent "
             "for (step, microbatch); same (step, mb) dedup discipline as "
             "ActivationShip (no microbatch's gradient applied twice)"),
     MessageCode.StageReady: PayloadSchema(
         fields=("stage", "inc_lo", "inc_hi", "wm_lo", "wm_hi"),
         handled_by=("coord",),
+        dedup_key="incarnation",
         doc="stage member -> coordinator: I serve pipeline stage `stage` "
             "at microbatch watermark wm (= step * n_microbatches, the "
             "global count my checkpoint has applied). A restarted member "
@@ -352,6 +462,7 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
         fields=("ver_lo", "ver_hi", "n_stages", "n_params_lo",
                 "n_params_hi"),
         rest="entries", handled_by=("coord",),
+        dedup_key="version",
         doc="coordinator -> everyone: the versioned StagePlacement "
             "(coord/stages.py; 10 floats per entry: stage, rank, inc "
             "halves, lo/hi halves, watermark halves). Neighbors react to "

@@ -124,6 +124,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "distmodel: bounded protocol model checking (analysis/"
+        "distmodel.py — exactly-once / lease / watermark-replay "
+        "invariants, the seeded-mutation soundness corpus, and the "
+        "counterexample-to-chaos replays against the real transport "
+        "stack — ISSUE 13); `make distmodel` runs the checker itself, "
+        "these tests run in tier-1",
+    )
+    config.addinivalue_line(
+        "markers",
         "netweather: adaptive-wire tests under network weather "
         "(utils/chaos.WeatherRule + the RTO/window/breaker machinery in "
         "utils/messaging.ReliableTransport); `make netweather` selects "

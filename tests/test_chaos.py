@@ -18,6 +18,7 @@ Fast seeded cases carry the ``chaos`` marker and run in tier-1
 ``slow``.
 """
 
+import json
 import threading
 import time
 
@@ -83,6 +84,38 @@ def test_faulty_transport_decisions_are_seeded_and_channel_local():
     assert got_a == got_b
     assert len(got_a) < 60  # drops happened
     assert "drop" in log_a and "dup" in log_a
+
+
+def test_chaos_plan_json_roundtrip_is_exact():
+    """ISSUE 13: counterexamples from the bounded model checker travel as
+    ChaosPlan JSON — the round trip must be identity across all three
+    rule families, and unknown fields must fail loudly (a typo'd field
+    silently weakening a replayed counterexample is the one wrong
+    answer)."""
+    from distributed_ml_pytorch_tpu.utils.chaos import (
+        SDCRule,
+        WeatherRule,
+        plan_from_json,
+        plan_to_json,
+    )
+
+    plan = ChaosPlan(
+        rules=[FaultRule(src=1, dst=0, code=int(MessageCode.ReliableFrame),
+                         drop=1.0, after=2, until=3),
+               FaultRule(dup=0.5, delay=0.01, delay_p=0.25)],
+        seed=41,
+        weather=[WeatherRule(src=0, latency=0.002, jitter=0.001,
+                             bandwidth=1e6)],
+        sdc=[SDCRule(code=int(MessageCode.GradientUpdate), p=1.0,
+                     kind="scale", factor=-2.0, skip=6)])
+    data = plan_to_json(plan)
+    assert plan_from_json(json.loads(json.dumps(data))) == plan
+    # defaults are omitted from the wire form, not round-tripped as noise
+    assert "weather" not in plan_to_json(ChaosPlan(seed=7))
+    with pytest.raises(ValueError, match="unknown ChaosPlan fields"):
+        plan_from_json({"seed": 0, "ruels": []})
+    with pytest.raises(ValueError, match="unknown FaultRule fields"):
+        plan_from_json({"rules": [{"dorp": 1.0}]})
 
 
 def test_fault_rule_windows_and_code_match():

@@ -1055,6 +1055,199 @@ def test_multiline_suppression_covers_next_code_line(tmp_path):
     assert not active and [f.code for f in suppressed] == ["DC201"]
 
 
+# ------------------------------------------------ DC4xx: protocol model
+
+_PROTO_MESSAGING = """
+    import enum
+
+    class MessageCode(enum.IntEnum):
+        Push = 0
+        Join = 1
+        Fleet = 2
+
+    class PayloadSchema:
+        def __init__(self, fields=(), rest=None, rest_min=0, handled_by=(),
+                     dedup_key=None, durability="none", delivery="reliable",
+                     rest_sections=(), rest_separator=None):
+            self.fields = fields
+            self.rest = rest
+            self.handled_by = handled_by
+            self.dedup_key = dedup_key
+
+    WIRE_SCHEMAS = {
+        MessageCode.Push: PayloadSchema(
+            rest="params", handled_by=("ps",),
+            dedup_key="env_seq", durability="wal_before_ack"),
+        MessageCode.Join: PayloadSchema(
+            fields=("inc",), handled_by=("coord",),
+            dedup_key="incarnation"),
+        MessageCode.Fleet: PayloadSchema(
+            fields=("v",), rest="tail", handled_by=("coord",),
+            dedup_key="version",
+            rest_sections=("ranks", "metrics"), rest_separator=-1.0),
+    }
+"""
+
+_PROTO_SERVER = """
+    from fixturepkg.utils.messaging import MessageCode
+
+    class Shard:
+        def handle(self, code, payload, delta):
+            if code == MessageCode.Push:
+                self.wal.append(self.seq, delta)
+                self.central += delta
+
+        def commit(self):
+            self.wal.sync()
+            self.transport.ack_delivered()
+"""
+
+_PROTO_HUB = """
+    from fixturepkg.utils.messaging import MessageCode
+
+    def decode_fleet(payload):
+        tail = payload[1:]
+        split = [i for i, v in enumerate(tail) if v < 0]
+        ranks = tail[:split[0]] if split else tail
+        metrics = tail[split[0] + 1:] if split else []
+        return {"ranks": list(ranks), "metrics": list(metrics)}
+
+    class Hub:
+        def handle(self, sender, code, payload):
+            if code == MessageCode.Join and payload.size >= 1:
+                inc = payload[0]
+                if inc < self.member_inc:
+                    return
+                self.member_inc = inc
+            if code == MessageCode.Fleet and payload.size >= 1:
+                self.view = decode_fleet(payload)
+"""
+
+_PROTO_SENDERS = """
+    import numpy as np
+    from fixturepkg.utils.messaging import MessageCode
+
+    def push(transport, grad):
+        transport.send(MessageCode.Push, grad)
+
+    def announce(transport, inc, frame):
+        transport.send(MessageCode.Join,
+                       np.asarray([float(inc)], np.float32))
+        transport.send(MessageCode.Fleet, frame)
+"""
+
+
+def _proto_files(**overrides):
+    files = {
+        "utils/messaging.py": _PROTO_MESSAGING,
+        "parallel/server.py": _PROTO_SERVER,
+        "coord/hub.py": _PROTO_HUB,
+        "parallel/worker.py": _PROTO_SENDERS,
+    }
+    files.update(overrides)
+    return files
+
+
+def test_proto_clean_twin_is_silent(tmp_path):
+    active, _ = _run(tmp_path, _proto_files())
+    assert not active, [f.render() for f in active]
+
+
+def test_dc401_reliable_send_without_dedup_key(tmp_path):
+    broken = _proto_files(**{"utils/messaging.py": _PROTO_MESSAGING.replace(
+        'dedup_key="env_seq", durability="wal_before_ack"',
+        'durability="wal_before_ack"')})
+    active, _ = _run(tmp_path, broken)
+    assert "DC401" in _codes(active)
+    assert any("no dedup_key" in f.message for f in active)
+
+
+def test_dc401_vocabulary_and_delivery_mismatch(tmp_path):
+    broken = _proto_files(**{"utils/messaging.py": _PROTO_MESSAGING.replace(
+        'dedup_key="env_seq"', 'dedup_key="vibes"')})
+    active, _ = _run(tmp_path, broken)
+    assert "DC401" in _codes(active)
+    assert any("vocabulary" in f.message for f in active)
+
+
+def test_dc402_apply_before_wal_append(tmp_path):
+    broken = _proto_files(**{"parallel/server.py": _PROTO_SERVER.replace(
+        """self.wal.append(self.seq, delta)
+                self.central += delta""",
+        """self.central += delta
+                self.wal.append(self.seq, delta)""")})
+    active, _ = _run(tmp_path, broken)
+    assert _codes(active) == ["DC402"]
+    assert "BEFORE the WAL append" in active[0].message
+
+
+def test_dc403_ack_released_before_group_fsync(tmp_path):
+    broken = _proto_files(**{"parallel/server.py": _PROTO_SERVER.replace(
+        """self.wal.sync()
+            self.transport.ack_delivered()""",
+        """self.transport.ack_delivered()
+            self.wal.sync()""")})
+    active, _ = _run(tmp_path, broken)
+    assert _codes(active) == ["DC403"]
+    assert "BEFORE the WAL group-fsync" in active[0].message
+
+
+def test_dc404_incarnation_update_without_gate(tmp_path):
+    broken = _proto_files(**{"coord/hub.py": _PROTO_HUB.replace(
+        """inc = payload[0]
+                if inc < self.member_inc:
+                    return
+                self.member_inc = inc""",
+        """self.member = payload[0]""")})
+    active, _ = _run(tmp_path, broken)
+    assert _codes(active) == ["DC404"]
+    assert "incarnation" in active[0].message
+
+
+def test_dc405_multi_section_tail_needs_separator(tmp_path):
+    broken = _proto_files(**{"utils/messaging.py": _PROTO_MESSAGING.replace(
+        "rest_sections=(\"ranks\", \"metrics\"), rest_separator=-1.0",
+        "rest_sections=(\"ranks\", \"metrics\")")})
+    active, _ = _run(tmp_path, broken)
+    assert "DC405" in _codes(active)
+    assert any("without a rest_separator" in f.message for f in active)
+
+
+def test_dc405_decoder_must_split_on_declared_separator(tmp_path):
+    broken = _proto_files(**{"coord/hub.py": _PROTO_HUB.replace(
+        """        tail = payload[1:]
+        split = [i for i, v in enumerate(tail) if v < 0]
+        ranks = tail[:split[0]] if split else tail
+        metrics = tail[split[0] + 1:] if split else []
+        return {"ranks": list(ranks), "metrics": list(metrics)}""",
+        """        tail = payload[1:]
+        return {"ranks": list(tail), "metrics": []}""")})
+    active, _ = _run(tmp_path, broken)
+    assert _codes(active) == ["DC405"]
+    assert "splits on it" in active[0].message
+
+
+def test_dc4xx_silent_without_protocol_annotations(tmp_path):
+    """The opt-in discipline (DC105/107/108 precedent): a schema table
+    with NO protocol-model annotations — the DC1xx fixture corpora, any
+    third-party tree — must see no DC4xx findings at all, even with a
+    reliable send and no dedup keys anywhere."""
+    active, _ = _run(tmp_path, _wire_files())
+    assert not [f for f in active if f.code.startswith("DC4")]
+
+
+# -------------------------------------------- analysis/ self-analysis
+
+def test_analysis_package_self_clean(tmp_path):
+    """The ISSUE 13 satellite: distcheck over the analyzer package
+    ITSELF (concurrency + tracing + protocol rules all apply to the
+    checker's own code) must be clean — no findings, no stale
+    suppressions."""
+    root = os.path.join(_package_root(), "analysis")
+    active, _ = analyze_path(root, rel_base=os.path.dirname(_package_root()))
+    assert not active, [f.render() for f in active]
+
+
 # ------------------------------------------------- the real package
 
 def _package_root():

@@ -1,0 +1,152 @@
+"""WIRE_SCHEMAS totality fast-tests (ISSUE 13 satellite).
+
+Until now only ``make lint`` (the full analyzer run) checked that the
+declarative schema table stays total over the ``MessageCode`` enum; these
+plain tier-1 units fail a schema drift in milliseconds:
+
+- every enum member has a schema entry, and no schema names a ghost code;
+- code values are collision-free (IntEnum would silently alias);
+- every schema's ``handled_by`` plane names at least one real handler
+  site in the package source;
+- the ISSUE 13 protocol annotations are complete and vocabulary-valid:
+  every reliably-delivered code declares its dedup key, durability only
+  decorates reliable codes, ``delivery='best_effort'`` agrees exactly
+  with ``ReliableTransport.unreliable_codes``, and an evolved
+  multi-section tail declares its separator.
+"""
+
+import inspect
+import os
+
+import pytest
+
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    DEDUP_KEYS,
+    DELIVERY,
+    DURABILITY,
+    MessageCode,
+    PayloadSchema,
+    ReliableTransport,
+    WIRE_SCHEMAS,
+)
+
+
+def test_every_code_has_a_schema_and_no_ghosts():
+    missing = [c.name for c in MessageCode if c not in WIRE_SCHEMAS]
+    assert not missing, f"codes without a WIRE_SCHEMAS entry: {missing}"
+    ghosts = [c for c in WIRE_SCHEMAS if not isinstance(c, MessageCode)]
+    assert not ghosts, f"schemas for non-enum keys: {ghosts}"
+
+
+def test_code_values_are_collision_free():
+    # IntEnum aliases a colliding member silently: __members__ keeps the
+    # alias name, so a collision shows up as more names than values
+    members = MessageCode.__members__
+    assert len({int(v) for v in members.values()}) == len(members), (
+        "MessageCode values collide — IntEnum aliased a member and its "
+        "frames dispatch to the wrong handler")
+
+
+def test_handled_by_planes_are_known_and_nonempty():
+    valid = {"ps", "serving", "coord", "transport"}
+    for code, schema in WIRE_SCHEMAS.items():
+        assert schema.handled_by, f"{code.name}: empty handled_by"
+        assert set(schema.handled_by) <= valid, (
+            f"{code.name}: unknown plane(s) {schema.handled_by}")
+
+
+@pytest.fixture(scope="module")
+def handler_sites():
+    """Positive dispatch sites per (code name, plane), from the same AST
+    extraction the analyzer uses — parsing only, no checker run."""
+    import distributed_ml_pytorch_tpu as pkg
+    from distributed_ml_pytorch_tpu.analysis.core import load_package
+    from distributed_ml_pytorch_tpu.analysis.wire import extract_handlers
+
+    tree = load_package(os.path.dirname(os.path.abspath(pkg.__file__)))
+    return extract_handlers(tree)
+
+
+def test_every_schema_plane_names_a_real_handler(handler_sites):
+    by_code = {}
+    for h in handler_sites:
+        by_code.setdefault(h.code, set()).add(h.plane)
+    orphans = []
+    for code, schema in WIRE_SCHEMAS.items():
+        if not by_code.get(code.name, set()) & set(schema.handled_by):
+            orphans.append((code.name, schema.handled_by))
+    assert not orphans, (
+        "schemas whose declared plane has no real handler site: "
+        f"{orphans}")
+
+
+# --------------------------------------- ISSUE 13 protocol annotations
+
+def test_annotation_vocabularies_are_enforced_at_construction():
+    with pytest.raises(ValueError, match="dedup_key"):
+        PayloadSchema(dedup_key="vibes")
+    with pytest.raises(ValueError, match="durability"):
+        PayloadSchema(durability="hopes")
+    with pytest.raises(ValueError, match="delivery"):
+        PayloadSchema(delivery="carrier-pigeon")
+    with pytest.raises(ValueError, match="rest_separator"):
+        PayloadSchema(rest="tail", rest_sections=("a", "b"))
+
+
+def test_every_reliable_code_declares_a_dedup_key():
+    bare = [c.name for c, s in WIRE_SCHEMAS.items()
+            if s.delivery == "reliable" and s.dedup_key is None]
+    assert not bare, (
+        "reliably-delivered codes with no dedup_key (at-least-once "
+        f"redelivery with no exactly-once guard): {bare}")
+
+
+def test_annotations_stay_inside_their_vocabularies():
+    for code, s in WIRE_SCHEMAS.items():
+        assert s.dedup_key is None or s.dedup_key in DEDUP_KEYS, code.name
+        assert s.durability in DURABILITY, code.name
+        assert s.delivery in DELIVERY, code.name
+
+
+def test_durability_only_decorates_reliable_wal_codes():
+    for code, s in WIRE_SCHEMAS.items():
+        if s.durability == "wal_before_ack":
+            assert s.delivery == "reliable", (
+                f"{code.name}: WAL-before-ack is meaningless without "
+                "reliable delivery (nothing withholds the ack)")
+            assert s.dedup_key == "env_seq", (
+                f"{code.name}: WAL'd codes dedup by the envelope "
+                "identity the WAL records (seed_dedup)")
+
+
+def test_best_effort_annotation_matches_unreliable_codes_default():
+    sig = inspect.signature(ReliableTransport.__init__)
+    default = {MessageCode(int(c))
+               for c in sig.parameters["unreliable_codes"].default}
+    annotated = {c for c, s in WIRE_SCHEMAS.items()
+                 if s.delivery == "best_effort"}
+    assert annotated == default, (
+        f"delivery='best_effort' annotations {sorted(c.name for c in annotated)} "
+        "disagree with ReliableTransport.unreliable_codes "
+        f"{sorted(c.name for c in default)}")
+
+
+def test_envelope_codes_are_exactly_the_reliability_wire():
+    annotated = {c.name for c, s in WIRE_SCHEMAS.items()
+                 if s.delivery == "envelope"}
+    assert annotated == {"ReliableFrame", "ReliableAck", "CumAck"}
+
+
+def test_multi_section_tails_declare_rest_and_separator():
+    for code, s in WIRE_SCHEMAS.items():
+        if s.rest_sections:
+            assert s.rest is not None, code.name
+            assert len(s.rest_sections) >= 2, code.name
+            assert s.rest_separator is not None, code.name
+    fleet = WIRE_SCHEMAS[MessageCode.FleetState]
+    assert fleet.rest_sections == ("engine_ranks", "fleet_metrics")
+    from distributed_ml_pytorch_tpu.coord.coordinator import (
+        FLEET_TAIL_SEPARATOR,
+    )
+
+    assert fleet.rest_separator == FLEET_TAIL_SEPARATOR
