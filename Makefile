@@ -179,6 +179,12 @@ netweather:
 bench-wire:
 	$(PY) bench_all.py --only transport_microbench --only reliability
 
+# compressed gradient wire ladder (ISSUE 14, utils/compress.py): dense vs
+# int8 vs top-k bytes-on-wire per push + acked pushes/s against a real
+# decoding ParameterServer, plus the derived compression ratios
+bench-wire-bytes:
+	$(PY) bench_all.py --only wire_bytes
+
 # distcheck (analysis/): protocol / concurrency / tracing-hygiene static
 # analysis over the whole package — exits non-zero on any unsuppressed
 # finding that is not in the checked-in baseline. Regenerate the baseline
@@ -227,4 +233,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-health bench-gate bench-compute bench-mpmd timeline chaos coord drill drill-demo fleet health health-demo mpmd mpmd-demo netweather soak lint distmodel test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-wire-bytes bench-health bench-gate bench-compute bench-mpmd timeline chaos coord drill drill-demo fleet health health-demo mpmd mpmd-demo netweather soak lint distmodel test test-all verify-real-data graph install dist

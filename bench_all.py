@@ -1965,6 +1965,109 @@ def multiprocess_psum_phase(n: int = 4, rounds: int = 20) -> None:
          "2-device row")
 
 
+def wire_bytes_phase() -> None:
+    """Config 7, compressed-wire ladder (ISSUE 14, ``--only wire_bytes``,
+    ``make bench-wire-bytes``): dense vs int8 vs top-k bytes-on-wire per
+    push and acked push round-trips/s on the real raveled-AlexNet PS push
+    path — in-process transports + the reliability envelope + a real
+    ``ParameterServer`` decoding every frame, so the codec's encode AND
+    decode CPU are inside the measured loop (the honest per-push cost,
+    labelled in-process; the 9.9 MB echo baseline for the same payload
+    over real TCP is ``reliability_phase``). Bytes are exact frame
+    arithmetic, not estimates."""
+    import threading
+
+    from distributed_ml_pytorch_tpu.parallel.async_ps import ParameterServer
+    from distributed_ml_pytorch_tpu.utils.compress import (
+        CompressingEncoder,
+        make_codec,
+    )
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        MessageCode,
+        make_world,
+    )
+
+    n = 2_472_266  # raveled AlexNet size — the 9.9 MB dense frame
+    rng = np.random.default_rng(0)
+    n_iter = 12
+    rates: dict = {}
+    bytes_per_push: dict = {}
+    for mode in ("dense", "int8", "topk"):
+        world, t, stop = None, None, None
+        try:
+            # setup rides INSIDE the try: a failed rung (construction
+            # included) logs and yields to the next mode, never kills
+            # the whole table
+            world, _log = make_world(
+                2, reliable=True,
+                reliable_opts={"ack_timeout": 5.0, "max_backoff": 10.0})
+            ps = ParameterServer(params=np.zeros(n, np.float32),
+                                 transport=world[0])
+            stop = threading.Event()
+
+            def serve():
+                while not stop.is_set():
+                    msg = world[0].recv(timeout=0.2)
+                    if msg is None:
+                        continue
+                    ps.handle(msg[0], msg[1], msg[2])
+
+            t = threading.Thread(target=serve, daemon=True)
+            t.start()
+            enc = (None if mode == "dense" else CompressingEncoder(
+                n, make_codec(mode, block=1024, k_frac=0.01)))
+            vec = rng.normal(scale=0.01, size=n).astype(np.float32)
+
+            def push():
+                if enc is None:
+                    world[1].send(MessageCode.GradientUpdate, vec, dst=0)
+                    return n * 4
+                head, body = enc.encode_range(vec, 0, n)
+                world[1].sendv(MessageCode.CompressedUpdate, (head, body),
+                               dst=0)
+                return int((head.size + body.size) * 4)
+            push()  # warm both directions (+ the server's first decode)
+            world[1].flush(timeout=60)
+            t0 = time.perf_counter()
+            nbytes = 0
+            for _ in range(n_iter):
+                nbytes = push()
+                # flush per push: the rate includes the ack round trip,
+                # matching the dense echo baseline's send+reply discipline
+                world[1].flush(timeout=60)
+            dt = time.perf_counter() - t0
+            rates[mode] = n_iter / dt
+            bytes_per_push[mode] = nbytes
+            emit(7, f"ps_wire_bytes_per_push_{mode}", nbytes, "bytes",
+                 "in-process, reliable envelope",
+                 f"exact frame bytes of one {mode} push of the "
+                 f"{n}-param vector (envelope header excluded: +36 B "
+                 "either way); decoded server-side inside the loop")
+            emit(7, f"ps_push_roundtrips_{mode}", rates[mode],
+                 "pushes/sec", "in-process, reliable envelope",
+                 f"acked {mode} pushes/s incl. encode + decode + apply "
+                 f"({nbytes * rates[mode] / 1e6:.1f} MB/s on-wire); "
+                 "dense TCP echo baseline: reliability_phase")
+        except Exception as e:  # noqa: BLE001 — a failed rung must not
+            log(f"wire_bytes bench ({mode}) failed: {e}")  # kill the table
+        finally:
+            if stop is not None:
+                stop.set()
+            if t is not None:
+                t.join(timeout=10)
+            for tr in (world or {}).values():
+                tr.close()
+    for mode in ("int8", "topk"):
+        if mode in bytes_per_push and "dense" in bytes_per_push:
+            emit(7, f"ps_wire_compression_ratio_{mode}",
+                 bytes_per_push["dense"] / bytes_per_push[mode],
+                 "x fewer bytes", "derived",
+                 f"dense / {mode} bytes-on-wire per push (error-feedback "
+                 "encoder, utils/compress.py); the acceptance bar is "
+                 ">= 3x with convergence in the fault-free corridor "
+                 "(tests/test_compress.py)")
+
+
 #: phases addressable via ``--only`` (``make bench-wire`` runs the wire
 #: legs without paying for the full table)
 PHASES = {
@@ -1979,6 +2082,7 @@ PHASES = {
     "transport": lambda: transport_phase(),
     "reliability": lambda: reliability_phase(),
     "transport_microbench": lambda: transport_microbench_phase(),
+    "wire_bytes": lambda: wire_bytes_phase(),
     "compute_microbench": lambda: compute_microbench_phase(),
     "cpu_mesh": lambda: cpu_mesh_phase(),
     "multiprocess_psum": lambda: multiprocess_psum_phase(),
@@ -2009,6 +2113,7 @@ def main(argv=None) -> None:
     transport_phase()
     reliability_phase()
     transport_microbench_phase()
+    wire_bytes_phase()
     compute_microbench_phase()
     cpu_mesh_phase()
     # LAST: the 4 gloo subprocesses leave the 1-core host briefly saturated

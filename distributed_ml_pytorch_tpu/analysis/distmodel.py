@@ -24,6 +24,12 @@ invariants the seeded acceptance scenarios only sample:
   neighbor's watermark-bounded replay. Invariants: *no microbatch applied
   twice* and *watermark replay fills every hole* (a quiescent pipeline has
   no gap below its frontier).
+- **copt** — the compressed optimizer-plane push path (ISSUE 14): lossy
+  quantized pushes with per-worker error feedback, silent corruption of
+  in-flight compressed frames, a server that admission-gates on the
+  DECODED norm. Invariants: *quiescent error bound* (quantization error
+  is deferred via the residual, never compounded) and *no poison
+  applied* (a decoded outlier never reaches the applied sum).
 
 Exploration is exhaustive breadth-first over SMALL configurations (2
 workers x 2 updates; 2 lives; 3-stage pipeline slice with 2 steps x 2
@@ -35,7 +41,8 @@ exactly what a seeded scenario suite cannot do.
 **Mutations** re-run a model with one protocol guard removed (the
 soundness corpus: ``ack_before_fsync``, ``no_dedup``,
 ``no_seed_on_restore``, ``no_incarnation_gate``, ``watermark_off_by_one``,
-``no_mb_dedup``); the checker must find a counterexample for each. Every
+``no_mb_dedup``, ``no_error_feedback``, ``decode_before_admission``); the
+checker must find a counterexample for each. Every
 counterexample is emitted as a JSON artifact carrying the event trace, a
 concrete :class:`~.chaos.ChaosPlan` (deterministic windowed fault rules
 derived from the trace's drop/dup events), a crash script, and a pytest
@@ -526,11 +533,121 @@ class MpmdModel(Model):
 
 
 # =====================================================================
+# copt — compressed-push error feedback + decode-before-admission
+# =====================================================================
+
+class CompressModel(Model):
+    """The compressed optimizer-plane push path (ISSUE 14): one worker
+    pushes ``n_updates`` fixed-value updates through a lossy quantizer
+    (floor to multiples of ``Q`` — the abstract int8/topk), carrying a
+    per-worker error-feedback residual; an SDC budget may silently
+    corrupt an in-flight frame into a poison whose DECODED magnitude
+    dwarfs the admission gate while its encoded bytes look ordinary; the
+    server admission-gates on the decoded value and applies.
+
+    State ::
+
+        (next,        # next update index to push
+         residual,    # worker-side error-feedback carry
+         net,         # in-flight frames: sorted (idx, decoded_value)
+         applied,     # server's applied sum
+         sent,        # true sum of raw update values pushed so far
+         poisoned,    # sticky: a poison's decoded value was APPLIED
+         sdc,         # remaining silent-corruption budget
+         sdc_used)    # any corruption happened (disables the EF bound)
+
+    Invariants: *quiescent error bound* — with no corruption, once every
+    push is delivered, ``|applied + residual - sent| == 0`` and
+    ``residual < Q`` (the error-feedback identity: quantization error is
+    deferred, never compounded); *no poison applied* — a frame whose
+    decoded magnitude exceeds the gate never reaches the applied sum.
+
+    Mutations: ``no_error_feedback`` (the residual is dropped — each
+    push's quantization error is lost forever, the sum drifts past Q);
+    ``decode_before_admission`` (the handler gates before/without
+    decoding, so compressed traffic slips the gate — the poison's decoded
+    value applies). SDC is only enabled at or past frame index
+    :data:`_WARMUP` — the real gate's z-score needs admitted history, and
+    the replayed chaos schedule bakes the same warmup in.
+    """
+
+    name = "copt"
+
+    #: update values and quantization step: 3 // 4 -> 0, so without error
+    #: feedback EVERY push quantizes to zero and the drift is maximal
+    _VALUES = (3, 3, 3, 3, 3)
+    _Q = 4
+    _GATE = 100
+    _POISON = 1000
+    _WARMUP = 2
+
+    def __init__(self, n_updates: int = 5, sdc: int = 1,
+                 mutation: Optional[str] = None):
+        self.n_updates = min(n_updates, len(self._VALUES))
+        self.mutation = mutation
+        self.sdc = sdc
+
+    def initial(self):
+        return (0, 0, (), 0, 0, False, self.sdc, False)
+
+    def successors(self, st):
+        nxt, residual, net, applied, sent, poisoned, sdc, sdc_used = st
+        mut = self.mutation
+        out = []
+        if nxt < self.n_updates:
+            v = self._VALUES[nxt]
+            if mut == "no_error_feedback":
+                q, new_res = (v // self._Q) * self._Q, 0
+            else:
+                p = v + residual
+                q = (p // self._Q) * self._Q
+                new_res = p - q
+            out.append((("push", nxt, q), (
+                nxt + 1, new_res, tuple(sorted(net + ((nxt, q),))),
+                applied, sent + v, poisoned, sdc, sdc_used)))
+        for frame in sorted(set(net)):
+            idx, val = frame
+            if sdc > 0 and val != self._POISON and idx >= self._WARMUP:
+                lst = list(net)
+                lst.remove(frame)
+                out.append((("sdc", idx), (
+                    nxt, residual,
+                    tuple(sorted(lst + [(idx, self._POISON)])),
+                    applied, sent, poisoned, sdc - 1, True)))
+            lst = list(net)
+            lst.remove(frame)
+            if mut != "decode_before_admission" and val > self._GATE:
+                # admission on the DECODED value: poison quarantined
+                out.append((("deliver", idx, "rejected"), (
+                    nxt, residual, tuple(lst), applied, sent,
+                    poisoned, sdc, sdc_used)))
+            else:
+                out.append((("deliver", idx, val), (
+                    nxt, residual, tuple(lst), applied + val, sent,
+                    poisoned or val == self._POISON, sdc, sdc_used)))
+        return out
+
+    def invariant(self, st):
+        nxt, residual, net, applied, sent, poisoned, sdc, sdc_used = st
+        if poisoned:
+            return ("poisoned decoded update admitted: the gate never saw "
+                    "the decoded norm (compressed traffic slipped it)")
+        if not sdc_used and nxt == self.n_updates and not net:
+            if applied + residual != sent or not 0 <= residual < self._Q:
+                return (f"error-feedback bound violated: applied {applied} "
+                        f"+ residual {residual} != sent {sent} at "
+                        "quiescence — quantization error was dropped, not "
+                        "deferred")
+        return None
+
+
+# =====================================================================
 # registry + counterexample emission
 # =====================================================================
 
 MODELS: Dict[str, Callable[..., Model]] = {
-    "ps": PSModel, "lease": LeaseModel, "mpmd": MpmdModel}
+    "ps": PSModel, "lease": LeaseModel, "mpmd": MpmdModel,
+    "copt": CompressModel}
 
 #: mutation name -> the model it breaks (the soundness corpus)
 MUTATIONS: Dict[str, str] = {
@@ -540,11 +657,13 @@ MUTATIONS: Dict[str, str] = {
     "no_incarnation_gate": "lease",
     "watermark_off_by_one": "mpmd",
     "no_mb_dedup": "mpmd",
+    "no_error_feedback": "copt",
+    "decode_before_admission": "copt",
 }
 
 #: per-model depth the `make distmodel` gate explores to (deep enough to
 #: cover every mutation's counterexample; small enough to stay seconds)
-DEFAULT_DEPTH = {"ps": 12, "lease": 10, "mpmd": 12}
+DEFAULT_DEPTH = {"ps": 12, "lease": 10, "mpmd": 12, "copt": 12}
 
 
 def _chaos_plan_for(result: Result) -> dict:
@@ -604,7 +723,25 @@ def _chaos_plan_for(result: Result) -> dict:
             rules.append(FaultRule(
                 src=0, dst=1, code=int(MessageCode.ActivationShip),
                 dup=1.0, after=int(ev[1]), until=int(ev[1]) + 1))
-    return plan_to_json(ChaosPlan(rules=rules, seed=0))
+    sdc_rules = []
+    if result.model == "copt":
+        from distributed_ml_pytorch_tpu.utils.chaos import SDCRule
+        from distributed_ml_pytorch_tpu.utils.compress import HEAD_LEN
+
+        for ev in result.trace or []:
+            if ev[0] == "sdc":
+                # scale the BODY (skip = the 12-float compressed head) by
+                # a huge factor: decoded norm explodes, the frame stays
+                # wire-perfect (chaos re-stamps body + envelope CRCs) —
+                # only a gate on the DECODED norm can see it. Windowed to
+                # the poisoned push's envelope seq on the worker->server
+                # channel, exactly like the model's frame index.
+                i = int(ev[1])
+                sdc_rules.append(SDCRule(
+                    src=1, dst=0, code=int(MessageCode.CompressedUpdate),
+                    p=1.0, kind="scale", factor=1e30, skip=HEAD_LEN,
+                    after=i, until=i + 1))
+    return plan_to_json(ChaosPlan(rules=rules, seed=0, sdc=sdc_rules))
 
 
 _STUB_REAL = '''\
@@ -969,10 +1106,133 @@ def _replay_no_seed_on_restore(ce: dict, workdir: str,
     return violations
 
 
+def _replay_no_error_feedback(ce: dict, workdir: str,
+                              mutated: bool) -> List[str]:
+    """The compressed-push stack end to end: a worker pushes the SAME
+    update 8 times through a top-1 sparsifier over the reliability
+    envelope into a WAL'd server. With error feedback the exact identity
+    ``sum(decoded) == sum(raw) - residual`` bounds the drift by one
+    residual (<= 12 per coordinate here, by construction); mutated
+    (residual dropped) only the single largest coordinate ever ships and
+    the others drift by the full 8-push sum (32) — the model's
+    quiescent-error-bound violation on the real wire."""
+    import numpy as np
+
+    from distributed_ml_pytorch_tpu.utils.compress import (
+        CompressingEncoder,
+        make_codec,
+    )
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        InProcessTransport,
+        MessageCode,
+        ReliableTransport,
+    )
+
+    world = InProcessTransport.create_world(2)
+    srv = ReliableTransport(world[0], ack_on_delivery=False,
+                            ack_timeout=0.05)
+    wrk = ReliableTransport(world[1], ack_timeout=5.0, max_backoff=10.0)
+    ps = _mk_ps(workdir, srv)
+    enc = CompressingEncoder(4, make_codec("topk", k_frac=0.25),
+                             error_feedback=not mutated)
+    u = np.asarray([8.0, 4.0, 2.0, 1.0], np.float32)
+    n_push = 8
+    for _ in range(n_push):
+        head, body = enc.encode_range(u, 0, 4)
+        wrk.sendv(MessageCode.CompressedUpdate, (head, body), dst=0)
+        msg = _drain(srv)
+        assert msg is not None
+        ps._envelope = srv.last_delivery
+        ps.handle(msg[0], msg[1], msg[2])
+        ps.commit()
+    true_total = n_push * u
+    drift = float(np.max(np.abs(true_total - ps.central)))
+    violations = []
+    if drift > 12.0:
+        violations.append(
+            f"error-feedback bound violated on the real stack: applied "
+            f"sum drifts {drift:.0f} from the raw sum after {n_push} "
+            "compressed pushes (quantization error dropped, not deferred)")
+    srv.detach()
+    wrk.detach()
+    for t in world.values():
+        t.close()
+    return violations
+
+
+def _replay_decode_before_admission(ce: dict, workdir: str,
+                                    mutated: bool) -> List[str]:
+    """The counterexample's SDC schedule against the real compressed-push
+    stack: chaos silently scales one push's compressed BODY by 1e30
+    (body + envelope CRCs re-stamped — bit-perfect on the wire), after
+    enough clean pushes to warm the gate's per-worker statistics. Correct
+    config: the server DECODES first, the z-score on the decoded norm
+    quarantines the poison, the central vector stays sane. Mutated (the
+    gate never sees compressed traffic — the forgotten-gate bug the
+    schema's decoded-norm contract exists to prevent): the poison
+    applies and the central norm explodes."""
+    import numpy as np
+
+    from distributed_ml_pytorch_tpu.utils.chaos import (
+        FaultyTransport,
+        plan_from_json,
+    )
+    from distributed_ml_pytorch_tpu.utils.compress import (
+        CompressingEncoder,
+        make_codec,
+    )
+    from distributed_ml_pytorch_tpu.utils.health import GradientAdmission
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        InProcessTransport,
+        MessageCode,
+        ReliableTransport,
+    )
+
+    plan = plan_from_json(ce["chaos_plan"])
+    poison_at = max((r.after for r in plan.sdc), default=2)
+    world = InProcessTransport.create_world(2)
+    chaos, _log = FaultyTransport.wrap_world(world, plan)
+    srv = ReliableTransport(chaos[0], ack_timeout=0.05)
+    wrk = ReliableTransport(chaos[1], ack_timeout=5.0, max_backoff=10.0)
+    gate = GradientAdmission(z_max=6.0, warmup=2)
+    ps = _mk_ps(workdir, srv)
+    ps.admission = None if mutated else gate
+    enc = CompressingEncoder(4, make_codec("int8", block=4))
+    rng = np.random.default_rng(7)
+    for _i in range(poison_at + 2):
+        u = rng.normal(scale=1.0, size=4).astype(np.float32)
+        head, body = enc.encode_range(u, 0, 4)
+        wrk.sendv(MessageCode.CompressedUpdate, (head, body), dst=0)
+        msg = _drain(srv)
+        assert msg is not None
+        ps._envelope = srv.last_delivery
+        ps.handle(msg[0], msg[1], msg[2])
+        ps.commit()
+    violations = []
+    central_norm = float(np.linalg.norm(
+        ps.central.astype(np.float64)))
+    if not np.isfinite(ps.central).all() or central_norm > 1e6:
+        violations.append(
+            f"poisoned decoded update admitted: central norm "
+            f"{central_norm:.3g} after the SDC push — the gate never saw "
+            "the decoded norm")
+    if not mutated and ps.quarantined < 1:
+        violations.append(
+            "clean config did not quarantine the SDC push — the decoded-"
+            "norm gate is not wired where the schema promises")
+    srv.detach()
+    wrk.detach()
+    for t in world.values():
+        t.close()
+    return violations
+
+
 _REPLAYS = {
     ("ps", "ack_before_fsync"): _replay_ack_before_fsync,
     ("ps", "no_dedup"): _replay_no_dedup,
     ("ps", "no_seed_on_restore"): _replay_no_seed_on_restore,
+    ("copt", "no_error_feedback"): _replay_no_error_feedback,
+    ("copt", "decode_before_admission"): _replay_decode_before_admission,
 }
 
 

@@ -130,6 +130,8 @@ def recovery_drill(
     batch: int = 16,
     wal_group_n: int = 4,
     fixture=None,
+    compress: str = "",
+    server_opt: str = "",
 ) -> Dict:
     """Run one kill-and-recover drill (see module docstring).
 
@@ -139,6 +141,13 @@ def recovery_drill(
     = ALL shards). ``kill_at=None`` runs the fault-free corridor baseline.
     Per-shard state (checkpoint + WAL) lives under ``base_dir/shard<i>``,
     the fleet manifest under ``base_dir``.
+
+    ``compress`` (ISSUE 14) runs the workers' pushes over the compressed
+    ``CompressedUpdate`` wire (int8/topk + error feedback) — the drill
+    then proves restore replays DECODED deltas exactly once and that the
+    WAL records carry the codec id. ``server_opt`` gives every shard a
+    ZeRO-style sharded optimizer whose per-range state must survive the
+    kill + manifest restore + WAL replay.
     """
     import jax
     import jax.numpy as jnp
@@ -196,6 +205,17 @@ def recovery_drill(
         target=coord.run, kwargs={"timeout": 600}, daemon=True)
     coord_thread.start()
 
+    def make_optimizer():
+        if not server_opt:
+            return None
+        from distributed_ml_pytorch_tpu.parallel.optplane import (
+            ShardedOptimizer,
+        )
+
+        # momentum 0.5: strong enough that lost/duplicated state would
+        # visibly change the replayed trajectory, tame enough to converge
+        return ShardedOptimizer(server_opt, 0, 0, lr=1.0, momentum=0.5)
+
     def start_server(i: int) -> ElasticShardServer:
         client = CoordClient(coord_world[1 + i], "shard",
                              renew_interval=lease / 4)
@@ -203,7 +223,8 @@ def recovery_drill(
             server_id=1 + i, n_params=n_params,
             transport=make_server_transport(i), coord=client,
             init_params=flat0, ckpt_dir=os.path.join(base_dir, f"shard{i}"),
-            ckpt_every=0, wal=True, wal_group_n=wal_group_n)
+            ckpt_every=0, wal=True, wal_group_n=wal_group_n,
+            optimizer=make_optimizer())
         t = threading.Thread(target=srv.run, kwargs={"timeout": 600},
                              daemon=True)
         t.start()
@@ -219,7 +240,7 @@ def recovery_drill(
     losses: Dict[int, list] = {}
     opts: Dict[int, object] = {}
     errors: list = []
-    restored_info = {"replayed": 0, "manifest": None}
+    restored_info = {"replayed": 0, "manifest": None, "replayed_codecs": []}
     restored_evt = threading.Event()
     if kill_at is None:
         restored_evt.set()  # corridor baseline: nothing to wait out
@@ -248,9 +269,17 @@ def recovery_drill(
                 transport=make_server_transport(i), coord=client,
                 init_params=flat0,
                 ckpt_dir=os.path.join(base_dir, f"shard{i}"),
-                ckpt_every=0, wal=True, wal_group_n=wal_group_n)
+                ckpt_every=0, wal=True, wal_group_n=wal_group_n,
+                optimizer=make_optimizer())
             srv.restore_from_manifest(manifest)
             restored_info["replayed"] += srv.ps.replayed_updates
+            # codec provenance of the surviving log (ISSUE 14): captured
+            # at restore time, before any later checkpoint truncates it —
+            # a compressed run's replayed records must say they were
+            # compressed (the WAL logs decoded deltas + codec ids)
+            recs, _stats = srv.ps.wal.replay()
+            restored_info["replayed_codecs"].extend(
+                r.codec for r in recs)
             servers[i] = srv
             t = threading.Thread(target=srv.run, kwargs={"timeout": 600},
                                  daemon=True)
@@ -298,7 +327,8 @@ def recovery_drill(
         opt = ShardedAsynchronous(
             params, lr=lr, n_push=n_push, n_pull=n_pull,
             transports=[factory(e) for e in m.entries],
-            coord=client, transport_factory=factory, shard_map=m)
+            coord=client, transport_factory=factory, shard_map=m,
+            compress=compress or None)
         opts[j] = opt
         rng = jax.random.key(100 + j)
         my_losses = losses.setdefault(j, [])
@@ -361,7 +391,9 @@ def recovery_drill(
     for i in range(n_shards):
         acked[i] = {j: (rel_workers[i][j].acked_count(
             0, MessageCode.ShardPush) + rel_workers[i][j].acked_count(
-            0, MessageCode.GradientUpdate)) for j in range(1, 1 + n_workers)}
+            0, MessageCode.GradientUpdate) + rel_workers[i][j].acked_count(
+            0, MessageCode.CompressedUpdate))
+            for j in range(1, 1 + n_workers)}
         applied[i] = {j: servers[i].ps.applied_by_sender.get(j, 0)
                       for j in range(1, 1 + n_workers)}
     accounting_ok = all(
@@ -389,6 +421,7 @@ def recovery_drill(
         "applied": applied,
         "accounting_ok": accounting_ok,
         "replayed_updates": restored_info["replayed"],
+        "replayed_codecs": restored_info["replayed_codecs"],
         "manifest": restored_info["manifest"],
         "chaos_lines": log.lines(),
         "chaos_counts": log.counts(),

@@ -73,6 +73,8 @@ class ElasticShardServer:
         wal_group_n: int = 8,
         admission=None,
         manifest_path: Optional[str] = None,
+        combine: str = "add",
+        optimizer=None,
     ):
         self.server_id = int(server_id)
         self.n_params = int(n_params)
@@ -94,7 +96,18 @@ class ElasticShardServer:
             params=np.zeros(1, np.float32), transport=transport,
             ckpt_dir=ckpt_dir, ckpt_every=ckpt_every,
             staleness_damping=staleness_damping, wal=wal,
-            wal_group_n=wal_group_n, admission=admission)
+            wal_group_n=wal_group_n, admission=admission,
+            combine=combine)
+        #: ZeRO-style sharded optimizer (ISSUE 14): owns momentum/Adam
+        #: state for EXACTLY this server's assigned range — resized with
+        #: the central slice on every map change (overlap state kept,
+        #: fresh subranges start neutral), persisted/replayed through the
+        #: wrapped ParameterServer's checkpoint + WAL machinery. Attached
+        #: post-construction because the range is coordinator-assigned,
+        #: not known at build time.
+        if optimizer is not None:
+            optimizer.resize(self.lo, self.hi)
+            self.ps.optimizer = optimizer
         #: where the coordinator publishes its FleetManifest — the rollback
         #: barrier (ISSUE 8) needs it to restore the last good snapshot
         self.manifest_path = manifest_path
@@ -199,6 +212,10 @@ class ElasticShardServer:
         )
         self.lo, self.hi = e.lo, e.hi
         self.ps.central = new_central
+        if self.ps.optimizer is not None:
+            # the optimizer range follows the central slice: overlap
+            # state survives, freshly-acquired subranges start neutral
+            self.ps.optimizer.resize(e.lo, e.hi)
         self.stats["resizes"] += 1
 
     # ---------------------------------------------------------- snapshots
@@ -330,6 +347,11 @@ class ElasticShardServer:
             if self._init_flat is not None:
                 central[:] = self._init_flat[entry.lo:entry.hi]
             self.ps.central = central
+            if self.ps.optimizer is not None:
+                # size the optimizer to the manifest range BEFORE the
+                # restore loads its persisted state (which is validated
+                # against exactly this size)
+                self.ps.optimizer.resize(entry.lo, entry.hi)
             if not self.ps.maybe_restore():
                 raise ManifestError(
                     f"shard {self.server_id}: manifest promises a "
@@ -376,6 +398,25 @@ class ElasticShardServer:
                 return
             self.ps.handle(sender, MessageCode.GradientUpdate, values)
             self.coord.report(self.ps._push_count, 0, 0.0)
+        elif code == MessageCode.CompressedUpdate and payload.size >= 13:
+            # 13 == compress.HEAD_LEN + 1 (a literal for the distcheck
+            # size-guard extraction, like ShardPush's 7 above)
+            # the compressed elastic push (ISSUE 14): the RANGE stamp is
+            # checked BEFORE paying for a decode — same gate as ShardPush,
+            # codec-agnostic; an unstamped compressed frame on the elastic
+            # plane is dropped like an unstamped GradientUpdate below
+            from distributed_ml_pytorch_tpu.utils.compress import peek_stamp
+
+            stamp = peek_stamp(payload)
+            if stamp is None or (stamp[1], stamp[2]) != (self.lo, self.hi):
+                self.stats["stale_dropped"] += 1
+                return
+            self.ps.handle(sender, MessageCode.CompressedUpdate, payload)
+            self.coord.report(self.ps._push_count, 0, 0.0)
+        elif code == MessageCode.CompressedUpdate:
+            # truncated below head+1: unroutable, counted like any other
+            # undeliverable elastic push (never a silent fall-through)
+            self.stats["stale_dropped"] += 1
         elif code == MessageCode.GradientUpdate:
             # unversioned pushes no longer exist on the elastic plane
             # (every elastic client stamps ShardPush) — one arriving means
@@ -485,7 +526,8 @@ class ElasticShardServer:
                 continue  # malformed frame: drop, never die
             if (self.ps.wal is None
                     or code not in (MessageCode.GradientUpdate,
-                                    MessageCode.ShardPush)
+                                    MessageCode.ShardPush,
+                                    MessageCode.CompressedUpdate)
                     or self.ps.wal.pending >= self.ps.wal_group_n):
                 with self._mu:
                     self.ps.commit()

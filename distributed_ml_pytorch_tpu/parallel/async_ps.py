@@ -89,6 +89,8 @@ class ParameterServer:
         wal_group_n: int = 8,
         admission=None,
         recorder=None,
+        combine: str = "add",
+        optimizer=None,
     ):
         if params is not None:
             self.central = np.asarray(params, dtype=np.float32).copy()
@@ -178,6 +180,36 @@ class ParameterServer:
         #: under straggler-heavy fleets a small damping keeps one slow
         #: worker's very stale deltas from dragging the central params back.
         self.staleness_damping = float(staleness_damping)
+        # --- scalable optimizer plane (ISSUE 14) ------------------------
+        #: how concurrent pushes combine: "add" (the reference behavior)
+        #: or "adasum" (arXiv:2006.02924) — an angle-aware merge against
+        #: the OVERLAP (the sum of deltas applied since the pushing
+        #: worker's last pull) that de-weights redundant directions
+        #: instead of damping everything by staleness. The two knobs are
+        #: alternatives by design, never stacked.
+        if combine not in ("add", "adasum"):
+            raise ValueError(f"combine must be 'add' or 'adasum', "
+                             f"got {combine!r}")
+        if combine == "adasum" and self.staleness_damping > 0.0:
+            raise ValueError(
+                "combine='adasum' replaces --staleness-damping — pick one "
+                "(stacking them would damp the same staleness twice)")
+        self.combine = combine
+        #: per-sender overlap vectors (adasum only): reset on each pull,
+        #: grown by every OTHER sender's applied delta
+        self._overlap: dict = {}
+        #: optional server-side sharded optimizer
+        #: (``parallel/optplane.ShardedOptimizer``): transforms each
+        #: admitted, combined update into the applied delta, owning the
+        #: momentum/Adam state for exactly this server's range (the
+        #: ZeRO-style 1/shards state scaling). The WAL logs the
+        #: optimizer's INPUT, so replay re-runs ``step`` and rebuilds
+        #: state bit-for-bit from the checkpointed generation.
+        self.optimizer = optimizer
+        if optimizer is not None and optimizer.size != self.central.shape[0]:
+            raise ValueError(
+                f"optimizer covers {optimizer.size} params but this "
+                f"server holds {self.central.shape[0]}")
         from distributed_ml_pytorch_tpu.utils.failure import StalenessAuditor
 
         self.staleness = StalenessAuditor()
@@ -201,6 +233,11 @@ class ParameterServer:
         import os
 
         return os.path.join(self.ckpt_dir, "ps_meta.json")
+
+    def _opt_path(self) -> str:
+        import os
+
+        return os.path.join(self.ckpt_dir, "ps_opt.npz")
 
     def save_checkpoint(self) -> None:
         """Persist the central params + resume clock, atomically AND
@@ -238,6 +275,20 @@ class ParameterServer:
             "recent_envelopes": [list(e) for e in self._recent_envelopes],
             "prev": self._prev_ckpt_meta,
         }
+        if self.optimizer is not None:
+            # optimizer state rides the checkpoint (ISSUE 14), written
+            # FIRST and bound to this vector generation by the vector CRC:
+            # the state file keeps two generations, so whichever meta/
+            # vector generation a torn crash resolves to, a CRC-matching
+            # optimizer generation exists (optplane.save_state). The
+            # last COMPLETED generation's CRC tells save_state which
+            # stored generation to keep as prev (a torn save's orphan
+            # cur must not evict the still-live one).
+            last_crc = (self._prev_ckpt_meta or {}).get("central_crc")
+            self.optimizer.save_state(
+                self._opt_path(), central_crc=int(meta["central_crc"]),
+                apply_seq=self._apply_seq,
+                prev_crc=None if last_crc is None else int(last_crc))
         atomic_write(self._meta_path(), json.dumps(meta).encode())
         atomic_write(self._ckpt_path(), blob)
         self._prev_ckpt_meta = {k: v for k, v in meta.items() if k != "prev"}
@@ -326,12 +377,31 @@ class ParameterServer:
                     for s, i, q in meta.get("recent_envelopes", []))
                 self._prev_ckpt_meta = {
                     k: v for k, v in meta.items() if k != "prev"}
+            self._restore_optimizer_state(meta)
             restored = True
         if self.wal is not None:
             restored = bool(self._replay_wal()) or restored
         if restored:
             self._restored = True
         return restored
+
+    def _restore_optimizer_state(self, meta) -> None:
+        """Adopt the checkpoint's optimizer generation (the one whose CRC
+        binds it to the adopted central vector); a missing state file is
+        a pre-optimizer checkpoint — fresh zero moments, loudly noted
+        (WAL replay then rebuilds from there exactly as the live path
+        would have)."""
+        if self.optimizer is None:
+            return
+        crc = int(meta["central_crc"]) if (
+            meta is not None and "central_crc" in meta) else None
+        if not self.optimizer.load_state(self._opt_path(),
+                                         central_crc=crc):
+            self.optimizer.reset()  # never pair live moments with a
+            # restored vector from another timeline
+            _LOGGER.warning(
+                "no optimizer state beside the checkpoint (%s) — "
+                "resuming with fresh zero moments", self._opt_path())
 
     def _replay_wal(self) -> int:
         """Re-apply logged updates the checkpoint does not cover; returns
@@ -359,7 +429,9 @@ class ParameterServer:
                     f"WAL record seq {rec.seq} holds {rec.payload.shape[0]} "
                     f"params but the restored vector holds "
                     f"{self.central.shape[0]} — log/checkpoint mismatch")
-            self.central += rec.payload
+            # the record holds the optimizer's INPUT: replay re-runs the
+            # step, so the optimizer state catches up exactly (ISSUE 14)
+            self._apply_delta(rec.payload)
             self._apply_seq = rec.seq
             self._push_count += 1
             self.staleness.version += 1
@@ -422,6 +494,13 @@ class ParameterServer:
                 for k, v in meta.get("applied_by_sender", {}).items()}
         else:
             self._apply_seq = 0
+        # a rollback discards the live optimizer state with the live
+        # vector: re-adopt the checkpoint's generation, then the capped
+        # replay below catches BOTH up to the target together
+        self._restore_optimizer_state(meta)
+        if self.combine == "adasum":
+            self._overlap.clear()  # overlap windows described the
+            # discarded regime; workers re-pull at the barrier anyway
         replayed = 0
         if self.wal is not None:
             records, _stats = self.wal.replay()
@@ -433,7 +512,7 @@ class ParameterServer:
                         f"WAL record seq {rec.seq} holds "
                         f"{rec.payload.shape[0]} params but the restored "
                         f"vector holds {self.central.shape[0]}")
-                self.central += rec.payload
+                self._apply_delta(rec.payload)
                 self._apply_seq = rec.seq
                 self._push_count += 1
                 self.staleness.version += 1
@@ -472,68 +551,43 @@ class ParameterServer:
         _LOGGER.info("Processing message: %s", code.name)
         self.message_counts[code] = self.message_counts.get(code, 0) + 1
         if code == MessageCode.GradientUpdate:
-            if payload.shape != self.central.shape:
-                # validate BEFORE any accounting or WAL append: a wrong-size
-                # update must not inflate the apply clock, poison the log
-                # with a record replay can never fit (it would refuse every
-                # future restore), or numpy-broadcast into the vector
+            self._apply_update(sender, payload)
+        # 13 == compress.HEAD_LEN + 1 = the schema's min_size — a literal
+        # because the distcheck wire checker reads size guards statically
+        elif code == MessageCode.CompressedUpdate and payload.size >= 13:
+            # the compressed gradient wire (ISSUE 14): DECODE FIRST — the
+            # admission gate, the WAL and the apply path must all see the
+            # decoded delta (a gate judging wire bytes is exactly what the
+            # distmodel `decode_before_admission` mutation breaks)
+            from distributed_ml_pytorch_tpu.utils.compress import (
+                CompressionError,
+                decode_update,
+            )
+
+            try:
+                _stamp, codec_id, delta = decode_update(payload)
+            except CompressionError as e:
+                # malformed/corrupt compressed frames are dropped BEFORE
+                # any accounting — same contract as a wrong-size dense push
                 self.dropped_bad_updates += 1
                 _LOGGER.warning(
-                    "dropping GradientUpdate from %d: %d params vs central "
-                    "%d (wrong model / stale partition?)", sender,
-                    payload.shape[0], self.central.shape[0])
+                    "dropping CompressedUpdate from %d: %s", sender, e)
                 return
-            if self.admission is not None:
-                # the admission gate (ISSUE 8) runs BEFORE accounting and
-                # BEFORE the WAL append: a quarantined update must not
-                # inflate the apply clock nor enter the log (a logged
-                # poisoned record would be replayed on every restore)
-                verdict = self.admission.evaluate(sender, payload)
-                if verdict is not None:
-                    self._quarantine_update(sender, verdict)
-                    return
-            # workers pre-scale by -lr (Asynchronous.py:55) → server-side add
-            rec = self.recorder
-            staleness = self.staleness.on_push(sender)
-            if self.staleness_damping > 0.0 and staleness > 0:
-                delta = (payload / (1.0 + self.staleness_damping * staleness)
-                         ).astype(np.float32)
-            else:
-                delta = payload
-            self._apply_seq += 1
-            self.applied_by_sender[sender] = (
-                self.applied_by_sender.get(sender, 0) + 1)
-            if self.wal is not None:
-                # log-before-apply(-before-ack): the APPLIED delta (post
-                # damping) is what replay must reproduce; once the record
-                # is fsync'd (commit()) the delivery ack is released and
-                # the update can never be lost
-                env_inc, env_seq = self._envelope or (0, 0)
-                t0 = time.monotonic_ns() if rec is not None else 0
-                self.wal.append(self._apply_seq, delta, sender=sender,
-                                env_inc=env_inc, env_seq=env_seq)
-                if rec is not None:
-                    rec.record("wal-append", "wal", t0, time.monotonic_ns(),
-                               meta={"sender": sender,
-                                     "seq": self._apply_seq})
-                if env_inc or env_seq:
-                    self._recent_envelopes.append(
-                        (sender, env_inc, env_seq))
-            t0 = time.monotonic_ns() if rec is not None else 0
-            self.central += delta
-            if rec is not None:
-                # the corr id the delivering envelope restored into this
-                # thread stitches push -> admission -> WAL -> apply -> ack
-                rec.record("apply", "apply", t0, time.monotonic_ns(),
-                           meta={"sender": sender, "seq": self._apply_seq})
-            self._push_count += 1
-            if self.ckpt_dir and self.ckpt_every and (
-                self._push_count % self.ckpt_every == 0
-            ):
-                self.save_checkpoint()
+            self._apply_update(sender, delta, codec=codec_id)
+        elif code == MessageCode.CompressedUpdate:
+            # shorter than head+1: even the guarded branch above cannot
+            # take it — still a malformed frame, still loudly counted
+            self.dropped_bad_updates += 1
+            _LOGGER.warning(
+                "dropping truncated CompressedUpdate from %d "
+                "(%d floats, head is 12)", sender, payload.size)
         elif code == MessageCode.ParameterRequest:
             self._reply(sender, self.central)
             self.staleness.on_pull(sender)
+            if self.combine == "adasum":
+                # the worker now sees everything applied so far: its
+                # overlap window restarts empty
+                self._overlap[sender] = np.zeros_like(self.central)
         elif code == MessageCode.ParameterUpdate:
             if self._restored:
                 # a restored server must not let a fresh worker's
@@ -555,6 +609,113 @@ class ParameterServer:
                 self._reply(sender, self.central)
             else:
                 self.central = payload.astype(np.float32).copy()
+
+    def _apply_update(self, sender: int, payload: np.ndarray,
+                      codec: int = 0) -> None:
+        """THE apply path, shared by dense and compressed pushes (ISSUE
+        14): size gate -> admission on the DECODED delta -> staleness
+        damping or Adasum combine -> WAL append (the optimizer's input +
+        the codec id) -> optimizer step -> apply. Ordering is the
+        protocol: validation and admission run before any accounting, the
+        WAL record lands before the mutation (DC402), and the logged
+        value is exactly what replay must feed the optimizer to reproduce
+        both the vector and the optimizer state."""
+        if payload.shape != self.central.shape:
+            # validate BEFORE any accounting or WAL append: a wrong-size
+            # update must not inflate the apply clock, poison the log
+            # with a record replay can never fit (it would refuse every
+            # future restore), or numpy-broadcast into the vector
+            self.dropped_bad_updates += 1
+            _LOGGER.warning(
+                "dropping update from %d: %d params vs central "
+                "%d (wrong model / stale partition?)", sender,
+                payload.shape[0], self.central.shape[0])
+            return
+        if self.admission is not None:
+            # the admission gate (ISSUE 8) runs BEFORE accounting and
+            # BEFORE the WAL append: a quarantined update must not
+            # inflate the apply clock nor enter the log (a logged
+            # poisoned record would be replayed on every restore)
+            verdict = self.admission.evaluate(sender, payload)
+            if verdict is not None:
+                self._quarantine_update(sender, verdict)
+                return
+        # workers pre-scale by -lr (Asynchronous.py:55) → server-side add
+        rec = self.recorder
+        staleness = self.staleness.on_push(sender)
+        if self.staleness_damping > 0.0 and staleness > 0:
+            delta = (payload / (1.0 + self.staleness_damping * staleness)
+                     ).astype(np.float32)
+        elif self.combine == "adasum":
+            delta = self._adasum_combine(sender, payload)
+        else:
+            delta = payload
+        self._apply_seq += 1
+        self.applied_by_sender[sender] = (
+            self.applied_by_sender.get(sender, 0) + 1)
+        if self.wal is not None:
+            # log-before-apply(-before-ack): the COMBINED delta (post
+            # damping/adasum, pre optimizer) is what replay must feed the
+            # optimizer to reproduce the applied bytes AND the optimizer
+            # state; once the record is fsync'd (commit()) the delivery
+            # ack is released and the update can never be lost. The codec
+            # id records which wire encoding delivered it (drill-audited).
+            env_inc, env_seq = self._envelope or (0, 0)
+            t0 = time.monotonic_ns() if rec is not None else 0
+            self.wal.append(self._apply_seq, delta, sender=sender,
+                            env_inc=env_inc, env_seq=env_seq,
+                            codec=codec)
+            if rec is not None:
+                rec.record("wal-append", "wal", t0, time.monotonic_ns(),
+                           meta={"sender": sender,
+                                 "seq": self._apply_seq})
+            if env_inc or env_seq:
+                self._recent_envelopes.append(
+                    (sender, env_inc, env_seq))
+        t0 = time.monotonic_ns() if rec is not None else 0
+        applied = self._apply_delta(delta)
+        if rec is not None:
+            # the corr id the delivering envelope restored into this
+            # thread stitches push -> admission -> WAL -> apply -> ack
+            rec.record("apply", "apply", t0, time.monotonic_ns(),
+                       meta={"sender": sender, "seq": self._apply_seq})
+        if self.combine == "adasum":
+            # what actually moved the params joins every OTHER worker's
+            # overlap window (their next push raced this one)
+            for other, o in self._overlap.items():
+                if other != sender and o.shape == applied.shape:
+                    o += applied
+        self._push_count += 1
+        if self.ckpt_dir and self.ckpt_every and (
+            self._push_count % self.ckpt_every == 0
+        ):
+            self.save_checkpoint()
+
+    def _apply_delta(self, delta: np.ndarray) -> np.ndarray:
+        """Run the (optional) server-side optimizer and mutate the
+        central vector; returns the delta that actually applied. Shared
+        by the live path, WAL replay and rollback so the optimizer state
+        can never drift between them."""
+        if self.optimizer is not None:
+            delta = self.optimizer.step(delta)
+        self.central += delta
+        return delta
+
+    def _adasum_combine(self, sender: int, payload: np.ndarray,
+                        ) -> np.ndarray:
+        """Adasum against this worker's overlap window (the deltas applied
+        since its last pull). No window yet — the worker has not pulled
+        since the mode came up, or the vector was resized — means no
+        overlap knowledge: plain add, and the stale window is discarded."""
+        o = self._overlap.get(sender)
+        if o is None or o.shape != payload.shape:
+            self._overlap.pop(sender, None)
+            return payload
+        from distributed_ml_pytorch_tpu.parallel.optplane import (
+            adasum_adjust,
+        )
+
+        return adasum_adjust(o, payload)
 
     def _quarantine_update(self, sender: int, verdict) -> None:
         """Record one rejected update and tell the worker EXPLICITLY.
@@ -679,7 +840,9 @@ class ParameterServer:
                     break
                 continue
             self.handle(sender, code, payload)
-            if (self.wal is None or code != MessageCode.GradientUpdate
+            if (self.wal is None
+                    or code not in (MessageCode.GradientUpdate,
+                                    MessageCode.CompressedUpdate)
                     or self.wal.pending >= self.wal_group_n):
                 # group-fsync batching applies to the gradient stream only;
                 # everything else commits (and releases its ack) immediately
@@ -1045,6 +1208,9 @@ class Asynchronous:
         heartbeat: Optional["HeartbeatSender"] = None,
         rejoin: bool = False,
         install_timeout: float = 5.0,
+        compress: Optional[str] = None,
+        compress_opts: Optional[dict] = None,
+        error_feedback: bool = True,
     ):
         validate_downpour_args(lr, n_push, n_pull)
         self.lr = float(lr)
@@ -1115,24 +1281,48 @@ class Asynchronous:
         self.skipped_updates = 0
 
         self._device_step = make_downpour_device_step(self.tx, self._pad)
-        self._flusher = PushFlusher(
-            lambda arr: self._send(MessageCode.GradientUpdate, arr))
+        # --- compressed push wire (ISSUE 14) ----------------------------
+        #: with ``compress="int8"|"topk"``, pushes ride the
+        #: ``CompressedUpdate`` frame through an error-feedback encoder
+        #: (utils/compress.CompressingEncoder): what a push could not
+        #: represent carries into the next one, so compressed DownPour
+        #: stays in the fault-free corridor. Touched only by the flusher
+        #: thread (finish() drains it before the final inline push).
+        self.encoder = None
+        if compress:
+            from distributed_ml_pytorch_tpu.utils.compress import (
+                CompressingEncoder,
+                make_codec,
+            )
 
-    def _send(self, code: MessageCode, payload) -> None:
-        """Send toward the server; a dead server degrades, never crashes.
+            self.encoder = CompressingEncoder(
+                self._flat_n, make_codec(compress, **(compress_opts or {})),
+                error_feedback=error_feedback)
+        self._flusher = PushFlusher(self._send_push)
 
-        First failure prints one warning and flips :attr:`server_down`; from
-        then on the worker trains purely locally (the reference would raise
-        out of ``optimizer.step`` mid-epoch — SURVEY.md §5.3 notes it has no
-        failure handling anywhere).
-        """
+    def _send_push(self, arr: np.ndarray) -> None:
+        """One push toward the server: dense ``GradientUpdate``, or a
+        compressed ``CompressedUpdate`` (head, body) pair riding the
+        transport's scatter/gather ``sendv``."""
+        if self.encoder is None:
+            self._send(MessageCode.GradientUpdate, arr)
+            return
+        head, body = self.encoder.encode_range(arr, 0, self._flat_n)
+        self._sendv(MessageCode.CompressedUpdate, (head, body))
+
+    def _guarded_send(self, do_send) -> None:
+        """THE degrade discipline, shared by every wire shape: a dead
+        server flips :attr:`server_down` once (with one warning) and the
+        worker trains purely locally from then on (the reference would
+        raise out of ``optimizer.step`` mid-epoch — SURVEY.md §5.3 notes
+        it has no failure handling anywhere)."""
         if self.server_down:
             return
         if self.heartbeat is not None and self.heartbeat.peer_down:
             self.server_down = True
         else:
             try:
-                send_message(code, payload, transport=self.transport)
+                do_send()
                 return
             except (OSError, ConnectionError):
                 self.server_down = True
@@ -1141,6 +1331,15 @@ class Asynchronous:
             "purely-local SGD (no further push/pull)",
             file=sys.stderr,
         )
+
+    def _sendv(self, code: MessageCode, parts) -> None:
+        """Degrade-guarded multi-part (scatter/gather) send."""
+        self._guarded_send(lambda: self.transport.sendv(code, parts))
+
+    def _send(self, code: MessageCode, payload) -> None:
+        """Degrade-guarded single-payload send toward the server."""
+        self._guarded_send(
+            lambda: send_message(code, payload, transport=self.transport))
 
     def _resync_on_nacks(self) -> None:
         """The nack response (ISSUE 8): a quarantined push means this
@@ -1224,9 +1423,11 @@ class Asynchronous:
 
     def finish(self) -> None:
         """Flush a final push, notify the server, stop the listener."""
-        # in-flight pushes must land BEFORE the final one (cadence order)
+        # in-flight pushes must land BEFORE the final one (cadence order);
+        # the drain also quiesces the encoder's residual, so the final
+        # compressed push folds it in on this thread race-free
         self._flusher.drain()
-        self._send(MessageCode.GradientUpdate, np.asarray(self.accum[: self._flat_n]))
+        self._send_push(np.asarray(self.accum[: self._flat_n]))
         # over a reliable transport, WorkerDone must barrier behind every
         # prior push: the layer guarantees delivery, not ordering, so an
         # unflushed retry could land after the server counted this worker
@@ -1281,6 +1482,10 @@ def train_worker(
     if opt_factory is not None:
         opt = opt_factory(params, tx)
     else:
+        from distributed_ml_pytorch_tpu.utils.compress import (
+            compress_from_args,
+        )
+
         opt = Asynchronous(
             params,
             lr=args.lr,
@@ -1290,6 +1495,7 @@ def train_worker(
             transport=transport,
             heartbeat=heartbeat,
             rejoin=getattr(args, "rejoin", False),
+            **compress_from_args(args),
         )
     dropout_rng = jax.random.key(seed + 1 + transport.rank)
 
@@ -1453,6 +1659,14 @@ def run_server(args, transport: Transport) -> ParameterServer:
     params = model.init(
         jax.random.key(getattr(args, "seed", 0)), jnp.zeros((1, 32, 32, 3))
     )["params"]
+    from distributed_ml_pytorch_tpu.parallel.optplane import (
+        optimizer_from_args,
+    )
+    from distributed_ml_pytorch_tpu.utils.serialization import (
+        ravel_model_params as _ravel,
+    )
+
+    n_params = int(np.asarray(_ravel(params)).shape[0])
     server = ParameterServer(
         params,
         transport=transport,
@@ -1463,6 +1677,8 @@ def run_server(args, transport: Transport) -> ParameterServer:
         staleness_damping=getattr(args, "staleness_damping", 0.0),
         wal=getattr(args, "wal", False),
         admission=_admission_from_args(args),
+        combine=getattr(args, "combine", "add") or "add",
+        optimizer=optimizer_from_args(args, n_params),
     )
     if getattr(args, "resume", False) and server.maybe_restore():
         print("parameter server: resumed central params from", server._ckpt_path())

@@ -1,0 +1,297 @@
+"""Scalable optimizer plane: ZeRO-style sharded server-side optimizer
+state + Adasum combination of concurrent pushes (ISSUE 14 tentpole).
+
+**Sharded optimizer** (:class:`ShardedOptimizer`). Per "Automatic
+Cross-Replica Sharding of Weight Update in Data-Parallel Training"
+(arXiv:2004.13336 — already cited by ``make_accum_train_step`` for the
+grad-accumulation side), optimizer state and step cost should scale with
+``1/shards``: each shard owns the momentum / Adam moments ONLY for the
+contiguous ``[lo, hi)`` range it serves, slotting straight into the
+existing ``ShardMap`` / ``FleetManifest`` ranges. The server transforms
+each admitted (decoded, combined) update ``u`` into the applied delta:
+
+- ``sgdm`` — heavy-ball over the incoming deltas:
+  ``m = momentum * m + u``; ``delta = lr * m``.
+- ``adam`` — Adam moments over the incoming deltas with bias correction:
+  ``m, v`` EWMAs of ``u`` / ``u^2``, ``delta = lr * m_hat /
+  (sqrt(v_hat) + eps)``.
+
+The math is elementwise, so a sharded step over ``[lo, hi)`` equals the
+same slice of a dense step — pinned by ``tests/test_optplane.py``
+(sharded-Adam == dense-Adam on the same range).
+
+**Durability contract** (how drills and rollback keep working): the WAL
+logs the optimizer's INPUT (the decoded, combined delta) plus the codec
+id, and replay re-runs :meth:`ShardedOptimizer.step` — so checkpoint +
+replay reproduces both the central vector AND the optimizer state
+bit-for-bit. The state itself rides the checkpoint via
+:meth:`save_state` / :meth:`load_state`: a two-generation ``.npz``
+written BEFORE the checkpoint meta, each generation bound to its central
+vector by the vector's CRC, so the ISSUE-5 tear window (a crash between
+renames) always resolves to a (vector, optimizer) pair from ONE
+generation — never a mixed clock.
+
+**Adasum** (:func:`adasum`). Per "Scaling Distributed Training with
+Adaptive Summation" (arXiv:2006.02924), two gradients computed from the
+same point combine as::
+
+    Adasum(a, b) = (1 - a.b / 2|a|^2) a + (1 - a.b / 2|b|^2) b
+
+which reduces to the plain sum for orthogonal updates and to ``a`` for
+identical ones — redundant directions are de-weighted instead of
+double-applied. At the PS this replaces ``--staleness-damping``
+(``combine="adasum"``): the server tracks, per worker, the OVERLAP — the
+sum of deltas applied since that worker's last pull — and applies
+``Adasum(overlap, push) - overlap`` instead of the raw push, so a stale
+push that mostly repeats what concurrent workers already applied moves
+the params once, not twice. Anti-aligned pushes (``a.b < 0``) fall back
+to the plain sum: disagreement is signal, not redundancy — only
+REDUNDANCY is damped (documented deliberate deviation; the paper's
+formula would amplify them).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+#: server-side optimizer kinds the CLI face accepts
+OPT_KINDS = ("sgdm", "adam")
+
+
+def adasum(a: np.ndarray, b: np.ndarray, *, eps: float = 1e-30,
+           ) -> np.ndarray:
+    """Angle-aware merge of two updates (module docstring): plain sum for
+    orthogonal or anti-aligned inputs, de-weighted sum for aligned ones.
+    Dot products run in float64 so the decision is stable on 9.9 MB
+    float32 vectors; the result is float32."""
+    a64 = np.asarray(a, np.float64).ravel()
+    b64 = np.asarray(b, np.float64).ravel()
+    dot = float(a64 @ b64)
+    na = float(a64 @ a64)
+    nb = float(b64 @ b64)
+    if dot <= 0.0 or na <= eps or nb <= eps:
+        return (a64 + b64).astype(np.float32)
+    return ((1.0 - dot / (2.0 * na)) * a64
+            + (1.0 - dot / (2.0 * nb)) * b64).astype(np.float32)
+
+
+def adasum_adjust(overlap: np.ndarray, push: np.ndarray) -> np.ndarray:
+    """The PS-side application: the overlap ``o`` is ALREADY applied, so
+    the increment that lands the central params on ``Adasum(o, push)`` is
+    ``Adasum(o, push) - o`` (exactly ``push`` when orthogonal)."""
+    o64 = np.asarray(overlap, np.float64).ravel()
+    merged = adasum(overlap, push).astype(np.float64)
+    return (merged - o64).astype(np.float32)
+
+
+class ShardedOptimizer:
+    """Optimizer state for ONE contiguous parameter range (module
+    docstring). ``step`` maps an incoming combined update to the applied
+    delta; state cost is ``O(hi - lo)`` — the 1/shards scaling. The
+    instance is only touched from its server's serve thread (and replay,
+    which runs before serving starts), so it carries no lock — the same
+    contract as ``GradientAdmission``."""
+
+    def __init__(self, kind: str, lo: int = 0, hi: int = 0, *,
+                 lr: float = 1.0, momentum: float = 0.9,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 eps: float = 1e-8):
+        if kind not in OPT_KINDS:
+            raise ValueError(f"unknown optimizer kind {kind!r} "
+                             f"(known: {OPT_KINDS})")
+        self.kind = kind
+        self.lr = float(lr)
+        self.momentum = float(momentum)
+        self.beta1 = float(beta1)
+        self.beta2 = float(beta2)
+        self.eps = float(eps)
+        self.lo = self.hi = 0
+        self.t = 0  # Adam bias-correction step count
+        self.m = np.zeros(0, np.float32)
+        self.v = np.zeros(0, np.float32)  # Adam only; kept for sgdm too
+        self.resize(lo, hi)
+
+    @property
+    def size(self) -> int:
+        return self.hi - self.lo
+
+    def state_floats(self) -> int:
+        """Optimizer-state footprint in float32 words — the measurable
+        behind the 1/shards claim."""
+        return int(self.m.size + self.v.size)
+
+    def step(self, u: np.ndarray) -> np.ndarray:
+        """Transform one incoming update (sized ``hi - lo``) into the
+        applied delta, advancing the state. Deterministic: replaying the
+        same inputs from the same state reproduces the same deltas AND
+        the same state — the WAL-replay contract."""
+        u = np.asarray(u, np.float32).ravel()
+        if u.size != self.size:
+            raise ValueError(
+                f"update of {u.size} params for optimizer range "
+                f"[{self.lo},{self.hi})")
+        if self.kind == "sgdm":
+            self.m = (self.momentum * self.m + u).astype(np.float32)
+            return (self.lr * self.m).astype(np.float32)
+        # adam
+        self.t += 1
+        self.m = (self.beta1 * self.m + (1.0 - self.beta1) * u
+                  ).astype(np.float32)
+        self.v = (self.beta2 * self.v + (1.0 - self.beta2) * (u * u)
+                  ).astype(np.float32)
+        mhat = self.m / np.float32(1.0 - self.beta1 ** self.t)
+        vhat = self.v / np.float32(1.0 - self.beta2 ** self.t)
+        return (np.float32(self.lr) * mhat
+                / (np.sqrt(vhat) + np.float32(self.eps))).astype(np.float32)
+
+    def reset(self) -> None:
+        """Zero the moments (the neutral state) — the adopt-nothing path
+        when a restore finds no persisted state to pair with."""
+        self.t = 0
+        self.m = np.zeros(self.size, np.float32)
+        self.v = np.zeros(self.size, np.float32)
+
+    def resize(self, lo: int, hi: int) -> None:
+        """Adopt a new range, keeping the overlap's state — the elastic
+        rebalance contract, identical to how the shard's central slice
+        resizes. Freshly-acquired subranges start with zero moments (the
+        neutral state; their history lived on another shard)."""
+        lo, hi = int(lo), int(hi)
+        if (lo, hi) == (self.lo, self.hi):
+            return
+        if hi < lo:
+            raise ValueError(f"bad optimizer range [{lo},{hi})")
+        new_m = np.zeros(hi - lo, np.float32)
+        new_v = np.zeros(hi - lo, np.float32)
+        o_lo, o_hi = max(self.lo, lo), min(self.hi, hi)
+        if o_lo < o_hi:
+            new_m[o_lo - lo:o_hi - lo] = self.m[o_lo - self.lo:
+                                                o_hi - self.lo]
+            new_v[o_lo - lo:o_hi - lo] = self.v[o_lo - self.lo:
+                                                o_hi - self.lo]
+        self.lo, self.hi = lo, hi
+        self.m, self.v = new_m, new_v
+
+    # ------------------------------------------------------- persistence
+    def state_dict(self) -> Dict:
+        return {"kind": self.kind, "lo": self.lo, "hi": self.hi,
+                "t": self.t, "m": self.m.copy(), "v": self.v.copy()}
+
+    def load_state_dict(self, st: Dict) -> None:
+        if st["hi"] - st["lo"] != self.size:
+            raise ValueError(
+                f"optimizer state for [{st['lo']},{st['hi']}) does not "
+                f"fit range [{self.lo},{self.hi})")
+        self.t = int(st["t"])
+        self.m = np.asarray(st["m"], np.float32).copy()
+        self.v = np.asarray(st["v"], np.float32).copy()
+
+    def save_state(self, path: str, *, central_crc: int,
+                   apply_seq: int,
+                   prev_crc: Optional[int] = None) -> None:
+        """Persist this range's state bound (by CRC) to the central
+        vector generation it matches. The file keeps TWO generations —
+        current and previous — so a crash anywhere in the checkpoint's
+        multi-rename window leaves at least one generation whose CRC
+        matches whichever vector generation ``maybe_restore`` adopts.
+        Called BEFORE the meta/vector renames (see
+        ``ParameterServer.save_checkpoint``).
+
+        ``prev_crc`` names the last COMPLETED checkpoint's vector CRC:
+        the generation promoted into the ``prev`` slot must be the one
+        matching it — not blindly the file's ``cur``, which after a torn
+        save is an orphan no vector generation ever adopted (promoting
+        the orphan would evict the still-live generation, and a SECOND
+        torn crash could then resolve the vector to a generation with no
+        matching optimizer state)."""
+        from distributed_ml_pytorch_tpu.utils.durability import atomic_write
+
+        prev: Dict[str, np.ndarray] = {}
+        if os.path.exists(path):
+            try:
+                with np.load(path) as old:
+                    pick = None
+                    for gen in ("cur", "prev"):
+                        if f"{gen}_m" not in old:
+                            continue
+                        if prev_crc is None or int(old[f"{gen}_crc"]) == (
+                                int(prev_crc) & 0xFFFFFFFF):
+                            pick = gen
+                            break
+                    if pick is not None:
+                        for key in ("m", "v", "t", "crc", "seq", "lo",
+                                    "hi"):
+                            if f"{pick}_{key}" in old:
+                                prev[f"prev_{key}"] = old[f"{pick}_{key}"]
+            except (OSError, ValueError):
+                prev = {}  # unreadable old file: single-generation write
+        buf = io.BytesIO()
+        np.savez(
+            buf,
+            cur_m=self.m, cur_v=self.v,
+            cur_t=np.int64(self.t),
+            cur_crc=np.uint32(central_crc & 0xFFFFFFFF),
+            cur_seq=np.int64(apply_seq),
+            cur_lo=np.int64(self.lo), cur_hi=np.int64(self.hi),
+            **prev)
+        atomic_write(path, buf.getvalue())
+
+    def load_state(self, path: str, *,
+                   central_crc: Optional[int] = None) -> bool:
+        """Adopt the on-disk generation whose CRC matches the restored
+        central vector (``central_crc=None`` — legacy meta without a CRC
+        — adopts the current generation). Returns False when no state
+        file exists (a pre-optimizer checkpoint: fresh zero moments, the
+        documented cold start). Raises when a file exists but NEITHER
+        generation matches — pairing an optimizer state with the wrong
+        vector generation would silently double- or mis-apply momentum
+        on every replayed record."""
+        if not os.path.exists(path):
+            return False
+        with np.load(path) as data:
+            for gen in ("cur", "prev"):
+                if f"{gen}_m" not in data:
+                    continue
+                crc = int(data[f"{gen}_crc"])
+                if central_crc is not None and \
+                        crc != (int(central_crc) & 0xFFFFFFFF):
+                    continue
+                lo = int(data[f"{gen}_lo"])
+                hi = int(data[f"{gen}_hi"])
+                if hi - lo != self.size:
+                    raise ValueError(
+                        f"optimizer state at {path} covers [{lo},{hi}) "
+                        f"but this server's range is "
+                        f"[{self.lo},{self.hi}) — state/map mismatch")
+                self.t = int(data[f"{gen}_t"])
+                self.m = np.asarray(data[f"{gen}_m"], np.float32).copy()
+                self.v = np.asarray(data[f"{gen}_v"], np.float32).copy()
+                return True
+        raise ValueError(
+            f"optimizer state at {path} matches neither stored generation"
+            " against the restored central vector's CRC — refusing to "
+            "pair momentum with the wrong vector generation")
+
+
+def server_opt_from_args(args):
+    """THE ``--server-opt``/``--server-lr``/``--server-momentum``
+    extraction, shared by every CLI entry (single, static-sharded,
+    elastic): ``(kind_or_None, kwargs)`` — a new knob lands here once."""
+    kind = getattr(args, "server_opt", "") or ""
+    if not kind or kind == "none":
+        return None, {}
+    return kind, {"lr": float(getattr(args, "server_lr", 1.0)),
+                  "momentum": float(getattr(args, "server_momentum", 0.9))}
+
+
+def optimizer_from_args(args, n_params: int) -> Optional[ShardedOptimizer]:
+    """CLI face: a full-range optimizer for a single/shard server, or
+    None when the plane is off."""
+    kind, kw = server_opt_from_args(args)
+    if kind is None:
+        return None
+    return ShardedOptimizer(kind, 0, int(n_params), **kw)

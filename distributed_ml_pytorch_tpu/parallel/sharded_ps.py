@@ -53,6 +53,16 @@ from distributed_ml_pytorch_tpu.utils.serialization import (
 Pytree = Any
 
 
+def _server_opt_args(args):
+    """One extraction point for the server-optimizer CLI knobs (the
+    canonical logic lives in ``optplane.server_opt_from_args``)."""
+    from distributed_ml_pytorch_tpu.parallel.optplane import (
+        server_opt_from_args,
+    )
+
+    return server_opt_from_args(args)
+
+
 def shard_ranges(n: int, n_shards: int) -> List[Tuple[int, int]]:
     """Contiguous near-equal [lo, hi) ranges covering ``range(n)`` — the
     first ``n % n_shards`` shards are one element longer."""
@@ -81,12 +91,18 @@ def make_shard_server(
     staleness_damping: float = 0.0,
     wal: bool = False,
     admission=None,
+    combine: str = "add",
+    server_opt: Optional[str] = None,
+    server_opt_kw: Optional[dict] = None,
 ) -> ParameterServer:
     """A shard server: a plain ParameterServer over its contiguous slice.
 
     ``ckpt_dir`` should be per-shard (each server checkpoints only its own
     slice) — callers typically pass ``f"{dir}/shard{shard}"``; with
     ``wal=True`` the shard's write-ahead log lives there too.
+    ``server_opt`` (ISSUE 14) gives the shard a ZeRO-style sharded
+    optimizer owning the momentum/Adam state for EXACTLY its ``[lo, hi)``
+    range — state cost per shard scales 1/k by construction.
     """
     flat = (
         np.asarray(params, np.float32)
@@ -94,6 +110,14 @@ def make_shard_server(
         else np.asarray(ravel_model_params(model), np.float32)
     )
     lo, hi = shard_ranges(flat.shape[0], n_shards)[shard]
+    optimizer = None
+    if server_opt:
+        from distributed_ml_pytorch_tpu.parallel.optplane import (
+            ShardedOptimizer,
+        )
+
+        optimizer = ShardedOptimizer(server_opt, lo, hi,
+                                     **(server_opt_kw or {}))
     return ParameterServer(
         params=flat[lo:hi],
         transport=transport,
@@ -104,6 +128,8 @@ def make_shard_server(
         staleness_damping=staleness_damping,
         wal=wal,
         admission=admission,
+        combine=combine,
+        optimizer=optimizer,
     )
 
 
@@ -145,6 +171,9 @@ class ShardedAsynchronous:
         coord=None,
         transport_factory=None,
         shard_map=None,
+        compress: Optional[str] = None,
+        compress_opts: Optional[dict] = None,
+        error_feedback: bool = True,
     ):
         validate_downpour_args(lr, n_push, n_pull)
         if not transports:
@@ -230,6 +259,22 @@ class ShardedAsynchronous:
         else:
             self.ranges = shard_ranges(self._flat_n, len(self.transports))
         self._device_step = make_downpour_device_step(self.tx, self._pad)
+        # --- compressed push wire (ISSUE 14) ----------------------------
+        #: ONE full-length error-feedback encoder: the residual is
+        #: indexed absolutely, so an elastic cutover reslices it exactly
+        #: like the accumulator — no residual is lost when a range moves.
+        #: Touched only on the flusher thread (drained before cutovers
+        #: and before finish()'s inline push).
+        self.encoder = None
+        if compress:
+            from distributed_ml_pytorch_tpu.utils.compress import (
+                CompressingEncoder,
+                make_codec,
+            )
+
+            self.encoder = CompressingEncoder(
+                self._flat_n, make_codec(compress, **(compress_opts or {})),
+                error_feedback=error_feedback)
         # per-shard liveness: a dead shard degrades that SLICE to purely-
         # local SGD (same contract as Asynchronous._send, per shard — the
         # other shards keep their push/pull service). ``heartbeats[s]`` is
@@ -287,6 +332,18 @@ class ShardedAsynchronous:
         norm = float(np.linalg.norm(arr.astype(np.float64, copy=False)))
         if np.isfinite(norm):
             self._gnorm_ewma.update(norm)
+        if self.encoder is not None:
+            # compressed wire (ISSUE 14): each shard's slice rides a
+            # CompressedUpdate (head, body) pair through sendv; elastic
+            # pushes carry the same (version, lo, hi) stamp ShardPush
+            # does, so the server's range gate is codec-agnostic
+            ver = max(0, self.map_version) if self.coord is not None else 0
+            for s, (lo, hi) in enumerate(self.ranges):
+                stamp = ((ver, lo, hi) if self.coord is not None else None)
+                head, body = self.encoder.encode_range(arr, lo, hi,
+                                                       stamp=stamp)
+                self._sendv(s, MessageCode.CompressedUpdate, (head, body))
+            return
         if self.coord is not None:
             from distributed_ml_pytorch_tpu.utils.messaging import _split16
 
@@ -321,6 +378,19 @@ class ShardedAsynchronous:
             return
         try:
             send_message(code, payload, transport=self.transports[shard])
+        except (OSError, ConnectionError):
+            self._mark_down(shard)
+
+    def _sendv(self, shard: int, code: MessageCode, parts) -> None:
+        """The ``_send`` degrade discipline for multi-part (scatter/
+        gather) frames — compressed pushes ride here."""
+        if self.shard_down[shard]:
+            return  # pulls remain the revival probe (_send)
+        if self.heartbeats is not None and self.heartbeats[shard].peer_down:
+            self._mark_down(shard)
+            return
+        try:
+            self.transports[shard].sendv(code, parts)
         except (OSError, ConnectionError):
             self._mark_down(shard)
 
@@ -689,6 +759,7 @@ def run_sharded_ps_process(args) -> int:
                 jnp.zeros((1, 32, 32, 3)),
             )["params"]
             ckpt_dir = getattr(args, "ckpt_dir", "") or None
+            opt_kind, opt_kw = _server_opt_args(args)
             server = make_shard_server(
                 model=params,
                 shard=shard,
@@ -703,6 +774,9 @@ def run_sharded_ps_process(args) -> int:
                 # loudly (ParameterServer does), not silently run undurable
                 wal=getattr(args, "wal", False),
                 admission=_admission_from_args(args),
+                combine=getattr(args, "combine", "add") or "add",
+                server_opt=opt_kind,
+                server_opt_kw=opt_kw,
             )
             if getattr(args, "resume", False) and server.maybe_restore():
                 print(f"shard server {shard}: resumed central params")
@@ -741,10 +815,15 @@ def _run_static_worker(args, k, n_workers, kind, reliable) -> int:
                 hb = HeartbeatSender(t, interval=hb_interval)
                 hb.start()
                 heartbeats.append(hb)
+        from distributed_ml_pytorch_tpu.utils.compress import (
+            compress_from_args,
+        )
+
         factory = lambda params, tx: ShardedAsynchronous(
             params, lr=args.lr, n_push=args.num_push, n_pull=args.num_pull,
             tx=tx, transports=transports, rejoin=getattr(args, "rejoin", False),
             heartbeats=heartbeats or None,
+            **compress_from_args(args),
         )
         _params, logger = train_worker(
             args, transports[0], opt_factory=factory
@@ -827,6 +906,16 @@ def _run_elastic_ps_process(args, k, n_workers, kind, reliable,
                 star = _Rel(star, ack_on_delivery=not getattr(
                     args, "wal", False))
             ckpt_dir = getattr(args, "ckpt_dir", "") or None
+            elastic_opt = None
+            opt_kind, opt_kw = _server_opt_args(args)
+            if opt_kind is not None:
+                from distributed_ml_pytorch_tpu.parallel.optplane import (
+                    ShardedOptimizer,
+                )
+
+                # the coordinator assigns the range; start empty, resize
+                # on the first shard map like the central slice does
+                elastic_opt = ShardedOptimizer(opt_kind, 0, 0, **opt_kw)
             server = ElasticShardServer(
                 server_id=args.rank + 1, n_params=flat.shape[0],
                 transport=star, coord=client, init_params=flat,
@@ -838,7 +927,9 @@ def _run_elastic_ps_process(args, k, n_workers, kind, reliable,
                 # wrapped ParameterServer instead of silently dropping WAL
                 wal=getattr(args, "wal", False),
                 admission=_admission_from_args(args),
-                manifest_path=getattr(args, "manifest_path", "") or None)
+                manifest_path=getattr(args, "manifest_path", "") or None,
+                combine=getattr(args, "combine", "add") or "add",
+                optimizer=elastic_opt)
             try:
                 server.run()
                 print(f"elastic shard server {args.rank}: done "
@@ -873,13 +964,18 @@ def _run_elastic_ps_process(args, k, n_workers, kind, reliable,
             created.append(t)
             return t
 
+        from distributed_ml_pytorch_tpu.utils.compress import (
+            compress_from_args,
+        )
+
         try:
             initial = [factory(e) for e in m.entries]
             opt_factory = lambda p, tx: ShardedAsynchronous(
                 p, lr=args.lr, n_push=args.num_push, n_pull=args.num_pull,
                 tx=tx, transports=initial,
                 coord=client, transport_factory=factory, shard_map=m,
-                rejoin=getattr(args, "rejoin", False))
+                rejoin=getattr(args, "rejoin", False),
+                **compress_from_args(args))
             _params, logger = train_worker(
                 args, initial[0], opt_factory=opt_factory)
             path = logger.to_csv("node{}.csv".format(star_rank))

@@ -227,6 +227,40 @@ def build_parser() -> argparse.ArgumentParser:
                         "versions since that worker's last pull (straggler "
                         "mitigation, arxiv 2006.02924); 0 = reference "
                         "behavior (apply raw)")
+    # --- scalable optimizer plane (ISSUE 14) ----------------------------
+    p.add_argument("--compress", type=str, default="none",
+                   choices=("none", "int8", "topk"),
+                   help="PS-mode gradient wire compression "
+                        "(utils/compress.py): pushes ride CompressedUpdate "
+                        "frames with per-worker error-feedback residuals — "
+                        "int8 = per-block symmetric quantization (~4x fewer "
+                        "bytes), topk = sparsified (idx, value) pairs; the "
+                        "server decodes BEFORE the admission gate and WAL")
+    p.add_argument("--compress-block", type=int, default=1024, metavar="B",
+                   help="int8 quantization block size (one absmax scale per "
+                        "block; multiple of 4)")
+    p.add_argument("--compress-topk", type=float, default=0.01, metavar="F",
+                   help="top-k fraction of elements kept per push "
+                        "(--compress topk)")
+    p.add_argument("--combine", type=str, default="add",
+                   choices=("add", "adasum"),
+                   help="how the PS combines concurrent pushes: add = the "
+                        "reference behavior; adasum = angle-aware merge "
+                        "against the overlap applied since the pusher's "
+                        "last pull (arXiv:2006.02924) — the alternative to "
+                        "--staleness-damping (mutually exclusive)")
+    p.add_argument("--server-opt", type=str, default="none",
+                   choices=("none", "sgdm", "adam"),
+                   help="ZeRO-style sharded server-side optimizer "
+                        "(parallel/optplane.py): each server/shard owns "
+                        "momentum (sgdm) or Adam moments for EXACTLY its "
+                        "range — state cost scales 1/shards; state rides "
+                        "checkpoints + WAL replay (arXiv:2004.13336)")
+    p.add_argument("--server-lr", type=float, default=1.0, metavar="LR",
+                   help="server-side optimizer step scale (1.0 with sgdm "
+                        "momentum 0 reproduces the plain add)")
+    p.add_argument("--server-momentum", type=float, default=0.9, metavar="M",
+                   help="server-side sgdm momentum over incoming deltas")
     return p
 
 

@@ -571,13 +571,29 @@ class FaultyTransport(Transport):
             return payload
         out = np.array(arr, copy=True)
         if rule.kind == "scale":
-            out[lo:] *= np.float32(rule.factor)
+            with np.errstate(over="ignore"):
+                # huge factors (the compressed-poison schedules use 1e30)
+                # overflowing to inf IS the modeled corruption
+                out[lo:] *= np.float32(rule.factor)
         elif rule.kind == "nan":
             out[lo + int(u[1] * n) % n] = np.float32(np.nan)
         else:  # bitflip
             bits = out.view(np.uint32)
             bits[lo + int(u[1] * n) % n] ^= np.uint32(1) << np.uint32(
                 int(u[2] * 32) % 32)
+        if inner == int(MessageCode.CompressedUpdate):
+            # the compressed frame carries its OWN body CRC (ISSUE 14):
+            # SDC models corruption in the sender's memory BEFORE the
+            # frame was stamped, so the injector must re-stamp it (rules
+            # should skip the 12-float head — compress.HEAD_LEN — so the
+            # poison lands in the body, not the protocol fields) or the
+            # decoder would reject the frame as detectably corrupt and
+            # the "silent" corruption would heal itself
+            from distributed_ml_pytorch_tpu.utils.compress import (
+                restamp_crc,
+            )
+
+            restamp_crc(out, body_off)
         if enveloped:
             # re-stamp: the corruption happened "before" the envelope, so
             # the frame must arrive CRC-clean — bit-perfect on the wire,

@@ -1,0 +1,401 @@
+"""Wire-level gradient compression with error feedback (ISSUE 14).
+
+The PS plane has shipped raw 9.9 MB float32 gradient frames since the
+seed. This module shrinks the push wire by 3-4x without changing what the
+server *applies*:
+
+- **int8 uniform quantization** (:class:`Int8Codec`) — per-block absmax
+  scales, the same symmetric-int8 recipe as the serving cache's
+  ``kv_quant`` path (``models/transformer.quantize_kv``): each
+  ``block``-sized chunk keeps its own scale so one outlier cannot crush
+  every other element's resolution. ~``n/4`` wire floats plus one scale
+  per block.
+- **top-k sparsification** (:class:`TopKCodec`) — the k
+  largest-magnitude elements as exact (index, value) pairs; ``2k`` wire
+  floats. Indices ride the float32 wire exactly (they must stay below
+  2^24, checked at encode).
+
+Both codecs are LOSSY, which is why :class:`CompressingEncoder` carries
+**per-worker error-feedback residuals** (arXiv:1809.07599 family): what a
+push could not represent is added into the next push instead of being
+dropped, so the SUM of decoded updates tracks the sum of raw updates to
+within one quantization step — the property that keeps compressed
+DownPour inside the fault-free convergence corridor
+(``tests/test_compress.py`` pins the identity, ``analysis/distmodel.py``'s
+``no_error_feedback`` mutation shows what breaks without it).
+
+Wire format — the ``CompressedUpdate`` frame (code 34, WIRE_SCHEMAS)::
+
+    [codec, n_lo, n_hi, crc_lo, crc_hi, param,
+     ver_lo, ver_hi, lo_lo, lo_hi, hi_lo, hi_hi,   # elastic stamp (or 0s)
+     *body]
+
+``codec`` names the codec (:data:`CODEC_INT8` / :data:`CODEC_TOPK`),
+``n`` the decoded length, ``param`` the codec parameter (block size /
+k), and ``crc`` a crc32 of the body bytes — the decoder's own integrity
+gate for transports without the reliability envelope (and the field the
+chaos layer's SDC injection must RE-STAMP, :func:`restamp_crc`, so
+silent corruption stays silent on the wire and only the admission gate
+can see it). The stamp halves mirror ``ShardPush``'s
+``(map version, absolute lo, hi)`` head; all-zero means unstamped (the
+single-server wire). The frame is built as ``(head, body)`` parts and
+handed to ``Transport.sendv`` — the reliability envelope then frames it
+zero-copy (one small head+body join is the only copy the compressed
+path pays, on a body already 3-4x smaller than the dense frame).
+
+Decoding happens at the SERVER, before anything else looks at the
+update: the admission gate evaluates the **decoded** norm (compression
+cannot slip the gate), the WAL logs the **decoded** delta plus the codec
+id (replay never re-decodes), and the apply path is byte-identical to a
+dense push of the same delta.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Optional, Tuple
+
+import numpy as np
+
+#: codec ids on the wire (float32-exact small ints)
+CODEC_DENSE = 0
+CODEC_INT8 = 1
+CODEC_TOPK = 2
+
+CODEC_NAMES = {CODEC_DENSE: "dense", CODEC_INT8: "int8", CODEC_TOPK: "topk"}
+
+#: fixed head length of a CompressedUpdate frame (WIRE_SCHEMAS fields)
+HEAD_LEN = 12
+
+#: float32 carries integers exactly only below 2^24 — top-k indices (and
+#: the decoded length halves via _split16) must stay under it
+_MAX_EXACT = 1 << 24
+
+
+class CompressionError(ValueError):
+    """A compressed frame that cannot be decoded (bad codec id, body CRC
+    mismatch, out-of-range indices, size mismatch). The server drops such
+    frames as malformed — loudly counted, never applied."""
+
+
+def body_crc(body: np.ndarray) -> int:
+    """crc32 over the body's raw bytes (bit pattern, not float value —
+    int8-packed words survive the round trip exactly)."""
+    mv = memoryview(np.ascontiguousarray(body)).cast("B")
+    return zlib.crc32(mv) & 0xFFFFFFFF
+
+
+class Int8Codec:
+    """Per-block symmetric int8 quantization (the ``kv_quant`` recipe
+    lifted from the serving cache onto the gradient wire): each block of
+    ``block`` elements is scaled by its absmax/127 and rounded; the body
+    is ``[scales (nblocks f32), packed int8 (ceil(n_pad/4) f32 words)]``.
+
+    Exactness bound: ``|x - decode(encode(x))| <= scale_block / 2``
+    elementwise (round-to-nearest), with ``scale_block =
+    max(absmax_block, eps) / 127`` — pinned by the numerics tests."""
+
+    id = CODEC_INT8
+    name = "int8"
+
+    def __init__(self, block: int = 1024):
+        if block < 4 or block % 4:
+            raise ValueError(f"int8 block must be a positive multiple of 4, "
+                             f"got {block}")
+        self.block = int(block)
+
+    @property
+    def param(self) -> int:
+        return self.block
+
+    def encode(self, x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, np.float32).ravel()
+        n = x.size
+        nblocks = -(-n // self.block)
+        padded = np.zeros(nblocks * self.block, np.float32)
+        padded[:n] = x
+        blocks = padded.reshape(nblocks, self.block)
+        absmax = np.max(np.abs(blocks), axis=1)
+        scales = np.maximum(absmax / 127.0, 1e-12).astype(np.float32)
+        q = np.clip(np.round(blocks / scales[:, None]), -127, 127
+                    ).astype(np.int8)
+        packed = q.reshape(-1).view(np.float32)  # 4 int8 per f32 word
+        return np.concatenate([scales, packed])
+
+    def decode(self, body: np.ndarray, n: int, param: int) -> np.ndarray:
+        block = int(param)
+        if block < 4 or block % 4:
+            raise CompressionError(f"bad int8 block {block}")
+        nblocks = -(-n // block)
+        expect = nblocks + (nblocks * block) // 4
+        body = np.asarray(body, np.float32).ravel()
+        if body.size != expect:
+            raise CompressionError(
+                f"int8 body holds {body.size} floats, expected {expect} "
+                f"for n={n} block={block}")
+        scales = body[:nblocks]
+        q = np.ascontiguousarray(body[nblocks:]).view(np.int8)
+        out = (q.reshape(nblocks, block).astype(np.float32)
+               * scales[:, None]).reshape(-1)[:n]
+        return np.ascontiguousarray(out, dtype=np.float32)
+
+    def wire_floats(self, n: int) -> int:
+        nblocks = -(-n // self.block)
+        return nblocks + (nblocks * self.block) // 4
+
+
+class TopKCodec:
+    """Keep the ``k`` largest-|x| elements as exact (index, value) pairs.
+
+    ``k`` derives from ``k_frac`` of the encoded length (at least 1).
+    Selection is a stable sort on magnitude so the encoding — and
+    therefore the error-feedback residual trajectory and every chaos
+    log downstream — is a pure function of the input, never of
+    argpartition's tie-breaking."""
+
+    id = CODEC_TOPK
+    name = "topk"
+
+    def __init__(self, k_frac: float = 0.01):
+        if not 0.0 < k_frac <= 1.0:
+            raise ValueError(f"need 0 < k_frac <= 1, got {k_frac}")
+        self.k_frac = float(k_frac)
+
+    def k_for(self, n: int) -> int:
+        return max(1, min(n, int(round(self.k_frac * n))))
+
+    @property
+    def param(self) -> int:  # resolved per-encode; 0 in the spec slot
+        return 0
+
+    def encode(self, x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, np.float32).ravel()
+        n = x.size
+        if n >= _MAX_EXACT:
+            raise ValueError(
+                f"top-k indices for n={n} are not float32-exact (>= 2^24)")
+        k = self.k_for(n)
+        # O(n) selection with DETERMINISTIC ties: everything strictly above
+        # the k-th magnitude, then boundary ties by lowest index — the same
+        # set a stable sort on -|x| yields, without the 9.9 MB-vector sort
+        a = np.abs(x)
+        if k >= n:
+            idx = np.arange(n, dtype=np.int64)
+        else:
+            kth = np.partition(a, n - k)[n - k]
+            above = np.flatnonzero(a > kth)
+            ties = np.flatnonzero(a == kth)[:k - above.size]
+            idx = np.sort(np.concatenate([above, ties]))
+        return np.concatenate([idx.astype(np.float32), x[idx]])
+
+    def decode(self, body: np.ndarray, n: int, param: int) -> np.ndarray:
+        body = np.asarray(body, np.float32).ravel()
+        if body.size % 2:
+            raise CompressionError(
+                f"top-k body of {body.size} floats is not (idx, val) pairs")
+        k = body.size // 2
+        if not 1 <= k <= n:
+            raise CompressionError(f"top-k k={k} out of range for n={n}")
+        idx = body[:k]
+        if not np.isfinite(idx).all():
+            raise CompressionError("top-k indices are nonfinite")
+        ii = idx.astype(np.int64)
+        if (ii < 0).any() or (ii >= n).any() or (ii != idx).any():
+            raise CompressionError("top-k indices out of range / non-integer")
+        out = np.zeros(n, np.float32)
+        out[ii] = body[k:]
+        return out
+
+    def wire_floats(self, n: int) -> int:
+        return 2 * self.k_for(n)
+
+
+def make_codec(name: str, *, block: int = 1024, k_frac: float = 0.01):
+    """Codec factory behind the ``--compress int8|topk`` CLI face."""
+    if name == "int8":
+        return Int8Codec(block=block)
+    if name == "topk":
+        return TopKCodec(k_frac=k_frac)
+    raise ValueError(f"unknown compression codec {name!r} "
+                     "(known: int8, topk)")
+
+
+_CODECS_BY_ID = {CODEC_INT8: Int8Codec, CODEC_TOPK: TopKCodec}
+
+
+def pack_frame(codec_id: int, n: int, param: int, body: np.ndarray,
+               stamp: Optional[Tuple[int, int, int]] = None,
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """``(head, body)`` parts of one CompressedUpdate frame, ready for
+    ``Transport.sendv``. ``stamp`` is the elastic ``(version, lo, hi)``
+    triple (``None`` = unstamped zeros, the single-server wire)."""
+    from distributed_ml_pytorch_tpu.utils.messaging import _split16
+
+    ver, lo, hi = stamp if stamp is not None else (0, 0, 0)
+    crc = body_crc(body)
+    head = np.asarray(
+        [float(codec_id), *_split16(int(n)), *_split16(crc),
+         float(int(param)), *_split16(int(ver)), *_split16(int(lo)),
+         *_split16(int(hi))], np.float32)
+    return head, np.asarray(body, np.float32).ravel()
+
+
+def unpack_frame(payload: np.ndarray,
+                 ) -> Tuple[int, int, int, Optional[Tuple[int, int, int]],
+                            np.ndarray]:
+    """Split + verify one CompressedUpdate payload:
+    ``(codec_id, n, param, stamp_or_None, body)``. Raises
+    :class:`CompressionError` on a short frame, a nonfinite head, or a
+    body CRC mismatch (the decoder's own integrity gate)."""
+    from distributed_ml_pytorch_tpu.utils.messaging import _join16
+
+    arr = np.asarray(payload, np.float32).ravel()
+    if arr.size < HEAD_LEN + 1:
+        raise CompressionError(
+            f"CompressedUpdate frame of {arr.size} floats is shorter than "
+            f"head+1 ({HEAD_LEN + 1})")
+    if not np.isfinite(arr[:HEAD_LEN]).all():
+        raise CompressionError("CompressedUpdate head is nonfinite")
+    codec_id = int(arr[0])
+    n = _join16(arr[1], arr[2])
+    crc = _join16(arr[3], arr[4])
+    param = int(arr[5])
+    ver = _join16(arr[6], arr[7])
+    lo = _join16(arr[8], arr[9])
+    hi = _join16(arr[10], arr[11])
+    body = arr[HEAD_LEN:]
+    if body_crc(body) != crc:
+        raise CompressionError("CompressedUpdate body CRC mismatch")
+    stamp = None if (ver, lo, hi) == (0, 0, 0) else (ver, lo, hi)
+    return codec_id, n, param, stamp, body
+
+
+def decode_update(payload: np.ndarray,
+                  ) -> Tuple[Optional[Tuple[int, int, int]], int, np.ndarray]:
+    """Full server-side decode of one CompressedUpdate payload:
+    ``(stamp_or_None, codec_id, decoded_vector)``. This runs BEFORE the
+    admission gate, the WAL, and the apply path — every downstream
+    consumer sees the decoded delta, never the wire bytes."""
+    codec_id, n, param, stamp, body = unpack_frame(payload)
+    cls = _CODECS_BY_ID.get(codec_id)
+    if cls is None:
+        raise CompressionError(f"unknown codec id {codec_id}")
+    decoded = cls().decode(body, n, param)  # decode is param-driven
+    return stamp, codec_id, decoded
+
+
+def peek_stamp(payload: np.ndarray) -> Optional[Tuple[int, int, int]]:
+    """The elastic ``(version, lo, hi)`` stamp WITHOUT decoding the body —
+    the elastic shard server's range gate must run before it pays for a
+    decode it may drop."""
+    from distributed_ml_pytorch_tpu.utils.messaging import _join16
+
+    arr = np.asarray(payload, np.float32).ravel()
+    if arr.size < HEAD_LEN or not np.isfinite(arr[6:HEAD_LEN]).all():
+        return None
+    ver = _join16(arr[6], arr[7])
+    lo = _join16(arr[8], arr[9])
+    hi = _join16(arr[10], arr[11])
+    return None if (ver, lo, hi) == (0, 0, 0) else (ver, lo, hi)
+
+
+def restamp_crc(arr: np.ndarray, head_off: int) -> None:
+    """Recompute the body CRC of the CompressedUpdate frame starting at
+    ``arr[head_off:]`` in place — the chaos layer's SDC hook: corruption
+    modeled in the sender's memory happens *before* the frame was
+    CRC-stamped, so after corrupting the body the injector must re-stamp
+    this CRC (and then the reliability envelope's) or the poison would be
+    detectably corrupt instead of silent."""
+    if arr.size < head_off + HEAD_LEN + 1:
+        return
+    from distributed_ml_pytorch_tpu.utils.messaging import _split16
+
+    crc = body_crc(arr[head_off + HEAD_LEN:])
+    lo, hi = _split16(crc)
+    arr[head_off + 3] = lo
+    arr[head_off + 4] = hi
+
+
+class CompressingEncoder:
+    """Worker-side compressed-push encoder with per-worker error feedback.
+
+    One instance per worker, over the FULL flat vector (length ``n``):
+    the residual is indexed absolutely, so elastic shard-map cutovers
+    reslice it for free exactly like the accumulator. Per push of range
+    ``[lo, hi)``::
+
+        p        = raw[lo:hi] + residual[lo:hi]   # carry what was lost
+        body     = codec.encode(p)
+        residual[lo:hi] = p - codec.decode(body)  # what THIS push lost
+
+    which yields the exact identity ``sum(decoded pushes) ==
+    sum(raw pushes) - final residual`` — the quantization error never
+    compounds, it is merely deferred (``error_feedback=False`` disables
+    the residual update for the distmodel mutation twin and drops the
+    guarantee).
+
+    Thread contract: called from ONE thread (the push flusher; ``finish``
+    drains it before the final inline push) — no lock, like the
+    accumulator it mirrors.
+    """
+
+    def __init__(self, n: int, codec, *, error_feedback: bool = True):
+        self.n = int(n)
+        self.codec = codec
+        self.error_feedback = bool(error_feedback)
+        self.residual = np.zeros(self.n, np.float32)
+        #: wire accounting (the bench + acceptance measurables): float32
+        #: words actually framed vs the dense frames they replace
+        self.pushes = 0
+        self.wire_floats = 0
+        self.dense_floats = 0
+        #: times a nonfinite residual was reset to zero (diverged pushes)
+        self.residual_resets = 0
+
+    def encode_range(self, arr: np.ndarray, lo: int, hi: int,
+                     stamp: Optional[Tuple[int, int, int]] = None,
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """One compressed push of ``arr[lo:hi]`` as ``(head, body)``
+        sendv parts, folding in (and updating) the range's residual."""
+        sl = np.asarray(arr, np.float32).ravel()[lo:hi]
+        p = sl + self.residual[lo:hi]
+        body = self.codec.encode(p)
+        param = (self.codec.k_for(hi - lo)
+                 if isinstance(self.codec, TopKCodec) else self.codec.param)
+        if self.error_feedback:
+            r = p - self.codec.decode(body, hi - lo, param)
+            if not np.isfinite(r).all():
+                # a nonfinite push (diverged worker) must not poison the
+                # residual FOREVER — the server quarantines the push
+                # itself; the carry restarts clean (counted, not silent)
+                r = np.zeros_like(r)
+                self.residual_resets += 1
+            self.residual[lo:hi] = r
+        head, body = pack_frame(self.codec.id, hi - lo, param, body,
+                                stamp=stamp)
+        self.pushes += 1
+        self.wire_floats += head.size + body.size
+        self.dense_floats += (hi - lo) + (0 if stamp is None else 6)
+        return head, body
+
+    def compression_ratio(self) -> float:
+        """Dense-to-wire byte ratio over every push so far (>= 1)."""
+        if self.wire_floats == 0:
+            return 1.0
+        return self.dense_floats / self.wire_floats
+
+
+def compress_from_args(args):
+    """CLI face shared by the training entries: ``--compress int8|topk``
+    (+ ``--compress-block`` / ``--compress-topk``) -> the kwargs the
+    DownPour clients take, or ``{}`` when compression is off."""
+    name = getattr(args, "compress", "") or ""
+    if not name or name == "none":
+        return {}
+    return {
+        "compress": name,
+        "compress_opts": {
+            "block": int(getattr(args, "compress_block", 1024)),
+            "k_frac": float(getattr(args, "compress_topk", 0.01)),
+        },
+    }

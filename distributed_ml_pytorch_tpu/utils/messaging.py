@@ -131,6 +131,8 @@ class MessageCode(enum.IntEnum):
     ActivationGrad = 31
     StageReady = 32
     StageAssign = 33
+    # --- scalable optimizer plane (ISSUE 14): compressed gradient wire ---
+    CompressedUpdate = 34
 
 
 #: dedup-key vocabulary (ISSUE 13): WHICH receiver-side guard makes an
@@ -469,6 +471,23 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
             "an entry whose member INCARNATION changed by re-shipping "
             "retained (step, mb) traffic at or past that entry's "
             "watermark — the bounded-replay restart contract"),
+    MessageCode.CompressedUpdate: PayloadSchema(
+        fields=("codec", "n_lo", "n_hi", "crc_lo", "crc_hi", "param",
+                "ver_lo", "ver_hi", "lo_lo", "lo_hi", "hi_lo", "hi_hi"),
+        rest="body", rest_min=1, handled_by=("ps", "coord"),
+        dedup_key="env_seq", durability="wal_before_ack",
+        doc="compressed GradientUpdate/ShardPush (ISSUE 14, "
+            "utils/compress.py): codec names the encoding (1 = int8 "
+            "per-block quant, 2 = top-k), n the decoded length, param the "
+            "codec parameter (block size / k), crc a crc32 of the body "
+            "bytes (the decoder's own integrity gate; chaos SDC must "
+            "re-stamp it, compress.restamp_crc). The ver/lo/hi halves "
+            "mirror ShardPush's elastic stamp — all-zero means unstamped "
+            "(single-server wire); elastic servers gate on the RANGE "
+            "before paying for a decode. The server DECODES before the "
+            "admission gate (z-scores on the decoded norm — compression "
+            "cannot slip the gate), WAL-logs the decoded delta plus this "
+            "codec id, then applies — replay never re-decodes"),
 }
 
 

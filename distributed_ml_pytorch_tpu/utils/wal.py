@@ -12,7 +12,13 @@ update can never be lost. A successful checkpoint truncates the log.
 Format — one self-delimiting binary record per applied update::
 
     magic:u32  incarnation:u32  seq:u64  sender:i32
-    env_inc:u32  env_seq:u32  nbytes:u64  crc:u32  payload[nbytes]
+    env_inc:u32  env_seq:u32  codec:u32  nbytes:u64  crc:u32  payload[nbytes]
+
+``codec`` (ISSUE 14) records WHICH wire encoding delivered the update —
+0 for a dense ``GradientUpdate``/``ShardPush``, ``utils/compress.py``'s
+codec ids for a ``CompressedUpdate``. The payload is always the DECODED
+delta (replay never re-decodes); the codec id is provenance the drills
+assert on (a compressed push's WAL record must say so).
 
 - ``incarnation`` stamps the writing server *life* (the same second-stamped
   monotonic counter the reliability layer uses), so a dead life's buffered
@@ -53,8 +59,12 @@ import numpy as np
 
 from distributed_ml_pytorch_tpu.utils.durability import atomic_write
 
-_MAGIC = 0x57414C31  # "WAL1"
-_HEADER = struct.Struct("<IIQiIIQI")  # magic inc seq sender env_inc env_seq nbytes crc
+_MAGIC = 0x57414C32  # "WAL2" (ISSUE 14: the codec field joined the header)
+#: the pre-ISSUE-14 record magic: recognized ONLY to fail loudly — a WAL1
+#: log holds acked state this parser cannot decode, and classing it as a
+#: torn tail would silently resume without it (the one wrong answer)
+_MAGIC_V1 = 0x57414C31  # "WAL1"
+_HEADER = struct.Struct("<IIQiIIIQI")  # magic inc seq sender env_inc env_seq codec nbytes crc
 
 
 class WALError(Exception):
@@ -78,14 +88,18 @@ class WALRecord:
     env_inc: int
     env_seq: int
     payload: np.ndarray
+    #: wire codec that delivered this update (0 = dense; compress.py ids)
+    codec: int = 0
 
 
 def _record_bytes(inc: int, seq: int, sender: int, env_inc: int,
-                  env_seq: int, payload: np.ndarray) -> bytes:
+                  env_seq: int, payload: np.ndarray,
+                  codec: int = 0) -> bytes:
     body = np.asarray(payload, np.float32).tobytes()
     head_sans_crc = struct.pack(
-        "<IIQiIIQ", _MAGIC, inc & 0xFFFFFFFF, seq, sender,
-        env_inc & 0xFFFFFFFF, env_seq & 0xFFFFFFFF, len(body))
+        "<IIQiIII", _MAGIC, inc & 0xFFFFFFFF, seq, sender,
+        env_inc & 0xFFFFFFFF, env_seq & 0xFFFFFFFF, codec & 0xFFFFFFFF
+    ) + struct.pack("<Q", len(body))
     crc = zlib.crc32(body, zlib.crc32(head_sans_crc)) & 0xFFFFFFFF
     return head_sans_crc + struct.pack("<I", crc) + body
 
@@ -97,8 +111,8 @@ def _parse_one(data: bytes, off: int) -> Optional[Tuple[WALRecord, int]]:
     end = off + _HEADER.size
     if end > len(data):
         return None
-    magic, inc, seq, sender, env_inc, env_seq, nbytes, crc = _HEADER.unpack(
-        data[off:end])
+    (magic, inc, seq, sender, env_inc, env_seq, codec, nbytes,
+     crc) = _HEADER.unpack(data[off:end])
     if magic != _MAGIC or nbytes > len(data) - end:
         return None
     body = data[end:end + nbytes]
@@ -107,7 +121,7 @@ def _parse_one(data: bytes, off: int) -> Optional[Tuple[WALRecord, int]]:
     if nbytes % 4:
         return None
     payload = np.frombuffer(body, dtype=np.float32).copy()
-    return (WALRecord(inc, seq, sender, env_inc, env_seq, payload),
+    return (WALRecord(inc, seq, sender, env_inc, env_seq, payload, codec),
             end + nbytes)
 
 
@@ -143,6 +157,15 @@ def replay_wal(path: str) -> Tuple[List[WALRecord], dict]:
     while off < len(data):
         parsed = _parse_one(data, off)
         if parsed is None:
+            if data[off:off + 4] == struct.pack("<I", _MAGIC_V1):
+                # a pre-ISSUE-14 log: its records ARE acked state, just in
+                # the codec-less WAL1 layout — refusing beats silently
+                # resuming without them as a "torn tail"
+                raise WALCorruptionError(
+                    f"{path}: record at byte {off} carries the WAL1 magic "
+                    "— this log predates the codec-stamped WAL2 format; "
+                    "restore it with the pre-upgrade code (checkpoint, "
+                    "then delete the log) instead of losing its records")
             if _any_valid_record_after(data, off + 1):
                 raise WALCorruptionError(
                     f"{path}: record at byte {off} is corrupt but valid "
@@ -189,10 +212,10 @@ class WriteAheadLog:
         self._max_seq = 0
 
     def append(self, seq: int, payload: np.ndarray, *, sender: int = 0,
-               env_inc: int = 0, env_seq: int = 0) -> None:
+               env_inc: int = 0, env_seq: int = 0, codec: int = 0) -> None:
         self._f.write(_record_bytes(
             self.incarnation, int(seq), int(sender), env_inc, env_seq,
-            payload))
+            payload, codec=int(codec)))
         self.pending += 1
         self.appended += 1
         self._max_seq = max(self._max_seq, int(seq))
@@ -223,7 +246,7 @@ class WriteAheadLog:
         self._f.close()
         atomic_write(self.path, b"".join(
             _record_bytes(r.incarnation, r.seq, r.sender, r.env_inc,
-                          r.env_seq, r.payload)
+                          r.env_seq, r.payload, codec=r.codec)
             for r in keep))
         self._f = open(self.path, "ab", buffering=0)
         self.pending = 0
@@ -241,7 +264,7 @@ class WriteAheadLog:
         self._f.close()
         atomic_write(self.path, b"".join(
             _record_bytes(r.incarnation, r.seq, r.sender, r.env_inc,
-                          r.env_seq, r.payload)
+                          r.env_seq, r.payload, codec=r.codec)
             for r in keep))
         self._f = open(self.path, "ab", buffering=0)
         self.pending = 0

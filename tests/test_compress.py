@@ -1,0 +1,417 @@
+"""Compressed gradient wire (ISSUE 14, utils/compress.py).
+
+- codec numerics: int8 encode/decode inside the per-block bound, top-k
+  exact on its support, error-feedback accumulation identity (sum of
+  decoded pushes == sum of raw pushes minus the final residual, which a
+  quantization bound caps);
+- frame integrity: pack/unpack roundtrip with and without the elastic
+  stamp, body-CRC rejection, the chaos SDC re-stamp path (silent on the
+  wire, visible only to the decoded-norm admission gate);
+- PS integration: a compressed push applies exactly the decoded delta,
+  malformed frames drop before accounting, the WAL records carry the
+  codec id, admission evaluates the DECODED norm;
+- THE acceptance (``chaos`` marker): compressed DownPour — int8 + error
+  feedback, 2 workers under seeded drop/dup chaos — converges in the
+  fault-free corridor with >= 3x fewer bytes on the wire, a byte-
+  identical chaos log across 3 runs, and zero quarantines (every
+  compressed push passes the gate on decoded norms);
+- the drill satellite (``drill`` marker): a shard killed mid-compressed-
+  run restores from manifest + WAL with decoded deltas replayed exactly
+  once and per-range optimizer state intact.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.models import LeNet
+from distributed_ml_pytorch_tpu.parallel.async_ps import (
+    Asynchronous,
+    ParameterServer,
+)
+from distributed_ml_pytorch_tpu.utils.chaos import (
+    ChaosPlan,
+    FaultRule,
+    FaultyTransport,
+    SDCRule,
+)
+from distributed_ml_pytorch_tpu.utils.compress import (
+    CODEC_INT8,
+    CODEC_TOPK,
+    HEAD_LEN,
+    CompressingEncoder,
+    CompressionError,
+    Int8Codec,
+    TopKCodec,
+    decode_update,
+    make_codec,
+    pack_frame,
+    restamp_crc,
+    unpack_frame,
+)
+from distributed_ml_pytorch_tpu.utils.health import GradientAdmission
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    InProcessTransport,
+    MessageCode,
+    ReliableTransport,
+)
+from distributed_ml_pytorch_tpu.utils.serialization import ravel_model_params
+
+
+# ----------------------------------------------------------------- codecs
+
+def test_int8_roundtrip_stays_inside_the_per_block_bound():
+    rng = np.random.default_rng(0)
+    x = (rng.normal(size=5000) * rng.choice([0.01, 1.0, 100.0], size=5000)
+         ).astype(np.float32)
+    c = Int8Codec(block=256)
+    body = c.encode(x)
+    xd = c.decode(body, x.size, 256)
+    nblocks = -(-x.size // 256)
+    scales = body[:nblocks]
+    bound = np.repeat(scales / 2.0, 256)[:x.size] + 1e-7
+    assert (np.abs(x - xd) <= bound).all()
+
+
+def test_int8_wire_floats_accounting_is_exact():
+    c = Int8Codec(block=1024)
+    n = 2_472_266  # raveled AlexNet
+    assert c.encode(np.zeros(n, np.float32)).size == c.wire_floats(n)
+    # the headline claim: ~3.9x fewer floats than dense
+    assert n / c.wire_floats(n) > 3.8
+
+
+def test_topk_is_exact_on_its_support_and_zero_elsewhere():
+    x = np.asarray([0.1, -5.0, 0.2, 4.0, -0.3, 0.0], np.float32)
+    c = TopKCodec(k_frac=0.34)  # k = 2
+    xd = c.decode(c.encode(x), x.size, 0)
+    np.testing.assert_array_equal(xd, [0.0, -5.0, 0.0, 4.0, 0.0, 0.0])
+
+
+def test_topk_rejects_out_of_range_indices():
+    body = np.asarray([99.0, 1.0], np.float32)  # idx 99 for n=4
+    with pytest.raises(CompressionError, match="out of range"):
+        TopKCodec().decode(body, 4, 0)
+
+
+@pytest.mark.parametrize("codec_name", ["int8", "topk"])
+def test_error_feedback_accumulation_identity(codec_name):
+    """sum(decoded) + final residual == sum(raw) — exactly for top-k,
+    within float addition noise for int8 (the identity is algebraic:
+    residual_t = p_t - decoded_t telescopes)."""
+    rng = np.random.default_rng(3)
+    n = 64
+    enc = CompressingEncoder(
+        n, make_codec(codec_name, block=16, k_frac=0.1))
+    tot_raw = np.zeros(n, np.float64)
+    tot_dec = np.zeros(n, np.float64)
+    for _ in range(20):
+        u = rng.normal(size=n).astype(np.float32)
+        tot_raw += u
+        head, body = enc.encode_range(u, 0, n)
+        _, _, dec = decode_update(np.concatenate([head, body]))
+        tot_dec += dec
+    np.testing.assert_allclose(tot_raw, tot_dec + enc.residual, atol=1e-4)
+    # and the residual itself is bounded (error deferred, not compounded)
+    assert np.abs(enc.residual).max() < 10.0
+
+
+def test_no_error_feedback_drops_the_identity():
+    """The mutation twin's premise, pinned as a unit: without the
+    residual the drift grows with the push count."""
+    n = 4
+    u = np.asarray([8.0, 4.0, 2.0, 1.0], np.float32)
+    on = CompressingEncoder(n, make_codec("topk", k_frac=0.25))
+    off = CompressingEncoder(n, make_codec("topk", k_frac=0.25),
+                             error_feedback=False)
+    tot_on = np.zeros(n, np.float32)
+    tot_off = np.zeros(n, np.float32)
+    for _ in range(8):
+        for enc, tot in ((on, tot_on), (off, tot_off)):
+            head, body = enc.encode_range(u, 0, n)
+            _, _, dec = decode_update(np.concatenate([head, body]))
+            tot += dec
+    true = 8 * u
+    assert np.abs(true - tot_on).max() <= 12.0
+    assert np.abs(true - tot_off).max() >= 32.0
+
+
+# ----------------------------------------------------------------- frames
+
+def test_frame_roundtrip_with_and_without_stamp():
+    body = Int8Codec(block=4).encode(np.arange(8, dtype=np.float32))
+    head, b = pack_frame(CODEC_INT8, 8, 4, body, stamp=(7, 100, 108))
+    codec_id, n, param, stamp, got = unpack_frame(np.concatenate([head, b]))
+    assert (codec_id, n, param, stamp) == (CODEC_INT8, 8, 4, (7, 100, 108))
+    np.testing.assert_array_equal(got.view(np.uint32), body.view(np.uint32))
+    head, b = pack_frame(CODEC_TOPK, 8, 2,
+                         np.asarray([1.0, 3.0, 5.0, -5.0], np.float32))
+    assert unpack_frame(np.concatenate([head, b]))[3] is None
+
+
+def test_body_crc_rejects_corruption_and_restamp_heals_it():
+    body = Int8Codec(block=4).encode(np.ones(8, np.float32))
+    head, b = pack_frame(CODEC_INT8, 8, 4, body)
+    frame = np.concatenate([head, b])
+    frame[HEAD_LEN] = np.float32(1e30)  # corrupt one body word
+    with pytest.raises(CompressionError, match="CRC"):
+        unpack_frame(frame)
+    restamp_crc(frame, 0)  # the SDC injector's contract
+    unpack_frame(frame)  # decodes (to poison — the gate's job, not ours)
+
+
+def test_sdc_on_compressed_frame_is_wire_silent_and_decoder_visible():
+    """A chaos scale-SDC on a CompressedUpdate riding the reliability
+    envelope arrives CRC-clean (both the envelope and the body CRC are
+    re-stamped) and decodes to a norm explosion only the admission gate
+    can see — the 'silent' in silent data corruption."""
+    plan = ChaosPlan(sdc=[SDCRule(
+        src=1, dst=0, code=int(MessageCode.CompressedUpdate), p=1.0,
+        kind="scale", factor=1e20, skip=HEAD_LEN)])
+    world = InProcessTransport.create_world(2)
+    chaos, log = FaultyTransport.wrap_world(world, plan)
+    srv = ReliableTransport(chaos[0], ack_timeout=0.5)
+    wrk = ReliableTransport(chaos[1], ack_timeout=0.5)
+    enc = CompressingEncoder(8, make_codec("int8", block=4))
+    head, body = enc.encode_range(np.full(8, 0.5, np.float32), 0, 8)
+    wrk.sendv(MessageCode.CompressedUpdate, (head, body), dst=0)
+    msg = srv.recv(timeout=5.0)
+    assert msg is not None and msg[1] == MessageCode.CompressedUpdate
+    assert srv.stats["crc_dropped"] == 0  # bit-perfect on the wire
+    _, _, dec = decode_update(msg[2])  # body CRC passes too
+    assert float(np.linalg.norm(dec)) > 1e10  # ...but the poison decodes
+    assert "sdc-scale" in log.lines()
+    srv.detach()
+    wrk.detach()
+    for t in world.values():
+        t.close()
+
+
+# ----------------------------------------------------------- PS integration
+
+def test_ps_applies_exactly_the_decoded_delta_and_logs_the_codec(tmp_path):
+    ps = ParameterServer(params=np.zeros(32, np.float32),
+                         ckpt_dir=str(tmp_path), ckpt_every=0, wal=True)
+    enc = CompressingEncoder(32, make_codec("int8", block=8))
+    u = np.linspace(-1, 1, 32).astype(np.float32)
+    head, body = enc.encode_range(u, 0, 32)
+    frame = np.concatenate([head, body])
+    _, _, expected = decode_update(frame)
+    ps.handle(1, MessageCode.CompressedUpdate, frame)
+    ps.commit()
+    np.testing.assert_array_equal(ps.central, expected)
+    recs, _ = ps.wal.replay()
+    assert [r.codec for r in recs] == [CODEC_INT8]
+    np.testing.assert_array_equal(recs[0].payload, expected)
+
+
+def test_truncated_compressed_frames_are_counted_never_silent():
+    """A frame shorter than head+1 cannot even reach the decode path —
+    it must still be loudly counted, on both the plain and elastic
+    handlers (review hardening: the guarded elif used to fall through)."""
+    ps = ParameterServer(params=np.zeros(8, np.float32))
+    ps.handle(1, MessageCode.CompressedUpdate, np.zeros(5, np.float32))
+    assert ps.dropped_bad_updates == 1 and ps._apply_seq == 0
+
+
+def test_ps_drops_malformed_compressed_frames_before_accounting():
+    ps = ParameterServer(params=np.zeros(8, np.float32))
+    head, body = pack_frame(CODEC_INT8, 8, 4,
+                            Int8Codec(block=4).encode(np.ones(8)))
+    frame = np.concatenate([head, body])
+    frame[HEAD_LEN + 1] = 42.0  # body corruption: CRC mismatch
+    ps.handle(1, MessageCode.CompressedUpdate, frame)
+    assert ps.dropped_bad_updates == 1 and ps._apply_seq == 0
+    # decoded-size mismatch (frame encodes 8, server holds 4)
+    ps2 = ParameterServer(params=np.zeros(4, np.float32))
+    head, body = pack_frame(CODEC_INT8, 8, 4,
+                            Int8Codec(block=4).encode(np.ones(8)))
+    ps2.handle(1, MessageCode.CompressedUpdate,
+               np.concatenate([head, body]))
+    assert ps2.dropped_bad_updates == 1 and ps2._apply_seq == 0
+
+
+def test_admission_gate_evaluates_the_decoded_norm(tmp_path):
+    """The schema contract: z-scores on the DECODED norm, so a compressed
+    poison cannot slip the gate — and a clean compressed stream trains
+    the same per-worker statistics a dense stream would."""
+    world = InProcessTransport.create_world(2)
+    gate = GradientAdmission(z_max=6.0, warmup=2)
+    ps = ParameterServer(params=np.zeros(16, np.float32),
+                         transport=world[0], admission=gate)
+    enc = CompressingEncoder(16, make_codec("int8", block=4))
+    rng = np.random.default_rng(0)
+    for _ in range(4):
+        head, body = enc.encode_range(
+            rng.normal(size=16).astype(np.float32), 0, 16)
+        ps.handle(1, MessageCode.CompressedUpdate,
+                  np.concatenate([head, body]))
+    assert ps.quarantined == 0 and gate.admitted == 4
+    # a poison whose WIRE bytes look ordinary but whose decode explodes:
+    # scale the body (scales included) like the SDC rule does
+    head, body = enc.encode_range(
+        rng.normal(size=16).astype(np.float32), 0, 16)
+    frame = np.concatenate([head, body * np.float32(1e20)])
+    restamp_crc(frame, 0)
+    ps.handle(1, MessageCode.CompressedUpdate, frame)
+    assert ps.quarantined == 1 and ps._apply_seq == 4
+    for t in world.values():
+        t.close()
+
+
+# ------------------------------------------------------------ THE acceptance
+
+_MODEL = LeNet()
+_STEPS = 16
+_BATCH = 16
+
+
+@pytest.fixture(scope="module")
+def ps_fixture():
+    from distributed_ml_pytorch_tpu.data import load_cifar10
+    from distributed_ml_pytorch_tpu.training.trainer import (
+        cross_entropy_loss,
+    )
+
+    x, y, *_ = load_cifar10(n_train=256, n_test=32, synthetic=True)
+
+    @jax.jit
+    def grad_fn(p, bx, by, rng):
+        def loss_fn(q):
+            logits = _MODEL.apply({"params": q}, bx, train=True,
+                                  rngs={"dropout": rng})
+            return cross_entropy_loss(logits, by)
+
+        return jax.value_and_grad(loss_fn)(p)
+
+    params0 = _MODEL.init(jax.random.key(0),
+                          jnp.zeros((1, 32, 32, 3)))["params"]
+    return x, y, grad_fn, params0
+
+
+def _run_compressed_world(ps_fixture, plan=None, compress="int8",
+                          admission=True, n_workers=2):
+    """One in-process compressed-DownPour run; returns (losses, log,
+    server, encoders)."""
+    x, y, grad_fn, params0 = ps_fixture
+    world = InProcessTransport.create_world(n_workers + 1)
+    log = None
+    if plan is not None:
+        world, log = FaultyTransport.wrap_world(world, plan)
+    gate = GradientAdmission(z_max=8.0, warmup=2) if admission else None
+    server = ParameterServer(
+        params=np.asarray(ravel_model_params(params0)),
+        transport=world[0], n_workers=n_workers, admission=gate)
+    server_thread = threading.Thread(target=server.run,
+                                     kwargs={"timeout": 180})
+    server_thread.start()
+    results, encoders = {}, {}
+
+    def worker(rank):
+        params = jax.tree.map(jnp.asarray, params0)
+        opt = Asynchronous(params, lr=0.05, n_push=4, n_pull=4,
+                           transport=world[rank], compress=compress,
+                           compress_opts={"block": 1024})
+        encoders[rank] = opt.encoder
+        rng = jax.random.key(rank)
+        losses = []
+        for step in range(_STEPS):
+            sel = np.random.default_rng(rank * 100 + step).integers(
+                0, len(x), _BATCH)
+            loss, grads = grad_fn(params, x[sel], y[sel],
+                                  jax.random.fold_in(rng, step))
+            params = opt.step(params, grads)
+            losses.append(float(loss))
+        opt.finish()
+        results[rank] = losses
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(1, n_workers + 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    server_thread.join(timeout=60)
+    assert not server_thread.is_alive(), "server did not shut down"
+    for t in world.values():
+        t.close()
+    return results, log, server, encoders
+
+
+_COMPRESSED_PLAN = ChaosPlan(
+    [FaultRule(code=int(c), drop=0.10, dup=0.05)
+     for c in (MessageCode.CompressedUpdate, MessageCode.ParameterRequest,
+               MessageCode.ParameterUpdate)],
+    seed=42)
+
+
+@pytest.mark.chaos
+def test_compressed_downpour_acceptance(ps_fixture, lock_witness):
+    """THE ISSUE 14 acceptance: int8 + error feedback, 2 workers under
+    seeded drop/dup chaos, 3 runs — fault-free-corridor convergence,
+    >= 3x fewer bytes on the wire than dense, byte-identical chaos logs,
+    and every compressed push admitted on its decoded norm (zero
+    quarantines)."""
+    clean, _, _, _ = _run_compressed_world(ps_fixture, plan=None,
+                                           compress=None, admission=False)
+    clean_final = np.mean([np.mean(l[-6:]) for l in clean.values()])
+
+    logs, finals = [], []
+    for _run in range(3):
+        results, log, server, encoders = _run_compressed_world(
+            ps_fixture, plan=_COMPRESSED_PLAN)
+        assert np.isfinite(server.central).all()
+        assert server.quarantined == 0, server.quarantine
+        assert server.message_counts[MessageCode.CompressedUpdate] > 0
+        assert server.message_counts[MessageCode.GradientUpdate] == 0
+        for enc in encoders.values():
+            assert enc.compression_ratio() >= 3.0, enc.compression_ratio()
+        logs.append(log.lines())
+        finals.append(np.mean([np.mean(l[-6:])
+                               for l in results.values()]))
+        for losses in results.values():
+            assert np.mean(losses[-6:]) < np.mean(losses[:6]), losses
+    assert logs[0] and logs[0] == logs[1] == logs[2], (
+        "fault log not byte-identical across runs")
+    assert "drop" in logs[0] and "dup" in logs[0]
+    for final in finals:
+        assert abs(final - clean_final) < 0.45, (final, clean_final)
+
+
+# ----------------------------------------------------------------- drill
+
+@pytest.mark.drill
+def test_compressed_drill_replays_decoded_deltas_exactly_once(tmp_path):
+    """The ISSUE 14 drill satellite: kill a shard mid-COMPRESSED-run
+    (int8 wire, sgdm sharded optimizer), restore from manifest + WAL —
+    acked => applied holds across the crash-truncation, the replayed WAL
+    records carry the codec id, and the restored shards' per-range
+    optimizer state is live (momentum engaged, sized to the range)."""
+    from distributed_ml_pytorch_tpu.coord.drill import (
+        default_drill_plan,
+        recovery_drill,
+    )
+    from distributed_ml_pytorch_tpu.utils.compress import CODEC_INT8
+
+    out = recovery_drill(base_dir=str(tmp_path), seed=0,
+                         plan=default_drill_plan(0),
+                         compress="int8", server_opt="sgdm")
+    assert out["ok"], (out["errors"], out["accounting_ok"],
+                       out["stuck_workers"])
+    assert out["accounting_ok"], (out["acked"], out["applied"])
+    assert out["replayed_updates"] > 0
+    # every record surviving into the restore carried the int8 codec id —
+    # captured at restore time, before the end-of-run checkpoint truncates
+    assert out["replayed_codecs"], out
+    assert set(out["replayed_codecs"]) == {CODEC_INT8}, (
+        out["replayed_codecs"])
+    for srv in out["servers"]:
+        ps = srv.ps
+        assert ps.optimizer is not None
+        assert ps.optimizer.size == srv.hi - srv.lo
+        assert np.isfinite(ps.optimizer.m).all()
+        # compressed pushes really flowed on every restored shard
+        assert ps.message_counts[MessageCode.CompressedUpdate] > 0

@@ -1,0 +1,286 @@
+"""Optimizer-plane numerics + durability (ISSUE 14, parallel/optplane.py).
+
+- sharded step == dense step on the same range (the ZeRO contract);
+- Adasum algebra: orthogonal -> plain sum, identical -> one copy,
+  anti-aligned -> plain sum (the documented deliberate deviation);
+- PS integration: adasum combine de-weights redundant concurrent pushes,
+  is mutually exclusive with staleness damping;
+- state persistence: checkpoint + WAL replay reproduces BOTH the central
+  vector and the optimizer moments bit-for-bit across a crash, including
+  a crash torn between the checkpoint's renames (the two-generation
+  state file), and elastic resizes keep the overlap's moments.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.parallel.async_ps import ParameterServer
+from distributed_ml_pytorch_tpu.parallel.optplane import (
+    ShardedOptimizer,
+    adasum,
+    adasum_adjust,
+    optimizer_from_args,
+)
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    InProcessTransport,
+    MessageCode,
+)
+
+
+# --------------------------------------------------------------- numerics
+
+@pytest.mark.parametrize("kind", ["sgdm", "adam"])
+def test_sharded_step_equals_dense_step_on_the_same_range(kind):
+    rng = np.random.default_rng(0)
+    dense = ShardedOptimizer(kind, 0, 24, lr=0.1, momentum=0.7)
+    parts = [ShardedOptimizer(kind, lo, hi, lr=0.1, momentum=0.7)
+             for lo, hi in ((0, 7), (7, 16), (16, 24))]
+    for _ in range(6):
+        u = rng.normal(size=24).astype(np.float32)
+        d = dense.step(u)
+        ds = np.concatenate([p.step(u[p.lo:p.hi]) for p in parts])
+        np.testing.assert_array_equal(d, ds)
+    # the 1/shards state claim: each shard holds exactly its range's words
+    assert sum(p.size for p in parts) == dense.size
+    assert all(p.state_floats() == 2 * p.size for p in parts)
+
+
+def test_sgdm_identity_configuration_reproduces_plain_add():
+    """lr=1, momentum=0: the optimizer plane degenerates to the exact
+    reference server behavior (central += payload)."""
+    opt = ShardedOptimizer("sgdm", 0, 5, lr=1.0, momentum=0.0)
+    u = np.asarray([1, -2, 3, -4, 5], np.float32)
+    np.testing.assert_array_equal(opt.step(u), u)
+
+
+def test_adasum_orthogonal_reduces_to_plain_sum():
+    a = np.asarray([1.0, 0.0, 0.0, 2.0], np.float32)
+    b = np.asarray([0.0, 3.0, -1.0, 0.0], np.float32)
+    assert float(a @ b) == 0.0
+    np.testing.assert_allclose(adasum(a, b), a + b)
+    np.testing.assert_allclose(adasum_adjust(a, b), b)
+
+
+def test_adasum_identical_updates_apply_once():
+    a = np.asarray([2.0, -1.0, 0.5], np.float32)
+    np.testing.assert_allclose(adasum(a, a), a, rtol=1e-6)
+
+
+def test_adasum_anti_aligned_falls_back_to_plain_sum():
+    a = np.asarray([1.0, 0.0], np.float32)
+    np.testing.assert_allclose(adasum(a, -a), a + (-a))
+
+
+def test_adasum_zero_overlap_is_the_identity():
+    z = np.zeros(3, np.float32)
+    b = np.asarray([1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_allclose(adasum_adjust(z, b), b)
+
+
+# ----------------------------------------------------------- PS integration
+
+def _pull(ps, world, rank):
+    ps.handle(rank, MessageCode.ParameterRequest, np.zeros(0, np.float32))
+    world[rank].recv(timeout=1.0)  # drain the reply
+
+
+def test_ps_adasum_deweights_redundant_concurrent_pushes():
+    world = InProcessTransport.create_world(3)
+    ps = ParameterServer(params=np.zeros(4, np.float32),
+                         transport=world[0], combine="adasum")
+    _pull(ps, world, 1)
+    _pull(ps, world, 2)
+    d = np.asarray([2.0, 0.0, 0.0, 0.0], np.float32)
+    ps.handle(1, MessageCode.GradientUpdate, d)
+    # worker 2's identical concurrent push: overlap == push -> applies ~0
+    ps.handle(2, MessageCode.GradientUpdate, d)
+    assert ps.central[0] < 3.0, ps.central  # plain add would give 4.0
+    np.testing.assert_allclose(ps.central[0], 2.0, atol=1e-5)
+    for t in world.values():
+        t.close()
+
+
+def test_ps_adasum_orthogonal_pushes_apply_in_full():
+    world = InProcessTransport.create_world(3)
+    ps = ParameterServer(params=np.zeros(4, np.float32),
+                         transport=world[0], combine="adasum")
+    _pull(ps, world, 1)
+    _pull(ps, world, 2)
+    ps.handle(1, MessageCode.GradientUpdate,
+              np.asarray([1.0, 0.0, 0.0, 0.0], np.float32))
+    ps.handle(2, MessageCode.GradientUpdate,
+              np.asarray([0.0, 1.0, 0.0, 0.0], np.float32))
+    np.testing.assert_allclose(ps.central, [1.0, 1.0, 0.0, 0.0], atol=1e-6)
+    for t in world.values():
+        t.close()
+
+
+def test_ps_adasum_and_staleness_damping_are_mutually_exclusive():
+    with pytest.raises(ValueError, match="adasum"):
+        ParameterServer(params=np.zeros(2, np.float32),
+                        combine="adasum", staleness_damping=0.5)
+    with pytest.raises(ValueError, match="combine"):
+        ParameterServer(params=np.zeros(2, np.float32), combine="vibes")
+
+
+# -------------------------------------------------------------- durability
+
+def _mk_ps(tmp_path, n=16, momentum=0.5, **kw):
+    opt = ShardedOptimizer("sgdm", 0, n, lr=1.0, momentum=momentum)
+    return ParameterServer(params=np.zeros(n, np.float32),
+                           ckpt_dir=str(tmp_path), ckpt_every=0, wal=True,
+                           optimizer=opt, **kw)
+
+
+def _push_n(ps, k, n=16, seed=0, sender=1):
+    rng = np.random.default_rng(seed)
+    for _ in range(k):
+        ps.handle(sender, MessageCode.GradientUpdate,
+                  rng.normal(size=n).astype(np.float32))
+
+
+@pytest.mark.drill
+def test_optimizer_state_survives_crash_restore_exactly(tmp_path):
+    """checkpoint + WAL replay reproduces the central vector AND the
+    momentum bit-for-bit — the state really rides checkpoints/WAL."""
+    ps = _mk_ps(tmp_path)
+    _push_n(ps, 3)
+    ps.commit()
+    ps.save_checkpoint()
+    _push_n(ps, 4, seed=1)  # live only in the WAL
+    ps.commit()
+    live_c, live_m, live_t = (ps.central.copy(), ps.optimizer.m.copy(),
+                              ps.optimizer.t)
+    ps.wal.close()
+
+    ps2 = _mk_ps(tmp_path)
+    assert ps2.maybe_restore()
+    np.testing.assert_array_equal(ps2.central, live_c)
+    np.testing.assert_array_equal(ps2.optimizer.m, live_m)
+    assert ps2._apply_seq == 7
+
+
+@pytest.mark.drill
+def test_optimizer_state_pairs_with_the_adopted_generation_on_a_torn_crash(
+        tmp_path):
+    """Crash between the checkpoint's renames: maybe_restore resolves the
+    vector to the PREVIOUS generation — the optimizer state must follow
+    it (the two-generation file), never pair new moments with the old
+    vector."""
+    import json
+
+    ps = _mk_ps(tmp_path)
+    _push_n(ps, 3)
+    ps.commit()
+    ps.save_checkpoint()
+    gen1_m = ps.optimizer.m.copy()
+    gen1_meta = json.load(open(ps._meta_path()))
+    _push_n(ps, 2, seed=1)
+    ps.commit()
+    # simulate the tear: write generation-2 OPT STATE (it goes first in
+    # save_checkpoint) and then "crash" before the meta/vector renames
+    ps.optimizer.save_state(ps._opt_path(),
+                            central_crc=12345, apply_seq=5)
+    ps.wal.close()
+
+    ps2 = _mk_ps(tmp_path)
+    assert ps2.maybe_restore()
+    # vector = gen1; the opt file's CURRENT gen is the orphan (crc 12345)
+    # so the PREVIOUS generation (bound to gen1's CRC) must be adopted,
+    # and WAL replay then advances both identically to the live run
+    assert ps2._apply_seq == 5
+    rng = np.random.default_rng(1)
+    expect_m = gen1_m.copy()
+    for _ in range(2):
+        u = rng.normal(size=16).astype(np.float32)
+        expect_m = (0.5 * expect_m + u).astype(np.float32)
+    np.testing.assert_array_equal(ps2.optimizer.m, expect_m)
+    assert int(gen1_meta["apply_seq"]) == 3
+
+
+@pytest.mark.drill
+def test_orphan_generation_from_a_torn_save_never_evicts_the_live_one(
+        tmp_path):
+    """Two torn crashes in a row (review hardening): save of G2 dies
+    after the opt-state write (orphan cur=G2, vector stays G1); the
+    restarted server later checkpoints G3 — the promoted prev slot must
+    be the ADOPTED G1, not the orphan G2, so a tear in G3's renames
+    still resolves to a (vector, optimizer) pair from one generation."""
+    ps = _mk_ps(tmp_path)
+    _push_n(ps, 2)
+    ps.commit()
+    ps.save_checkpoint()  # G1 completes
+    g1_m = ps.optimizer.m.copy()
+    _push_n(ps, 2, seed=1)
+    ps.commit()
+    # G2's save dies right after the opt-state write: only the orphan
+    # cur generation lands (bound to a CRC no on-disk vector ever gets)
+    ps.optimizer.save_state(ps._opt_path(), central_crc=0xDEAD,
+                            apply_seq=4, prev_crc=None)
+    ps.wal.close()
+
+    ps2 = _mk_ps(tmp_path)
+    assert ps2.maybe_restore()  # adopts G1 (+ WAL replay to seq 4)
+    _push_n(ps2, 1, seed=2)
+    ps2.commit()
+    ps2.save_checkpoint()  # G3: must promote G1 into prev, not the orphan
+    import numpy as _np
+
+    with _np.load(ps2._opt_path()) as data:
+        assert int(data["prev_seq"]) == 2  # G1's apply seq
+        _np.testing.assert_array_equal(data["prev_m"], g1_m)
+
+
+def test_missing_state_file_resets_moments_loudly(tmp_path):
+    ps = _mk_ps(tmp_path)
+    _push_n(ps, 2)
+    ps.commit()
+    ps.save_checkpoint()
+    os.unlink(ps._opt_path())
+    ps.wal.close()
+    ps2 = _mk_ps(tmp_path)
+    assert ps2.maybe_restore()
+    np.testing.assert_array_equal(
+        ps2.optimizer.m, np.zeros(16, np.float32))
+
+
+def test_rollback_restore_rolls_the_optimizer_state_back_too(tmp_path):
+    ps = _mk_ps(tmp_path)
+    _push_n(ps, 3)
+    ps.commit()
+    ps.save_checkpoint()
+    m_at_ckpt = ps.optimizer.m.copy()
+    target = ps._apply_seq
+    _push_n(ps, 3, seed=2)
+    ps.commit()
+    discarded = ps.rollback_restore(target)
+    assert discarded == 3
+    np.testing.assert_array_equal(ps.optimizer.m, m_at_ckpt)
+
+
+def test_resize_keeps_overlap_moments_and_zeroes_fresh_range():
+    opt = ShardedOptimizer("sgdm", 0, 8, momentum=0.9)
+    opt.step(np.arange(8, dtype=np.float32))
+    opt.resize(4, 12)
+    np.testing.assert_array_equal(opt.m[:4],
+                                  np.arange(4, 8, dtype=np.float32))
+    np.testing.assert_array_equal(opt.m[4:], np.zeros(4, np.float32))
+    assert (opt.lo, opt.hi) == (4, 12)
+
+
+def test_optimizer_from_args_cli_face():
+    class A:
+        server_opt = "adam"
+        server_lr = 0.01
+        server_momentum = 0.9
+
+    opt = optimizer_from_args(A(), 10)
+    assert opt.kind == "adam" and opt.size == 10 and opt.lr == 0.01
+    A.server_opt = "none"
+    assert optimizer_from_args(A(), 10) is None
+    A.server_opt = "vibes"
+    with pytest.raises(ValueError, match="kind"):
+        optimizer_from_args(A(), 10)

@@ -103,6 +103,31 @@ def test_wal_torn_tail_is_tolerated(tmp_path):
     assert stats["torn_tail"] == 1
 
 
+def test_wal_v1_log_refused_loudly_not_torn_tail(tmp_path):
+    """A pre-ISSUE-14 WAL1 log holds acked state this parser cannot
+    decode: replay must REFUSE (the old magic is recognized for exactly
+    this error), never classify the whole log as a torn tail and silently
+    resume without its records."""
+    import struct as _struct
+
+    import numpy as _np
+
+    from distributed_ml_pytorch_tpu.utils.wal import _MAGIC_V1
+
+    # one record in the old WAL1 layout: magic inc seq sender env_inc
+    # env_seq nbytes crc + payload (no codec field)
+    body = _np.ones(4, _np.float32).tobytes()
+    head = _struct.pack("<IIQiIIQ", _MAGIC_V1, 7, 1, 2, 0, 0, len(body))
+    import zlib as _zlib
+
+    crc = _zlib.crc32(body, _zlib.crc32(head)) & 0xFFFFFFFF
+    path = str(tmp_path / "w.log")
+    with open(path, "wb") as f:
+        f.write(head + _struct.pack("<I", crc) + body)
+    with pytest.raises(WALCorruptionError, match="WAL1"):
+        replay_wal(path)
+
+
 def test_wal_midlog_corruption_fails_loudly(tmp_path):
     """A CRC-corrupt record with valid records AFTER it is damage, not a
     torn tail — replay must refuse, never skip-and-continue past silently
