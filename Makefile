@@ -166,6 +166,24 @@ timeline:
 	@test -n "$(TIMELINE_DIR)" || (echo "no dump dir found — run 'make bench-mpmd' first or pass TIMELINE_DIR=<dir>"; exit 1)
 	$(PY) -m distributed_ml_pytorch_tpu.analysis timeline $(TIMELINE_DIR)
 
+# multi-tenant scheduler suite (ISSUE 16, coord/sched.py + coord/tenants.py):
+# capacity ledger exclusivity, admit/pack/preempt/resume protocol against a
+# real coordinator, autoscale actuation, and the park-and-restore drill
+# (preempt a LIVE training shard at peak, resume bit-for-bit off-peak,
+# byte-identical chaos logs 3x)
+sched:
+	$(PY) -m pytest tests/ -q -m sched
+
+# one-command scheduler demo (prints preempt/resume MTTR, WAL replay and
+# bit-identical restore proof, grants, decision log)
+sched-demo:
+	$(PY) -m distributed_ml_pytorch_tpu.coord.cli --sched-demo
+
+# scheduler bench phase: preempt/resume MTTR + aggregate goodput (shared
+# FleetScheduler vs two statically partitioned half-fleets)
+bench-sched:
+	$(PY) bench_all.py --only sched
+
 # adaptive-wire suite (ISSUE 7): RTT-driven retransmission, window/credit
 # backpressure, circuit breakers, and seeded network weather (latency /
 # jitter / bandwidth caps / one-way degradation) — the training acceptance
@@ -233,4 +251,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-wire-bytes bench-health bench-gate bench-compute bench-mpmd timeline chaos coord drill drill-demo fleet health health-demo mpmd mpmd-demo netweather soak lint distmodel test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-wire-bytes bench-health bench-gate bench-compute bench-mpmd bench-sched timeline chaos coord drill drill-demo fleet health health-demo mpmd mpmd-demo netweather sched sched-demo soak lint distmodel test test-all verify-real-data graph install dist

@@ -78,6 +78,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kill-engine-at", type=float, default=0.0,
                    help="crash one replica this many seconds into the "
                         "fleet run (0 = no kill) — prices migration")
+    # autoscale mode (ISSUE 16): the coordinator's check_engine_scaling
+    # advisory drives a REAL spawn/retire loop (FleetAutoscaler) instead
+    # of just logging advice; the JSON reports the scale-up MTTR
+    p.add_argument("--autoscale", action="store_true",
+                   help="run a coordinator whose scaling advice actually "
+                        "spawns/retires replicas (implies the fleet path)")
+    p.add_argument("--autoscale-max", type=int, default=4,
+                   help="replica ceiling for the autoscaler")
+    p.add_argument("--scale-occ-high", type=float, default=0.85,
+                   help="mean engine occupancy that advises scale-UP")
+    p.add_argument("--scale-occ-low", type=float, default=0.15,
+                   help="mean engine occupancy that advises scale-DOWN")
+    p.add_argument("--scale-cooldown", type=float, default=1.0,
+                   help="seconds between scaling decisions")
     p.add_argument("--shed-occupancy", type=float, default=0.0)
     p.add_argument("--brownout-occupancy", type=float, default=0.0)
     p.add_argument("--brownout-max-new", type=int, default=0)
@@ -262,7 +276,36 @@ def run_fleet(args) -> dict:
     engines = [make() for _ in range(args.engines)]
     for e in engines:
         _warmup(args, e)
-    members = [EngineMember(i, e).start() for i, e in enumerate(engines)]
+    coord = coord_thread = autoscaler = None
+    if args.autoscale:
+        # the full advisory->actuator loop: engine members lease into a
+        # real coordinator, renewals carry occupancy/TTFT, and the
+        # coordinator's check_engine_scaling advice lands on a
+        # FleetAutoscaler that spawns/retires replicas on the router
+        from distributed_ml_pytorch_tpu.coord.coordinator import Coordinator
+        from distributed_ml_pytorch_tpu.coord.member import CoordClient
+        from distributed_ml_pytorch_tpu.serving.fleet import FleetAutoscaler
+
+        cap = max(args.autoscale_max, args.engines)
+        coord_world = InProcessTransport.create_world(1 + cap)
+        coord = Coordinator(
+            coord_world[0], 1, lease=2.0, speculation=False,
+            engine_occ_high=args.scale_occ_high,
+            engine_occ_low=args.scale_occ_low,
+            scale_cooldown=args.scale_cooldown)
+        coord_thread = threading.Thread(
+            target=coord.run, name="bench-coord", daemon=True)
+        coord_thread.start()
+
+        def _member(eid: int, engine) -> EngineMember:
+            client = CoordClient(coord_world[1 + eid], "engine",
+                                 renew_interval=0.1)
+            return EngineMember(eid, engine, coord=client,
+                                report_interval=0.1)
+
+        members = [_member(i, e).start() for i, e in enumerate(engines)]
+    else:
+        members = [EngineMember(i, e).start() for i, e in enumerate(engines)]
     world = InProcessTransport.create_world(2)
     router = FleetRouter(
         world[0], members, probe_timeout=0.5,
@@ -273,6 +316,19 @@ def run_fleet(args) -> dict:
         slo_ttft_ms=args.slo_ttft_ms, shed_occupancy=args.shed_occupancy,
         brownout_occupancy=args.brownout_occupancy,
         brownout_max_new=args.brownout_max_new)
+    if args.autoscale:
+        def member_factory() -> EngineMember:
+            used = set(router.members.keys())
+            eid = next(i for i in range(cap) if i not in used)
+            engine = make()
+            _warmup(args, engine)
+            engines.append(engine)
+            log(f"autoscaler: spawning engine {eid}")
+            return _member(eid, engine)
+
+        autoscaler = FleetAutoscaler(
+            router, member_factory, min_engines=1, max_engines=cap)
+        coord.on_scale = autoscaler.on_scale
     server = threading.Thread(target=router.serve_forever, daemon=True)
     server.start()
     client = ServingClient(world[1])
@@ -340,6 +396,15 @@ def run_fleet(args) -> dict:
     server.join(timeout=5)
     for t in world.values():
         t.close()
+    autoscale_info = None
+    if autoscaler is not None:
+        autoscaler.quiesce()
+        autoscale_info = autoscaler.summary()
+        coord.stop()
+        coord_thread.join(timeout=5)
+        for t in coord_world.values():
+            t.close()
+        log(f"autoscaler: {autoscale_info}")
     good_tokens = total_tokens = met = shed = 0
     for i, rid in enumerate(submitted):
         toks, done_at, rejected = state[rid]
@@ -361,6 +426,7 @@ def run_fleet(args) -> dict:
         "rejected_client_side": shed, "mttr_s": router.mttr_s(),
         "migrations": router.migrations,
         "summary": engines[-1].slo_summary(),
+        "autoscale": autoscale_info,
     }
 
 
@@ -369,7 +435,8 @@ def main(argv=None) -> int:
 
     import jax
 
-    r = run_fleet(args) if args.engines >= 2 else run_single(args)
+    r = (run_fleet(args) if args.engines >= 2 or args.autoscale
+         else run_single(args))
     wall, total = r["wall"], r["total_tokens"]
     throughput = total / wall if wall > 0 else 0.0
     goodput = r["good_tokens"] / wall if wall > 0 else 0.0
@@ -396,6 +463,16 @@ def main(argv=None) -> int:
         "migration_mttr_s": (round(r["mttr_s"], 4)
                              if r["mttr_s"] is not None else None),
         "engines": args.engines,
+        # --- autoscale loop (ISSUE 16): advice -> actual spawn/retire ---
+        "autoscaled": bool(r.get("autoscale")),
+        "scaled_up": (r["autoscale"]["scaled_up"]
+                      if r.get("autoscale") else 0),
+        "scaled_down": (r["autoscale"]["scaled_down"]
+                        if r.get("autoscale") else 0),
+        "scale_up_mttr_s": (
+            round(float(np.mean(r["autoscale"]["scale_up_mttr_s"])), 4)
+            if r.get("autoscale") and r["autoscale"]["scale_up_mttr_s"]
+            else None),
         "ttft_ms": summary["ttft_ms"],
         "tpot_ms": summary["tpot_ms"],
         "queue_depth": summary["queue_depth"],

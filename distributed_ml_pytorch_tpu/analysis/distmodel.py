@@ -642,12 +642,131 @@ class CompressModel(Model):
 
 
 # =====================================================================
+# sched — lease + preempt + park/resume exclusivity and durability
+# =====================================================================
+
+class SchedModel(Model):
+    """The multi-tenant scheduler's preempt/park/resume protocol
+    (ISSUE 16, ``coord/sched.py``) over ONE slot and two tenants: a
+    training member owns the slot and produces acked deltas; a serving
+    tenant's demand peaks, the scheduler parks the member and grants the
+    slot; off-peak the grant is revoked and the member resumes from its
+    park manifest.
+
+    State ::
+
+        (owner,     # 0 free | 1 training | 2 serving
+         tstate,    # training member: 0 running | 1 parked
+         produced,  # acked deltas the member has applied (0..N)
+         synced,    # deltas durable in the WAL (fsync group commit)
+         manifest,  # -1 = no park manifest | deltas the snapshot covers
+         demand,    # serving tenant's current want (0/1)
+         peaked, offpeaked,   # one-shot diurnal toggles
+         viol)      # sticky: 0 ok | 1 double-grant | 2 lost acked state
+
+    The two guards under test, each dropped by one seeded mutation:
+
+    - *require_manifest* — a park is legal only under a snapshot barrier
+      manifest (park itself commits the WAL, so a STALE manifest is fine
+      — replay covers the gap — but NO manifest leaves nothing to
+      restore). ``park_without_manifest`` drops it: the resume of a
+      parked member that produced deltas has lost acked state.
+    - *exclusive grant* — the slot is granted to the waiting tenant only
+      once the victim's park completes (the slot is free).
+      ``double_grant_slot`` drops it: the grant fires while the training
+      member still holds the slot — two tenants own one slot.
+
+    Both violations latch into ``viol`` at the offending transition
+    (sticky, like the lease model) so later legal events cannot mask
+    them.
+    """
+
+    name = "sched"
+
+    def __init__(self, n_updates: int = 3, mutation: Optional[str] = None):
+        self.n_updates = n_updates
+        self.mutation = mutation
+
+    _OK, _DOUBLE_GRANT, _LOST_STATE = 0, 1, 2
+
+    def initial(self):
+        return (1, 0, 0, 0, -1, 0, 0, 0, self._OK)
+
+    def successors(self, st):
+        (owner, tstate, produced, synced, manifest, demand,
+         peaked, offpeaked, viol) = st
+        mut = self.mutation
+        out = []
+        if owner == 1 and tstate == 0 and produced < self.n_updates:
+            out.append((("push", produced), (
+                owner, tstate, produced + 1, synced, manifest, demand,
+                peaked, offpeaked, viol)))
+        if synced < produced:
+            out.append((("fsync",), (
+                owner, tstate, produced, produced, manifest, demand,
+                peaked, offpeaked, viol)))
+        if owner == 1 and tstate == 0 and manifest != produced:
+            # snapshot barrier: commit + checkpoint (coordinator-aligned)
+            out.append((("snapshot", produced), (
+                owner, tstate, produced, produced, produced, demand,
+                peaked, offpeaked, viol)))
+        if not peaked:
+            out.append((("peak",), (
+                owner, tstate, produced, synced, manifest, 1,
+                1, offpeaked, viol)))
+        if peaked and demand == 1 and not offpeaked:
+            out.append((("offpeak",), (
+                owner, tstate, produced, synced, manifest, 0,
+                peaked, 1, viol)))
+        if owner == 1 and tstate == 0 and demand == 1 \
+                and (mut == "park_without_manifest" or manifest != -1):
+            # park: the victim commits its WAL group and stops; the
+            # require_manifest guard is what the mutation drops
+            out.append((("park",), (
+                0, 1, produced, produced, manifest, demand,
+                peaked, offpeaked, viol)))
+        if demand == 1 and owner != 2:
+            if owner == 0:
+                out.append((("grant",), (
+                    2, tstate, produced, synced, manifest, demand,
+                    peaked, offpeaked, viol)))
+            elif mut == "double_grant_slot":
+                # exclusivity dropped: granted while the training member
+                # still holds the slot — the illegal two-owner state
+                out.append((("grant",), (
+                    2, tstate, produced, synced, manifest, demand,
+                    peaked, offpeaked, self._DOUBLE_GRANT)))
+        if owner == 2 and demand == 0:
+            out.append((("release",), (
+                0, tstate, produced, synced, manifest, demand,
+                peaked, offpeaked, viol)))
+        if owner == 0 and tstate == 1:
+            v = viol
+            if manifest == -1 and synced > 0:
+                v = self._LOST_STATE  # nothing to restore from
+            out.append((("resume",), (
+                1, 0, produced, synced, manifest, demand,
+                peaked, offpeaked, v)))
+        return out
+
+    def invariant(self, st):
+        viol = st[-1]
+        if viol == self._DOUBLE_GRANT:
+            return ("slot double-granted: two tenants own one slot (the "
+                    "grant fired before the victim's park completed)")
+        if viol == self._LOST_STATE:
+            return ("resume lost acked state: the member parked without "
+                    "a manifest, so its acked deltas are unrecoverable")
+        return None
+
+
+# =====================================================================
 # registry + counterexample emission
 # =====================================================================
 
 MODELS: Dict[str, Callable[..., Model]] = {
     "ps": PSModel, "lease": LeaseModel, "mpmd": MpmdModel,
-    "copt": CompressModel}
+    "copt": CompressModel, "sched": SchedModel}
 
 #: mutation name -> the model it breaks (the soundness corpus)
 MUTATIONS: Dict[str, str] = {
@@ -659,11 +778,14 @@ MUTATIONS: Dict[str, str] = {
     "no_mb_dedup": "mpmd",
     "no_error_feedback": "copt",
     "decode_before_admission": "copt",
+    "park_without_manifest": "sched",
+    "double_grant_slot": "sched",
 }
 
 #: per-model depth the `make distmodel` gate explores to (deep enough to
 #: cover every mutation's counterexample; small enough to stay seconds)
-DEFAULT_DEPTH = {"ps": 12, "lease": 10, "mpmd": 12, "copt": 12}
+DEFAULT_DEPTH = {"ps": 12, "lease": 10, "mpmd": 12, "copt": 12,
+                 "sched": 12}
 
 
 def _chaos_plan_for(result: Result) -> dict:
@@ -796,11 +918,16 @@ def counterexample_artifact(result: Result) -> dict:
     violated invariant, the event trace, the derived chaos plan, and the
     crash script (crash/restart positions within the trace)."""
     assert not result.ok and result.trace is not None
+    # ps/mpmd traces script crash/restart positions; sched traces script
+    # the scheduler's own state transitions (the chaos schedule a replay
+    # drives against the real coordinator)
+    ops = (("park", "resume", "grant", "release", "peak", "offpeak")
+           if result.model == "sched" else ("crash", "restart"))
     script = [
         {"after_event": i, "op": ev[0],
          "rank": 0 if result.model == "ps" else 1}
         for i, ev in enumerate(result.trace)
-        if ev[0] in ("crash", "restart")]
+        if ev[0] in ops]
     return {
         "model": result.model,
         "mutation": result.mutation,
@@ -1227,12 +1354,100 @@ def _replay_decode_before_admission(ce: dict, workdir: str,
     return violations
 
 
+def _replay_park_without_manifest(ce: dict, workdir: str,
+                                  mutated: bool) -> List[str]:
+    """The counterexample's park-then-resume schedule against the FULL
+    real stack: ``coord.drill.sched_drill`` runs coordinator + scheduler
+    + WAL'd elastic shards + DownPour workers through the model's event
+    sequence (peak -> park -> grant -> offpeak -> release -> resume).
+    Correct config (``require_manifest=True``): the preempt first drives
+    a snapshot barrier, the resume restores checkpoint + WAL replay
+    bit-for-bit — no violations. Mutated (the guard dropped): the member
+    parks without any manifest and the resume finds nothing to restore —
+    the model's lost-acked-state violation on the real coordinator."""
+    from distributed_ml_pytorch_tpu.coord.drill import (
+        default_drill_plan,
+        sched_drill,
+    )
+
+    out = sched_drill(base_dir=workdir, seed=0,
+                      plan=default_drill_plan(0),
+                      require_manifest=not mutated)
+    violations = list(out["violations"])
+    if not mutated and out["sched"]["preempts_done"] < 1:
+        violations.append(
+            "clean config never parked the victim — the preempt path is "
+            "not wired where the schedule expects")
+    return violations
+
+
+def _replay_double_grant_slot(ce: dict, workdir: str,
+                              mutated: bool) -> List[str]:
+    """The model's grant-before-park-completes schedule against the real
+    scheduler + coordinator, driven synchronously with a fake clock (the
+    coordinator's handle()/tick() test surface). Two shard members join;
+    the serving tenant's demand spikes. Correct config: the ledger's
+    exclusivity gate defers the grant until the victim's PreemptDone
+    frees the slot, so ``audit()`` stays clean. Mutated
+    (``enforce_exclusive=False``): the grant fires immediately over the
+    still-held slot and the ledger audit reports the two-owner state."""
+    from distributed_ml_pytorch_tpu.coord.coordinator import (
+        KIND_SHARD,
+        Coordinator,
+        encode_join,
+    )
+    from distributed_ml_pytorch_tpu.coord.sched import FleetScheduler
+    from distributed_ml_pytorch_tpu.coord.tenants import (
+        TENANT_SERVING,
+        Tenant,
+        TenantRegistry,
+    )
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        InProcessTransport,
+        MessageCode,
+    )
+
+    fake_now = [0.0]
+    world = InProcessTransport.create_world(3)
+    coord = Coordinator(world[0], 8, lease=60.0, speculation=False,
+                        clock=lambda: fake_now[0])
+    registry = TenantRegistry()
+    registry.register(Tenant(1, "train", priority=1, demand=2, min_slots=1))
+    registry.register(Tenant(2, "serve", kind=TENANT_SERVING, priority=5,
+                             demand=0))
+    sched = FleetScheduler(coord, registry=registry, require_manifest=True,
+                           enforce_exclusive=not mutated)
+    for rank in (1, 2):
+        coord.handle(rank, MessageCode.CoordJoin,
+                     encode_join(KIND_SHARD, rank))
+        sched.register_member_slot(rank, 1)
+    # replay the schedule: peak, then the scheduler's own pack passes
+    # (the grant either defers on the exclusivity gate or fires over the
+    # still-held slot — no PreemptDone ever arrives in this harness, so
+    # a premature grant can ONLY come from the dropped gate)
+    registry.set_demand(2, 1)
+    for _ in range(3):
+        fake_now[0] += 1.0
+        sched.tick(fake_now[0])
+    violations = list(sched.ledger.audit())
+    if not mutated and any(
+            2 in s.owners for s in sched.ledger.slots.values()):
+        violations.append(
+            "clean config granted a held slot before the victim parked — "
+            "the exclusivity gate is not wired where the ledger promises")
+    for t in world.values():
+        t.close()
+    return violations
+
+
 _REPLAYS = {
     ("ps", "ack_before_fsync"): _replay_ack_before_fsync,
     ("ps", "no_dedup"): _replay_no_dedup,
     ("ps", "no_seed_on_restore"): _replay_no_seed_on_restore,
     ("copt", "no_error_feedback"): _replay_no_error_feedback,
     ("copt", "decode_before_admission"): _replay_decode_before_admission,
+    ("sched", "park_without_manifest"): _replay_park_without_manifest,
+    ("sched", "double_grant_slot"): _replay_double_grant_slot,
 }
 
 

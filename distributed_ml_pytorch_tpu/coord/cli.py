@@ -76,6 +76,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "+ weather, middle stage killed mid-schedule, "
                         "checkpoint restart + watermark replay, MTTR "
                         "reported) and exit")
+    p.add_argument("--sched-demo", action="store_true",
+                   help="run the in-process multi-tenant scheduler "
+                        "scenario (ISSUE 16: serving demand spike "
+                        "preempts a live training shard — snapshot "
+                        "barrier, park under the FleetManifest — then "
+                        "resumes it bit-for-bit off-peak via checkpoint "
+                        "+ exactly-once WAL replay; prints preempt/"
+                        "resume MTTR and the restore proof) and exit")
     p.add_argument("--auto-rollback", action="store_true",
                    help="TCP hub mode: watch the fleet's loss telemetry "
                         "and drive RollbackRequest barriers to the last "
@@ -170,6 +178,16 @@ def run_mpmd(args) -> int:
     return 0 if summary.get("ok") else 1
 
 
+def run_sched(args) -> int:
+    """The ISSUE 16 multi-tenant scheduler scenario as a one-command
+    script: peak preempt -> park -> borrowed slot -> off-peak resume."""
+    from distributed_ml_pytorch_tpu.coord.drill import sched_demo
+
+    summary = sched_demo(seed=args.seed)
+    print("sched scenario:", summary)
+    return 0 if summary.get("ok") else 1
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     print(args)
@@ -181,6 +199,8 @@ def main(argv=None) -> int:
         return run_health(args)
     if args.mpmd:
         return run_mpmd(args)
+    if args.sched_demo:
+        return run_sched(args)
 
     from distributed_ml_pytorch_tpu.coord.coordinator import Coordinator
     from distributed_ml_pytorch_tpu.utils.messaging import TCPTransport

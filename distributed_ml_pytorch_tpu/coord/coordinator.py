@@ -65,8 +65,10 @@ KIND_WORKER = 0
 KIND_SHARD = 1
 KIND_ENGINE = 2
 KIND_STAGE = 3  # MPMD pipeline stage member (ISSUE 10, coord/stages.py)
+KIND_AGENT = 4  # node agent: the scheduler's actuator (ISSUE 16)
 _KIND_NAMES = {KIND_WORKER: "worker", KIND_SHARD: "shard",
-               KIND_ENGINE: "engine", KIND_STAGE: "stage"}
+               KIND_ENGINE: "engine", KIND_STAGE: "stage",
+               KIND_AGENT: "agent"}
 
 
 def encode_join(kind: int, incarnation: int) -> np.ndarray:
@@ -125,6 +127,38 @@ def encode_rollback_done(rollback_id: int, map_version: int, lo: int,
     return np.asarray(
         [*_split16(rollback_id), *_split16(map_version), *_split16(lo),
          *_split16(hi), *_split16(apply_seq)], np.float32)
+
+
+def encode_preempt_request(grant_id: int, snapshot_id: int) -> np.ndarray:
+    """Scheduler -> victim member: park yourself under ``grant_id``;
+    ``snapshot_id`` names the FleetManifest the park restores from."""
+    return np.asarray(
+        [*_split16(grant_id), *_split16(snapshot_id)], np.float32)
+
+
+def encode_preempt_done(grant_id: int, snapshot_id: int, lo: int, hi: int,
+                        apply_seq: int) -> np.ndarray:
+    return np.asarray(
+        [*_split16(grant_id), *_split16(snapshot_id), *_split16(lo),
+         *_split16(hi), *_split16(apply_seq)], np.float32)
+
+
+def encode_slot_grant(grant_id: int, tenant_id: int, action: int,
+                      slot_id: int) -> np.ndarray:
+    """Scheduler -> node agent: action 1 grants ``slot_id`` to
+    ``tenant_id`` (spawn that tenant's member kind), action 0 revokes."""
+    return np.asarray(
+        [*_split16(grant_id), float(tenant_id), float(action),
+         float(slot_id)], np.float32)
+
+
+def encode_resume_request(grant_id: int, rank: int,
+                          snapshot_id: int) -> np.ndarray:
+    """Scheduler -> node agent: resume the member parked as ``rank``,
+    restoring ``snapshot_id`` bit-for-bit (manifest + WAL replay)."""
+    return np.asarray(
+        [*_split16(grant_id), float(rank), *_split16(snapshot_id)],
+        np.float32)
 
 
 #: the FleetState tail's section sentinel (ISSUE 12/13): engine ranks are
@@ -277,6 +311,11 @@ class Coordinator:
         #: ships with the window that explains it (ISSUE 12)
         self.recorder = None
         self.obs_dir: Optional[str] = None
+        #: optional multi-tenant scheduler (ISSUE 16, ``coord/sched.py``):
+        #: ``FleetScheduler(coord)`` attaches itself here; tick() drives
+        #: its placement pass and handle() routes PreemptDone to it. A
+        #: parked member's silence is then a PARK, not a death.
+        self.sched = None
         # --- snapshot barrier (ISSUE 5): coordinator-aligned fleet ckpts ---
         self.manifest_dir = manifest_dir
         self.snapshot_interval = float(snapshot_interval)
@@ -571,6 +610,20 @@ class Coordinator:
                 hi=_join16(payload[6], payload[7]),
                 apply_seq=_join16(payload[8], payload[9]))
             return
+        if code == MessageCode.PreemptDone and payload.size >= 10:
+            if not np.isfinite(payload[:10]).all():
+                return
+            member.last_seen = now
+            if self.sched is not None:
+                self.sched.on_preempt_done(
+                    sender,
+                    grant_id=_join16(payload[0], payload[1]),
+                    snap_id=_join16(payload[2], payload[3]),
+                    lo=_join16(payload[4], payload[5]),
+                    hi=_join16(payload[6], payload[7]),
+                    apply_seq=_join16(payload[8], payload[9]),
+                    now=now)
+            return
         # distcheck: ignore[DC104] deliberate wire tolerance (WIRE_SCHEMAS
         # doc): the 5-field pre-ISSUE-7 and 6-field pre-ISSUE-8 renews stay
         # FULL renews — the wire-health and numerical-health tails are
@@ -624,8 +677,14 @@ class Coordinator:
         """Expire leases, rebalance, and (maybe) speculate; returns True if
         membership changed. Call at ~lease/4 cadence (the run loop does)."""
         now = self._clock()
+        # a PARKED member (ISSUE 16) stops renewing by design: its silence
+        # is the scheduler's doing, and expiring it would rebalance its
+        # range away and make the resume impossible
+        parked = (self.sched.parked_ranks()
+                  if self.sched is not None else set())
         expired = [m for m in self.members.values()
-                   if now - m.last_seen > self.lease]
+                   if now - m.last_seen > self.lease
+                   and m.rank not in parked]
         shard_died = False
         for m in expired:
             del self.members[m.rank]
@@ -640,6 +699,9 @@ class Coordinator:
         if self.speculation:
             self.check_stragglers()
         self.check_engine_scaling(now)
+        # --- multi-tenant scheduler pass (ISSUE 16; serve-thread only) ---
+        if self.sched is not None:
+            self.sched.tick(now)
         # --- snapshot barrier driving (serve-thread only, like the rest) ---
         due = (self._next_snap_at is not None and now >= self._next_snap_at)
         if self._snap_requested or due:
@@ -717,6 +779,15 @@ class Coordinator:
         shards = self._live(KIND_SHARD)
         if not shards:
             self._log("snapshot request ignored: no live shard servers")
+            return
+        parked = (self.sched.parked_ranks()
+                  if self.sched is not None else set())
+        if any(m.rank in parked for m in shards):
+            # a parked shard can never answer the barrier, and a manifest
+            # missing its range would not be a fleet snapshot — defer
+            # until the scheduler resumes it
+            self._log("snapshot request deferred: shard(s) "
+                      f"{sorted(r for r in parked)} parked by the scheduler")
             return
         self._snap_seq += 1
         self._snap = {

@@ -433,6 +433,422 @@ def recovery_drill(
     }
 
 
+def sched_drill(
+    *,
+    base_dir: str,
+    seed: int = 0,
+    steps: int = 56,
+    peak_at: int = 6,
+    offpeak_at: int = 46,
+    require_manifest: bool = True,
+    n_workers: int = 2,
+    n_shards: int = 2,
+    plan: Optional[ChaosPlan] = None,
+    lease: float = 2.0,
+    lr: float = 0.05,
+    n_push: int = 2,
+    n_pull: int = 2,
+    batch: int = 16,
+    wal_group_n: int = 4,
+    fixture=None,
+    step_sleep: float = 0.05,
+) -> Dict:
+    """One multi-tenant preempt/park/resume drill (ISSUE 16).
+
+    The full stack of :func:`recovery_drill` — coordinator + elastic WAL'd
+    shards + DownPour workers under chaos — plus a :class:`FleetScheduler`
+    with a training tenant (owns every shard slot) and a higher-priority
+    serving tenant, and an **agent** member that actuates grants/resumes.
+    The script, driven from worker 1's step hook like the recovery drill:
+
+    1. at ``peak_at`` the serving tenant's demand spikes; the scheduler
+       preempts the training tenant's last slot: snapshot barrier →
+       ``PreemptRequest`` → the victim shard commits its WAL and parks
+       (workers keep pushing THROUGH the barrier→park window, so acked
+       deltas exist that only the WAL holds);
+    2. workers observe the park and ``hold_shard`` the victim's range —
+       their slice degrades to purely-local SGD (held, not lost);
+    3. at ``offpeak_at`` demand drops; the grant is revoked and the agent
+       restores the parked member from the manifest + exactly-once WAL
+       replay, rejoining as a newer incarnation of the same rank;
+    4. workers release the hold and push to the revived shard; the drill
+       PROVES the round-trip: restored state bit-identical to the parked
+       server's (params, apply_seq, per-sender applied counts), acked <=
+       applied per (worker, shard), and a deterministic chaos log.
+
+    ``require_manifest=False`` is the ``park_without_manifest`` mutation's
+    real-stack surface: the scheduler parks without driving the barrier,
+    and the resume finds no manifest to restore from — the violation the
+    ``sched`` model's counterexample predicts. Violations are returned in
+    ``out["violations"]`` (empty = the protocol held).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ml_pytorch_tpu.coord.sched import FleetScheduler
+    from distributed_ml_pytorch_tpu.coord.tenants import (
+        TENANT_SERVING,
+        TENANT_TRAINING,
+        Tenant,
+        TenantRegistry,
+    )
+    from distributed_ml_pytorch_tpu.parallel.sharded_ps import (
+        ShardedAsynchronous,
+    )
+    from distributed_ml_pytorch_tpu.utils.serialization import (
+        ravel_model_params,
+    )
+
+    assert n_shards >= 2, "sched_drill needs a survivor shard (n_shards >= 2)"
+    if fixture is not None:
+        x, y, grad_fn, params0 = fixture
+    else:
+        x, y, grad_fn, params0 = _default_fixture(seed)
+    flat0 = np.asarray(ravel_model_params(params0), np.float32)
+    n_params = int(flat0.shape[0])
+    # the victim is the training tenant's LAST slot (the scheduler's
+    # _pick_victim order) — shard n_shards-1, never the chaos-faulted
+    # star 0, so the fault log stays a pure function of the step script
+    victim = n_shards - 1
+    victim_sid = 1 + victim
+
+    TRAIN_ID, SERVE_ID = 1, 2
+
+    log = ChaosLog()
+    the_plan = plan if plan is not None else ChaosPlan(seed=seed)
+    agent_rank = 1 + n_shards + n_workers
+    coord_world = InProcessTransport.create_world(2 + n_shards + n_workers)
+    # Chaos rides star 0 ONLY (one shared log). Every star reuses the same
+    # rank numbering, so a (src=1, dst=0, ParameterRequest) rule would
+    # otherwise fault the VICTIM star's pull channel too — and that
+    # channel's send count ends exactly when the worker observes the park,
+    # which is coordinator-thread timing, not step script. Scoping the
+    # plan to star 0 (whose shard is never parked) keeps the log a pure
+    # function of the step script, so repeats are byte-identical.
+    star_chaos: List[Dict[int, FaultyTransport]] = []
+    for i in range(n_shards):
+        world = InProcessTransport.create_world(1 + n_workers)
+        hub = FaultyTransport(
+            world[0], the_plan if i == 0 else ChaosPlan(seed=seed), log=log)
+        star = {0: hub}
+        for r in range(1, 1 + n_workers):
+            star[r] = hub.sibling(world[r])
+        star_chaos.append(star)
+
+    def make_server_transport(i: int) -> ReliableTransport:
+        return ReliableTransport(
+            star_chaos[i][0], ack_timeout=0.05, max_backoff=0.25,
+            max_retries=120, unreliable_codes=DRILL_UNRELIABLE,
+            ack_on_delivery=False)
+
+    rel_workers: List[Dict[int, ReliableTransport]] = []
+    for i in range(n_shards):
+        rel_workers.append({
+            j: ReliableTransport(
+                star_chaos[i][j], ack_timeout=0.05, max_backoff=0.25,
+                max_retries=120, unreliable_codes=DRILL_UNRELIABLE)
+            for j in range(1, 1 + n_workers)})
+
+    manifest_path = os.path.join(base_dir, MANIFEST_NAME)
+    coord = Coordinator(
+        coord_world[0], n_params, lease=lease, speculation=False,
+        manifest_dir=base_dir)
+    registry = TenantRegistry()
+    registry.register(Tenant(TRAIN_ID, "train", kind=TENANT_TRAINING,
+                             priority=1, demand=n_shards,
+                             min_slots=n_shards - 1))
+    registry.register(Tenant(SERVE_ID, "serve", kind=TENANT_SERVING,
+                             priority=5, demand=0))
+    sched = FleetScheduler(
+        coord, registry=registry, require_manifest=require_manifest,
+        actuator_rank=agent_rank, preempt_timeout=60.0, resume_timeout=60.0)
+    for i in range(n_shards):
+        sched.register_member_slot(1 + i, TRAIN_ID)
+    coord_thread = threading.Thread(
+        target=coord.run, kwargs={"timeout": 600}, daemon=True)
+    coord_thread.start()
+
+    def start_server(i: int) -> ElasticShardServer:
+        client = CoordClient(coord_world[1 + i], "shard",
+                             renew_interval=lease / 4)
+        srv = ElasticShardServer(
+            server_id=1 + i, n_params=n_params,
+            transport=make_server_transport(i), coord=client,
+            init_params=flat0, ckpt_dir=os.path.join(base_dir, f"shard{i}"),
+            ckpt_every=0, wal=True, wal_group_n=wal_group_n)
+        t = threading.Thread(target=srv.run, kwargs={"timeout": 600},
+                             daemon=True)
+        t.start()
+        return srv
+
+    servers: List[ElasticShardServer] = [start_server(i)
+                                         for i in range(n_shards)]
+    retired_servers: List[ElasticShardServer] = []
+    _wait_for(lambda: len(coord.shard_map.entries) == n_shards, 60,
+              "all shard servers to join the map")
+
+    # --- the node agent: grants/resumes land here over the wire ---------
+    violations: List[str] = []
+    grants: List[tuple] = []
+    resumed_info = {"replayed": 0, "bit_identical": None,
+                    "apply_seq_parked": None, "apply_seq_restored": None}
+    resume_failed = threading.Event()
+    resume_jobs: List[tuple] = []
+    resume_ready = threading.Event()
+    agent = CoordClient(coord_world[agent_rank], "agent",
+                        renew_interval=lease / 4)
+
+    def on_slot_grant(grant_id, tenant_id, action, slot_id):
+        grants.append((grant_id, tenant_id, action, slot_id))
+
+    def on_resume(grant_id, rank, snapshot_id):
+        resume_jobs.append((grant_id, rank, snapshot_id))
+        resume_ready.set()
+
+    agent.on_slot_grant = on_slot_grant
+    agent.on_resume = on_resume
+    agent.join(timeout=30)
+
+    def do_resume(grant_id: int, rank: int, snapshot_id: int) -> None:
+        i = rank - 1
+        old = servers[i]
+        try:
+            if snapshot_id <= 0 or not os.path.exists(manifest_path):
+                raise FileNotFoundError(
+                    f"no manifest for snapshot {snapshot_id}")
+            manifest = FleetManifest.load(manifest_path)
+            detach = getattr(old.transport, "detach", None)
+            if detach is not None:
+                detach()
+            client = CoordClient(coord_world[1 + i], "shard",
+                                 renew_interval=lease / 4)
+            srv = ElasticShardServer(
+                server_id=1 + i, n_params=n_params,
+                transport=make_server_transport(i), coord=client,
+                init_params=flat0,
+                ckpt_dir=os.path.join(base_dir, f"shard{i}"),
+                ckpt_every=0, wal=True, wal_group_n=wal_group_n)
+            srv.restore_from_manifest(manifest)
+            resumed_info["replayed"] += srv.ps.replayed_updates
+            # bit-for-bit proof BEFORE any new traffic: the restored
+            # range + apply_seq + per-sender counts must equal the parked
+            # server's in-memory state (checkpoint + exact WAL replay)
+            lo, hi = old.lo, old.hi
+            resumed_info["apply_seq_parked"] = old.ps._apply_seq
+            resumed_info["apply_seq_restored"] = srv.ps._apply_seq
+            identical = (
+                np.array_equal(np.asarray(old.ps.central[lo:hi]),
+                               np.asarray(srv.ps.central[lo:hi]))
+                and srv.ps._apply_seq == old.ps._apply_seq
+                and dict(srv.ps.applied_by_sender)
+                == dict(old.ps.applied_by_sender))
+            resumed_info["bit_identical"] = identical
+            if not identical:
+                violations.append(
+                    f"resume of rank {rank} not bit-identical: parked "
+                    f"apply_seq {old.ps._apply_seq} vs restored "
+                    f"{srv.ps._apply_seq}")
+            retired_servers.append(old)
+            servers[i] = srv
+            threading.Thread(target=srv.run, kwargs={"timeout": 600},
+                             daemon=True).start()
+        except Exception as e:  # noqa: BLE001 — the violation IS the result
+            violations.append(
+                f"resume lost acked state: rank {rank} parked without a "
+                f"usable manifest ({e!r})")
+            resume_failed.set()
+
+    def agent_loop() -> None:
+        while not agent_stop.is_set():
+            if not resume_ready.wait(0.05):
+                continue
+            resume_ready.clear()
+            while resume_jobs:
+                do_resume(*resume_jobs.pop(0))
+
+    agent_stop = threading.Event()
+    agent_thread = threading.Thread(target=agent_loop, daemon=True)
+    agent_thread.start()
+
+    timings: Dict[str, float] = {}
+    losses: Dict[int, list] = {}
+    opts: Dict[int, object] = {}
+    errors: list = []
+    hold_evt = threading.Event()
+    release_evt = threading.Event()
+    held = {j: False for j in range(1, 1 + n_workers)}
+
+    def _follow(j: int) -> None:
+        # non-blocking per-step reactions every worker applies: hold the
+        # victim's range once it parks, release once it is back
+        if hold_evt.is_set() and not release_evt.is_set() and not held[j]:
+            opts[j].hold_shard(victim_sid)
+            held[j] = True
+        if release_evt.is_set() and held[j] and not resume_failed.is_set():
+            opts[j].release_shard(victim_sid)
+            held[j] = False
+
+    def step_hook(j: int, step: int) -> None:
+        time.sleep(step_sleep)  # pace ALL workers so wall-clock scheduler
+        # decisions land inside the step script, not after it
+        if j != 1:
+            if step == offpeak_at:
+                release_evt.wait(300)
+            _follow(j)
+            return
+        if step == peak_at:
+            timings["peak"] = time.monotonic()
+            registry.set_demand(SERVE_ID, 1)
+        if peak_at < step < offpeak_at and not hold_evt.is_set() \
+                and sched.preempts_done > 0:
+            hold_evt.set()
+        if step == offpeak_at:
+            _wait_for(lambda: sched.preempts_done > 0
+                      or sched.preempts_aborted > 0, 120,
+                      "the preempt to park the victim")
+            hold_evt.set()
+            _follow(1)
+            timings["offpeak"] = time.monotonic()
+            registry.set_demand(SERVE_ID, 0)
+            _wait_for(lambda: sched.resumes_done > 0
+                      or resume_failed.is_set(), 120,
+                      "the resume to settle")
+            release_evt.set()
+        _follow(1)
+
+    def run_worker(j: int) -> None:
+        try:
+            _run_worker(j)
+        except Exception as e:  # noqa: BLE001 — surfaced to the caller
+            errors.append((j, repr(e)))
+            release_evt.set()  # never strand the other workers
+
+    def _run_worker(j: int) -> None:
+        client = CoordClient(coord_world[n_shards + j], "worker",
+                             renew_interval=lease / 4)
+        m = client.join(timeout=30)
+        assert m is not None and m.entries, "worker never got a shard map"
+        factory = lambda entry: rel_workers[entry.server_id - 1][j]
+        params = jax.tree.map(jnp.asarray, params0)
+        opt = ShardedAsynchronous(
+            params, lr=lr, n_push=n_push, n_pull=n_pull,
+            transports=[factory(e) for e in m.entries],
+            coord=client, transport_factory=factory, shard_map=m)
+        opts[j] = opt
+        rng = jax.random.key(100 + j)
+        my_losses = losses.setdefault(j, [])
+        for step in range(steps):
+            sel = np.random.default_rng(j * 1000 + step).integers(
+                0, len(x), batch)
+            loss, grads = grad_fn(params, x[sel], y[sel],
+                                  jax.random.fold_in(rng, step))
+            params = opt.step(params, grads)
+            my_losses.append(float(loss))
+            step_hook(j, step)
+        opt.finish()
+        client.close()
+
+    worker_threads = [threading.Thread(target=run_worker, args=(j,),
+                                       daemon=True)
+                      for j in range(1, n_workers + 1)]
+    timings["day_start"] = time.monotonic()
+    for t in worker_threads:
+        t.start()
+    for t in worker_threads:
+        t.join(timeout=600)
+    timings["day_end"] = time.monotonic()
+    stuck = [t for t in worker_threads if t.is_alive()]
+    agent_stop.set()
+    agent_thread.join(timeout=10)
+    for srv in servers:
+        srv.stop()
+    time.sleep(0.05)
+    agent.close()
+    coord.stop()
+    coord_thread.join(timeout=30)
+
+    # ---- per-(worker, shard) sequence accounting: every acked push is in
+    # the (possibly parked-and-resumed) server's applied counts ----------
+    acked: Dict[int, Dict[int, int]] = {}
+    applied: Dict[int, Dict[int, int]] = {}
+    for i in range(n_shards):
+        acked[i] = {j: (rel_workers[i][j].acked_count(
+            0, MessageCode.ShardPush) + rel_workers[i][j].acked_count(
+            0, MessageCode.GradientUpdate) + rel_workers[i][j].acked_count(
+            0, MessageCode.CompressedUpdate))
+            for j in range(1, 1 + n_workers)}
+        applied[i] = {j: servers[i].ps.applied_by_sender.get(j, 0)
+                      for j in range(1, 1 + n_workers)}
+        for j in range(1, 1 + n_workers):
+            if acked[i][j] > applied[i][j]:
+                violations.append(
+                    f"acked delta lost: shard {i} worker {j}: acked "
+                    f"{acked[i][j]} > applied {applied[i][j]}")
+    violations.extend(sched.ledger.audit())
+
+    for star in rel_workers:
+        for t in star.values():
+            t.close()
+    for srv in servers:
+        close = getattr(srv.transport, "close", None)
+        if close is not None:
+            close()
+    for t in coord_world.values():
+        t.close()
+
+    return {
+        "ok": (not stuck and not errors and not violations
+               and sched.preempts_done > 0),
+        "violations": violations,
+        "errors": errors,
+        "stuck_workers": len(stuck),
+        "losses": losses,
+        "acked": acked,
+        "applied": applied,
+        "replayed_updates": resumed_info["replayed"],
+        "bit_identical": resumed_info["bit_identical"],
+        "grants": grants,
+        "sched": sched.summary(),
+        "events": list(coord.events),
+        "chaos_lines": log.lines(),
+        "chaos_counts": log.counts(),
+        "held_pushes": {j: getattr(opts.get(j), "held_pushes", 0)
+                        for j in sorted(opts)},
+        # day geometry for the bench's goodput accounting: total day
+        # wall-clock and the measured peak window (demand-spike -> demand
+        # drop, i.e. the seconds the borrowed slot served)
+        "wall_s": timings["day_end"] - timings["day_start"],
+        "peak_window_s": (timings["offpeak"] - timings["peak"]
+                          if "peak" in timings and "offpeak" in timings
+                          else None),
+        "servers": servers,
+    }
+
+
+def sched_demo(seed: int = 0, base_dir: Optional[str] = None) -> Dict:
+    """One self-contained scheduler pass (``coord/cli.py --sched-demo``)."""
+    import tempfile
+
+    base = base_dir or tempfile.mkdtemp(prefix="sched_")
+    out = sched_drill(base_dir=base, seed=seed,
+                      plan=default_drill_plan(seed))
+    return {
+        "ok": out["ok"] and out["replayed_updates"] > 0,
+        "violations": out["violations"],
+        "preempt_mttr_s": out["sched"]["preempt_mttr_s"],
+        "resume_mttr_s": out["sched"]["resume_mttr_s"],
+        "replayed_updates": out["replayed_updates"],
+        "bit_identical": out["bit_identical"],
+        "acked": out["acked"],
+        "applied": out["applied"],
+        "held_pushes": out["held_pushes"],
+        "grants": out["grants"],
+        "events": out["events"],
+        "chaos": out["chaos_counts"],
+        "state_dir": base,
+    }
+
+
 def drill_demo(seed: int = 0, base_dir: Optional[str] = None) -> Dict:
     """One self-contained drill pass (``coord/cli.py --drill``)."""
     import tempfile

@@ -122,10 +122,18 @@ class ElasticShardServer:
         #: snapshot mailbox: the coord listener parks the request, the
         #: serve loop executes it at its next version boundary
         self._roll_req: Optional[int] = None
+        #: park mailbox (ISSUE 16), same discipline: the scheduler's
+        #: PreemptRequest is parked by the coord listener and executed by
+        #: the serve loop at its next version boundary — commit the WAL
+        #: group, report PreemptDone, stop serving WITHOUT a CoordLeave
+        self._preempt_req: Optional[tuple] = None
+        self._parked = False
         if getattr(coord, "on_snapshot", None) is None:
             coord.on_snapshot = self._note_snapshot
         if getattr(coord, "on_rollback", None) is None:
             coord.on_rollback = self._note_rollback
+        if getattr(coord, "on_preempt", None) is None:
+            coord.on_preempt = self._note_preempt
         self.stats = {
             "stale_dropped": 0, "parked_pulls": 0, "installs": 0,
             "dup_installs": 0, "spec_applied": 0, "spec_dropped": 0,
@@ -327,6 +335,39 @@ class ElasticShardServer:
             "update(s) discarded)", file=sys.stderr)
         self.coord.rollback_done(rollback_id, mv, lo, hi, apply_seq)
 
+    def _note_preempt(self, grant_id: int, snapshot_id: int) -> None:
+        """Coord-listener-thread callback (ISSUE 16): park the scheduler's
+        preempt request for the serve loop (newest wins; redelivery of the
+        same grant is idempotent — the server parks once)."""
+        with self._snap_mu:
+            self._preempt_req = (int(grant_id), int(snapshot_id))
+
+    def _take_preempt_request(self) -> Optional[tuple]:
+        with self._snap_mu:
+            req, self._preempt_req = self._preempt_req, None
+            return req
+
+    def _do_park(self, grant_id: int, snapshot_id: int) -> None:
+        """The member half of a preempt (ISSUE 16): at this version
+        boundary, commit the open WAL group — every ACKED delta is now
+        durable (log-before-ack + this fsync), so the parked state is
+        manifest checkpoint + exactly-once WAL replay away from bit-for-
+        bit — report PreemptDone, and stop serving. Deliberately NO
+        checkpoint (the WAL tail past the barrier snapshot is the replay
+        the resume proves) and NO CoordLeave (a parked life keeps its
+        rank, range and membership; the scheduler exempts its lease)."""
+        with self._mu:
+            self.ps.commit()
+            lo, hi = self.lo, self.hi
+            apply_seq = self.ps._apply_seq
+        self.coord.preempt_done(grant_id, snapshot_id, lo, hi, apply_seq)
+        self._parked = True
+        self._stop.set()
+        print(
+            f"shard {self.server_id}: PARKED [{lo},{hi}) at apply seq "
+            f"{apply_seq} under snapshot {snapshot_id} (grant {grant_id})",
+            file=sys.stderr)
+
     def restore_from_manifest(self, manifest) -> None:
         """Disaster recovery (ISSUE 5): re-install this shard's range from
         the manifest's shard map, then restore checkpoint + WAL replay.
@@ -503,6 +544,10 @@ class ElasticShardServer:
             snap = self._take_snapshot_request()
             if snap is not None:
                 self._do_snapshot(*snap)
+            park = self._take_preempt_request()
+            if park is not None:
+                self._do_park(*park)
+                break  # parked: state is durable on disk; serve no more
             if self.coord.fleet.workers_done():
                 break
             msg = self.transport.recv(timeout=0.1)
@@ -533,6 +578,12 @@ class ElasticShardServer:
                     self.ps.commit()
         if self._crashed:
             return  # scripted silent death: no checkpoint, no leave
+        if self._parked:
+            # a parked life: renewals stop but NO CoordLeave — the
+            # coordinator keeps the membership (lease exempted by the
+            # scheduler) and the resume rejoins the same rank/range
+            self.coord.stop()
+            return
         with self._mu:
             self.ps.save_checkpoint()
             self.ps.commit()

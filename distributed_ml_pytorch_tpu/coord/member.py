@@ -35,6 +35,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from distributed_ml_pytorch_tpu.coord.coordinator import (
+    KIND_AGENT,
     KIND_ENGINE,
     KIND_SHARD,
     KIND_STAGE,
@@ -42,6 +43,7 @@ from distributed_ml_pytorch_tpu.coord.coordinator import (
     decode_fleet,
     encode_join,
     encode_leave,
+    encode_preempt_done,
     encode_renew,
     encode_rollback_done,
     encode_snapshot_done,
@@ -55,7 +57,7 @@ from distributed_ml_pytorch_tpu.utils.messaging import (
 )
 
 _KINDS = {"worker": KIND_WORKER, "shard": KIND_SHARD, "engine": KIND_ENGINE,
-          "stage": KIND_STAGE}
+          "stage": KIND_STAGE, "agent": KIND_AGENT}
 
 
 class FleetView:
@@ -170,6 +172,18 @@ class CoordClient:
         #: assignment; called with the decoded ``StagePlacement`` on the
         #: listener thread (ISSUE 10)
         self.on_stage_assign = on_stage_assign
+        #: PUBLIC and mutable like on_snapshot (ISSUE 16): the shard server
+        #: wires its park mailbox in by assignment; called with
+        #: ``(grant_id, snapshot_id)`` on the listener thread — the member
+        #: commits, reports ``preempt_done`` and stops serving
+        self.on_preempt = None
+        #: PUBLIC and mutable (ISSUE 16): a NODE AGENT member wires its
+        #: actuators in by assignment — ``on_slot_grant(grant_id,
+        #: tenant_id, action, slot_id)`` spawns/retires the tenant's member
+        #: kind, ``on_resume(grant_id, rank, snapshot_id)`` restores the
+        #: parked member from the FleetManifest (+ exactly-once WAL replay)
+        self.on_slot_grant = None
+        self.on_resume = None
         self.rollback_hold_ttl = float(rollback_hold_ttl)
         self._lock = threading.Lock()
         self._latest_map: Optional[ShardMap] = None
@@ -256,6 +270,22 @@ class CoordClient:
             self.fleet.note_rollback(phase == 0, ttl=self.rollback_hold_ttl)
             if self.on_rollback is not None:
                 self.on_rollback(rollback_id, phase)
+        elif code == MessageCode.PreemptRequest and payload.size >= 4:
+            if self.on_preempt is not None and np.isfinite(payload[:4]).all():
+                self.on_preempt(
+                    _join16(payload[0], payload[1]),
+                    _join16(payload[2], payload[3]))
+        elif code == MessageCode.SlotGrant and payload.size >= 5:
+            if (self.on_slot_grant is not None
+                    and np.isfinite(payload[:5]).all()):
+                self.on_slot_grant(
+                    _join16(payload[0], payload[1]), int(payload[2]),
+                    int(payload[3]), int(payload[4]))
+        elif code == MessageCode.ResumeRequest and payload.size >= 5:
+            if self.on_resume is not None and np.isfinite(payload[:5]).all():
+                self.on_resume(
+                    _join16(payload[0], payload[1]), int(payload[2]),
+                    _join16(payload[3], payload[4]))
 
     def _renew_loop(self) -> None:
         tick = 0
@@ -326,6 +356,14 @@ class CoordClient:
         """Report this shard's completed in-place rollback (ISSUE 8)."""
         self._send(MessageCode.RollbackDone, encode_rollback_done(
             rollback_id, map_version, lo, hi, apply_seq))
+
+    def preempt_done(self, grant_id: int, snapshot_id: int, lo: int,
+                     hi: int, apply_seq: int) -> None:
+        """Report this member parked under ``grant_id`` (ISSUE 16): range
+        [lo,hi) durable at ``apply_seq`` under the named snapshot — the
+        scheduler may only now re-grant the slot."""
+        self._send(MessageCode.PreemptDone, encode_preempt_done(
+            grant_id, snapshot_id, lo, hi, apply_seq))
 
     def stage_ready(self, stage: int, watermark: int) -> None:
         """Announce this member serves pipeline stage ``stage`` at the

@@ -1,0 +1,489 @@
+"""The multi-tenant fleet scheduler (ISSUE 16 tentpole).
+
+DistBelief's production setting was a SHARED cluster: training jobs,
+pipelines and serving fleets competed for the same machines, and the
+framework's coordinator assigned work to whatever capacity existed —
+not a dedicated pod per demo. This module promotes ``coord/coordinator``
+to that role: tenants (``coord/tenants.py``) register demands with
+priorities, the :class:`FleetScheduler` owns a :class:`CapacityLedger`
+over fleet members and makes placement decisions:
+
+- **admit / pack** — a free slot is granted directly (``SlotGrant`` to
+  the node agent, which spawns the tenant's member kind — an
+  ``EngineMember`` for a serving tenant).
+- **preempt** — when a higher-priority tenant's demand is unmet, the
+  scheduler parks a low-priority training member: it first drives a
+  fleet snapshot barrier (the ``FleetManifest`` the park restores from
+  — the ``require_manifest`` gate the ``sched`` model checks), then
+  sends ``PreemptRequest``; the victim commits its WAL group, reports
+  ``PreemptDone`` and stops serving WITHOUT a ``CoordLeave`` — a parked
+  life, not a dead one (its lease is exempt from expiry, its shard-map
+  range stays put so workers degrade to held pushes, and a resume
+  rejoins the SAME range).
+- **resume** — off-peak, the grant is revoked (the agent retires the
+  engine) and ``ResumeRequest`` tells the agent to restore the parked
+  member bit-for-bit: fresh ``ElasticShardServer`` over the manifest's
+  checkpoint + exactly-once WAL replay (``restore_from_manifest``),
+  rejoining as a newer incarnation of the same rank.
+
+The capacity ledger is EXCLUSIVE by construction: a slot is granted to
+the waiting tenant only after the victim's ``PreemptDone`` frees it
+(``enforce_exclusive``; the ``double_grant_slot`` model mutation drops
+exactly this gate and ``audit()`` is the runtime detector).
+
+Like every coordinator decision, scheduling is synchronous and clock-
+injected: ``tick(now)`` runs on the coordinator's serve thread (wired
+via ``coord.sched``), so tests drive the whole protocol with
+``handle()``/``tick()`` calls and a fake clock. Decisions ride a capped
+:class:`~.obs.BoundedEvents` ring carrying the tenant id (total/dropped
+accounting — no append-forever maps) and double as ``sched``-plane
+flight-recorder events, so ``make timeline`` attributes where shared-
+capacity seconds went.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional
+
+from distributed_ml_pytorch_tpu.coord.tenants import (
+    TENANT_TRAINING,
+    Tenant,
+    TenantRegistry,
+)
+from distributed_ml_pytorch_tpu.utils import obs
+from distributed_ml_pytorch_tpu.utils.messaging import MessageCode
+
+_LOGGER = logging.getLogger(__name__)
+
+#: slot states (the sched plane's protocol states, mirrored by the
+#: ``analysis/distmodel.SchedModel`` bounded checker)
+FREE = "free"          # unowned capacity
+HELD = "held"          # a tenant's member runs here
+PARKING = "parking"    # preempt in flight: snapshot barrier / PreemptRequest
+PARKED = "parked"      # victim parked under a manifest; slot re-granted
+RESUMING = "resuming"  # ResumeRequest sent; awaiting the rank's new life
+
+
+@dataclasses.dataclass
+class Slot:
+    """One schedulable unit of fleet capacity.
+
+    ``owners`` is a LIST so the ledger can represent the illegal state
+    (two tenants owning one slot) instead of silently collapsing it —
+    ``audit()`` is the runtime detector for the ``double_grant_slot``
+    protocol bug, and a detector that cannot represent the bug detects
+    nothing.
+    """
+
+    slot_id: int
+    rank: Optional[int] = None  # coordinator rank of the occupying member
+    owners: List[int] = dataclasses.field(default_factory=list)
+    state: str = FREE
+    grant_id: int = 0
+    #: the parked member's restore ticket: rank, old incarnation, the
+    #: manifest snapshot id, its [lo,hi) range and apply_seq at park
+    parked: Optional[dict] = None
+
+
+class CapacityLedger:
+    """Who owns which slot — the scheduler's single source of truth.
+
+    ``enforce_exclusive`` is the correctness gate: a grant over a slot
+    another tenant still owns is REFUSED until the preempt protocol
+    frees it. The ``double_grant_slot`` mutation (and a misconfigured
+    deployment) drops the gate; :meth:`audit` reports every slot the
+    drop corrupted.
+    """
+
+    def __init__(self, *, enforce_exclusive: bool = True) -> None:
+        self.enforce_exclusive = bool(enforce_exclusive)
+        self.slots: Dict[int, Slot] = {}
+        self._next_slot = 0
+
+    def add_slot(self, *, rank: Optional[int] = None,
+                 tenant_id: Optional[int] = None) -> Slot:
+        slot = Slot(slot_id=self._next_slot, rank=rank)
+        self._next_slot += 1
+        if tenant_id is not None:
+            slot.owners.append(int(tenant_id))
+            slot.state = HELD
+        self.slots[slot.slot_id] = slot
+        return slot
+
+    def owned(self, tenant_id: int) -> List[Slot]:
+        return [s for s in self.slots.values() if tenant_id in s.owners]
+
+    def free_slots(self) -> List[Slot]:
+        return [s for s in self.slots.values()
+                if not s.owners and s.state == FREE]
+
+    def grant(self, slot: Slot, tenant_id: int, grant_id: int) -> bool:
+        """Grant ``slot`` to ``tenant_id``; False when exclusivity refuses."""
+        others = [o for o in slot.owners if o != tenant_id]
+        if others and self.enforce_exclusive:
+            return False
+        if tenant_id not in slot.owners:
+            slot.owners.append(int(tenant_id))
+        slot.grant_id = int(grant_id)
+        return True
+
+    def release(self, slot: Slot, tenant_id: int) -> None:
+        if tenant_id in slot.owners:
+            slot.owners.remove(tenant_id)
+
+    def audit(self) -> List[str]:
+        """Runtime exclusivity check: every multi-owner slot is a
+        violation (the model invariant's real-ledger twin)."""
+        return [
+            f"slot {s.slot_id} double-granted: owned by tenants "
+            f"{sorted(set(s.owners))}"
+            for s in self.slots.values() if len(set(s.owners)) > 1
+        ]
+
+
+class FleetScheduler:
+    """Placement decisions over the coordinator's member fleet.
+
+    Attach to a :class:`~.coordinator.Coordinator` (the constructor sets
+    ``coord.sched``); the coordinator's ``tick`` drives :meth:`tick` on
+    the serve thread and dispatches ``PreemptDone`` frames to
+    :meth:`on_preempt_done`. Actuation goes to the node agent member at
+    ``actuator_rank`` over the wire (``SlotGrant`` / ``ResumeRequest``)
+    and/or to the in-process ``on_grant`` / ``on_resume`` callbacks a
+    colocated harness sets.
+    """
+
+    def __init__(
+        self,
+        coord,
+        *,
+        registry: Optional[TenantRegistry] = None,
+        require_manifest: bool = True,
+        enforce_exclusive: bool = True,
+        actuator_rank: Optional[int] = None,
+        preempt_timeout: float = 30.0,
+        resume_timeout: float = 30.0,
+    ) -> None:
+        self.coord = coord
+        self.registry = registry if registry is not None else TenantRegistry()
+        self.ledger = CapacityLedger(enforce_exclusive=enforce_exclusive)
+        #: the park-with-manifest gate: a preempt first drives a fleet
+        #: snapshot barrier and only parks once the manifest is durable.
+        #: Dropping it is the ``park_without_manifest`` mutation — the
+        #: parked state may then be unrestorable (acked deltas lost).
+        self.require_manifest = bool(require_manifest)
+        self.actuator_rank = actuator_rank
+        self.preempt_timeout = float(preempt_timeout)
+        self.resume_timeout = float(resume_timeout)
+        #: capped decision ring (the ISSUE 16 small fix): every scale /
+        #: preempt / resume decision carries its tenant id and total /
+        #: dropped accounting — scheduler state holds NO unbounded maps
+        self.decisions = obs.BoundedEvents(maxlen=512)
+        #: in-process actuators (optional; the wire path is the agent):
+        #: on_grant(grant_id, tenant_id, action, slot),
+        #: on_resume(grant_id, parked_dict)
+        self.on_grant = None
+        self.on_resume = None
+        self._next_grant = 1
+        self._pending: Optional[dict] = None    # one preempt in flight
+        self._resuming: Optional[dict] = None   # one resume in flight
+        self.preempts_done = 0
+        self.preempts_aborted = 0
+        self.resumes_done = 0
+        self.preempt_mttrs: List[float] = []
+        self.resume_mttrs: List[float] = []
+        coord.sched = self
+
+    # ---------------------------------------------------------- bookkeeping
+    def _log(self, tenant_id: int, msg: str) -> None:
+        line = f"tenant {tenant_id}: {msg}"
+        self.decisions.append(line)
+        # mirror onto the coordinator's decision log (same capped ring the
+        # CLI tails) and the fleet timeline as a sched-plane event
+        self.coord.events.append(f"sched {line}")
+        if self.coord.recorder is not None:
+            self.coord.recorder.event("sched", corr=int(tenant_id), msg=msg)
+        _LOGGER.info("sched: %s", line)
+
+    def parked_ranks(self) -> set:
+        """Ranks whose silence is a PARK, not a death — the coordinator's
+        lease expiry and snapshot barrier exempt them."""
+        out = set()
+        for s in self.ledger.slots.values():
+            if s.parked is not None and s.state in (PARKED, RESUMING):
+                out.add(s.parked["rank"])
+        return out
+
+    def register_member_slot(self, rank: int, tenant_id: int) -> Slot:
+        """Record an existing member as a tenant-held slot."""
+        return self.ledger.add_slot(rank=rank, tenant_id=tenant_id)
+
+    def summary(self) -> dict:
+        return {
+            "preempts_done": self.preempts_done,
+            "preempts_aborted": self.preempts_aborted,
+            "resumes_done": self.resumes_done,
+            "preempt_mttr_s": list(self.preempt_mttrs),
+            "resume_mttr_s": list(self.resume_mttrs),
+            "decisions_total": self.decisions.total,
+            "decisions_dropped": self.decisions.dropped,
+            "audit": self.ledger.audit(),
+            "slots": {s.slot_id: {"state": s.state,
+                                  "owners": sorted(set(s.owners)),
+                                  "rank": s.rank}
+                      for s in self.ledger.slots.values()},
+        }
+
+    # ----------------------------------------------------------------- tick
+    def tick(self, now: float) -> None:
+        """One scheduling pass (serve thread, via ``Coordinator.tick``)."""
+        self._drive_pending(now)
+        self._drive_resuming(now)
+        self._evaluate(now)
+
+    def _evaluate(self, now: float) -> None:
+        for tenant in self.registry.all():  # priority-descending
+            have = len(self.ledger.owned(tenant.tenant_id))
+            if (self._pending is not None
+                    and self._pending["for"] == tenant.tenant_id):
+                have += 1  # a preempt already in flight counts as packed
+            shortfall = tenant.demand - have
+            if shortfall > 0:
+                self._pack(tenant, shortfall, now)
+            elif shortfall < 0:
+                self._shrink(tenant, -shortfall, now)
+
+    def _pack(self, tenant: Tenant, shortfall: int, now: float) -> None:
+        for slot in self.ledger.free_slots():
+            if shortfall <= 0:
+                return
+            gid = self._next_grant
+            self._next_grant += 1
+            self.ledger.grant(slot, tenant.tenant_id, gid)
+            slot.state = HELD
+            shortfall -= 1
+            self._log(tenant.tenant_id,
+                      f"admit: free slot {slot.slot_id} granted "
+                      f"(grant {gid})")
+            self._actuate_grant(gid, tenant.tenant_id, 1, slot)
+        if shortfall <= 0 or self._pending is not None:
+            return
+        victim = self._pick_victim(tenant)
+        if victim is None:
+            return
+        slot, victim_tenant = victim
+        if not self.ledger.enforce_exclusive:
+            # the double_grant_slot bug surface: capacity handed to the
+            # new tenant BEFORE the victim's park completes — the ledger
+            # now shows two owners, audit() flags it
+            gid = self._next_grant
+            self._next_grant += 1
+            self.ledger.grant(slot, tenant.tenant_id, gid)
+            self._log(tenant.tenant_id,
+                      f"grant of slot {slot.slot_id} issued while tenant "
+                      f"{victim_tenant.tenant_id} still holds it "
+                      f"(exclusivity off)")
+            self._actuate_grant(gid, tenant.tenant_id, 1, slot)
+        self._start_preempt(slot, victim_tenant, tenant, now)
+
+    def _pick_victim(self, tenant: Tenant):
+        """Lowest-priority HELD slot whose owner outranks nobody — never
+        preempt a peer or superior, never below the owner's min_slots."""
+        for victim in self.registry.by_priority_asc():
+            if victim.priority >= tenant.priority:
+                return None
+            owned = [s for s in self.ledger.owned(victim.tenant_id)
+                     if s.state == HELD and s.rank is not None]
+            if len(owned) <= victim.min_slots or not owned:
+                continue
+            return owned[-1], victim
+        return None
+
+    def _shrink(self, tenant: Tenant, surplus: int, now: float) -> None:
+        if self._resuming is not None:
+            return
+        # shed parked-backed slots first: releasing one both retires the
+        # borrowed member AND resumes the parked victim
+        owned = sorted(self.ledger.owned(tenant.tenant_id),
+                       key=lambda s: s.parked is None)
+        for slot in owned[:surplus]:
+            if slot.state not in (HELD, PARKED):
+                continue
+            self.ledger.release(slot, tenant.tenant_id)
+            self._log(tenant.tenant_id,
+                      f"release: slot {slot.slot_id} revoked "
+                      f"(grant {slot.grant_id})")
+            self._actuate_grant(slot.grant_id, tenant.tenant_id, 0, slot)
+            if slot.parked is not None:
+                self._start_resume(slot, now)
+                return  # one resume in flight at a time
+            slot.state = FREE
+
+    # -------------------------------------------------------------- preempt
+    def _start_preempt(self, slot: Slot, victim: Tenant, for_tenant: Tenant,
+                       now: float) -> None:
+        slot.state = PARKING
+        gid = self._next_grant
+        self._next_grant += 1
+        self._pending = {
+            "slot": slot,
+            "victim": victim.tenant_id,
+            "for": for_tenant.tenant_id,
+            "grant_id": gid,
+            "started": now,
+            "manifest_baseline": self.coord.manifests_written,
+            "snap_requested": False,
+            "sent": False,
+        }
+        self._log(for_tenant.tenant_id,
+                  f"preempt: parking tenant {victim.tenant_id}'s member "
+                  f"rank {slot.rank} (slot {slot.slot_id}, grant {gid}, "
+                  f"manifest {'required' if self.require_manifest else 'SKIPPED'})")
+        self._drive_pending(now)
+
+    def _drive_pending(self, now: float) -> None:
+        p = self._pending
+        if p is None:
+            return
+        slot = p["slot"]
+        if now - p["started"] > self.preempt_timeout:
+            slot.state = HELD
+            self.preempts_aborted += 1
+            self._pending = None
+            self._log(p["for"],
+                      f"preempt of slot {slot.slot_id} ABANDONED after "
+                      f"{self.preempt_timeout:.0f}s (grant {p['grant_id']})")
+            return
+        if p["sent"]:
+            return
+        if self.require_manifest:
+            if not p["snap_requested"]:
+                p["snap_requested"] = True
+                self.coord.trigger_snapshot()
+                return
+            if self.coord.manifests_written <= p["manifest_baseline"]:
+                return  # barrier still in flight; next tick re-checks
+            snap_id = int(self.coord.last_manifest.snapshot_id)
+        else:
+            lm = self.coord.last_manifest
+            snap_id = int(lm.snapshot_id) if lm is not None else 0
+        from distributed_ml_pytorch_tpu.coord.coordinator import (
+            encode_preempt_request,
+        )
+
+        p["sent"] = True
+        p["snap_id"] = snap_id
+        self.coord._send(slot.rank, MessageCode.PreemptRequest,
+                         encode_preempt_request(p["grant_id"], snap_id))
+        self._log(p["for"],
+                  f"preempt: PreemptRequest grant {p['grant_id']} snapshot "
+                  f"{snap_id} -> rank {slot.rank}")
+
+    def on_preempt_done(self, sender: int, *, grant_id: int, snap_id: int,
+                        lo: int, hi: int, apply_seq: int,
+                        now: float) -> None:
+        """Wired from ``Coordinator.handle`` (PreemptDone dispatch)."""
+        p = self._pending
+        if p is None or grant_id != p["grant_id"] or p["slot"].rank != sender:
+            self._log(-1, f"stale PreemptDone from rank {sender} "
+                          f"(grant {grant_id})")
+            return
+        slot = p["slot"]
+        member = self.coord.members.get(sender)
+        slot.parked = {
+            "rank": sender,
+            "tenant": p["victim"],
+            "incarnation": member.incarnation if member is not None else 0,
+            "snapshot_id": snap_id,
+            "lo": lo,
+            "hi": hi,
+            "apply_seq": apply_seq,
+        }
+        self.ledger.release(slot, p["victim"])
+        slot.state = PARKED
+        mttr = now - p["started"]
+        self.preempts_done += 1
+        self.preempt_mttrs.append(mttr)
+        self._log(p["victim"],
+                  f"parked: rank {sender} [{lo},{hi}) at apply seq "
+                  f"{apply_seq} under snapshot {snap_id} "
+                  f"({mttr * 1e3:.0f} ms)")
+        # only NOW is the slot free for the waiting tenant (the exclusive
+        # hand-over the double_grant_slot mutation breaks)
+        self.ledger.grant(slot, p["for"], grant_id)
+        self._log(p["for"],
+                  f"grant: slot {slot.slot_id} -> tenant {p['for']} "
+                  f"(grant {grant_id})")
+        self._actuate_grant(grant_id, p["for"], 1, slot)
+        self._pending = None
+
+    # --------------------------------------------------------------- resume
+    def _start_resume(self, slot: Slot, now: float) -> None:
+        from distributed_ml_pytorch_tpu.coord.coordinator import (
+            encode_resume_request,
+        )
+
+        slot.state = RESUMING
+        gid = self._next_grant
+        self._next_grant += 1
+        self._resuming = {
+            "slot": slot,
+            "grant_id": gid,
+            "started": now,
+            "incarnation": slot.parked["incarnation"],
+        }
+        self._log(slot.parked["tenant"],
+                  f"resume: restoring rank {slot.parked['rank']} from "
+                  f"snapshot {slot.parked['snapshot_id']} (grant {gid})")
+        if self.actuator_rank is not None:
+            self.coord._send(
+                self.actuator_rank, MessageCode.ResumeRequest,
+                encode_resume_request(gid, slot.parked["rank"],
+                                      slot.parked["snapshot_id"]))
+        if self.on_resume is not None:
+            self.on_resume(gid, dict(slot.parked))
+
+    def _drive_resuming(self, now: float) -> None:
+        r = self._resuming
+        if r is None:
+            return
+        slot = r["slot"]
+        parked = slot.parked
+        member = self.coord.members.get(parked["rank"])
+        if member is not None and member.incarnation > r["incarnation"]:
+            # the rank's new life joined: the park round-tripped
+            tenant_id = parked["tenant"]
+            slot.parked = None
+            slot.owners = [tenant_id]
+            slot.state = HELD
+            mttr = now - r["started"]
+            self.resumes_done += 1
+            self.resume_mttrs.append(mttr)
+            self._resuming = None
+            self._log(tenant_id,
+                      f"resumed: rank {parked['rank']} rejoined as inc "
+                      f"{member.incarnation} ({mttr * 1e3:.0f} ms) — slot "
+                      f"{slot.slot_id} back to tenant {tenant_id}")
+            return
+        if now - r["started"] > self.resume_timeout:
+            slot.state = PARKED
+            self._resuming = None
+            self._log(parked["tenant"],
+                      f"resume of rank {parked['rank']} ABANDONED after "
+                      f"{self.resume_timeout:.0f}s — still parked")
+
+    # ------------------------------------------------------------- actuation
+    def _actuate_grant(self, grant_id: int, tenant_id: int, action: int,
+                       slot: Slot) -> None:
+        if self.actuator_rank is not None:
+            from distributed_ml_pytorch_tpu.coord.coordinator import (
+                encode_slot_grant,
+            )
+
+            self.coord._send(
+                self.actuator_rank, MessageCode.SlotGrant,
+                encode_slot_grant(grant_id, tenant_id, action, slot.slot_id))
+        if self.on_grant is not None:
+            self.on_grant(grant_id, tenant_id, action, slot)

@@ -282,6 +282,15 @@ class ShardedAsynchronous:
         # SILENT deaths (partition/power loss) that a blocking TCP send
         # would otherwise stall on instead of raising.
         self.shard_down = [False] * len(self.transports)
+        #: scheduler park window (ISSUE 16): a HELD shard is parked by the
+        #: fleet scheduler, not dead — its slice degrades to purely-local
+        #: SGD exactly like shard_down, but deliberately and silently (no
+        #: down/up transition logging, no revival probes: the resume's
+        #: ``release_shard`` restores service). Unsent pushes are counted
+        #: in ``held_pushes``; an unsent push is an unacked push, so the
+        #: drill accounting (acked <= applied) holds through the window.
+        self.shard_held = [False] * len(self.transports)
+        self.held_pushes = 0
         self.heartbeats = list(heartbeats) if heartbeats else None
         if self.heartbeats is not None and len(self.heartbeats) != len(self.transports):
             raise ValueError("need one heartbeat sender per shard transport")
@@ -365,6 +374,13 @@ class ShardedAsynchronous:
         a restarted server's reply is exactly the contact that
         :meth:`_mark_up` revives on — without it the down flag would be a
         one-way door and the revive path dead code."""
+        if self.shard_held[shard]:
+            # parked by the scheduler (ISSUE 16): nothing is sent — not
+            # even the pull probe; the park is deliberate and the resume
+            # releases it explicitly. The skipped push was never acked.
+            if code in (MessageCode.GradientUpdate, MessageCode.ShardPush):
+                self.held_pushes += 1
+            return
         if self.shard_down[shard]:
             if code != MessageCode.ParameterRequest:
                 return
@@ -384,6 +400,9 @@ class ShardedAsynchronous:
     def _sendv(self, shard: int, code: MessageCode, parts) -> None:
         """The ``_send`` degrade discipline for multi-part (scatter/
         gather) frames — compressed pushes ride here."""
+        if self.shard_held[shard]:
+            self.held_pushes += 1  # parked by the scheduler (see _send)
+            return
         if self.shard_down[shard]:
             return  # pulls remain the revival probe (_send)
         if self.heartbeats is not None and self.heartbeats[shard].peer_down:
@@ -393,6 +412,31 @@ class ShardedAsynchronous:
             self.transports[shard].sendv(code, parts)
         except (OSError, ConnectionError):
             self._mark_down(shard)
+
+    def hold_shard(self, server_id: int) -> None:
+        """Scheduler park window (ISSUE 16): stop all traffic toward the
+        named shard server — its slice degrades to purely-local SGD until
+        :meth:`release_shard`. The flusher is drained first so no push cut
+        before the hold lands after it."""
+        self._flusher.drain()
+        idx = self.server_ids.index(server_id)
+        self.shard_held[idx] = True
+        lo, hi = self.ranges[idx]
+        print(
+            f"worker: shard {server_id} HELD (parked by the scheduler) — "
+            f"params [{lo},{hi}) continue with purely-local SGD",
+            file=sys.stderr,
+        )
+
+    def release_shard(self, server_id: int) -> None:
+        """End a park window: resume push/pull service to the shard (the
+        resumed server answers under the same range)."""
+        idx = self.server_ids.index(server_id)
+        self.shard_held[idx] = False
+        print(
+            f"worker: shard {server_id} RELEASED — push/pull service "
+            "resumes", file=sys.stderr,
+        )
 
     def _mark_down(self, shard: int) -> None:
         if self.shard_down[shard]:
@@ -565,22 +609,24 @@ class ShardedAsynchronous:
         values (``RangeInstall`` — first cutover wins server-side).
         """
         self._flusher.drain()
-        old = {sid: (t, listener, down) for sid, t, listener, down in zip(
-            self.server_ids, self.transports, self.listeners, self.shard_down)}
-        new_transports, new_listeners, new_down = [], [], []
+        old = {sid: (t, listener, down, held) for sid, t, listener, down, held
+               in zip(self.server_ids, self.transports, self.listeners,
+                      self.shard_down, self.shard_held)}
+        new_transports, new_listeners, new_down, new_held = [], [], [], []
         for e in m.entries:
             if e.server_id in old:
-                t, listener, down = old.pop(e.server_id)
+                t, listener, down, held = old.pop(e.server_id)
             else:
                 t = self.transport_factory(e)
                 self._owned.add(e.server_id)
                 listener = Listener(transport=t)
                 listener.start()
-                down = False
+                down = held = False
             new_transports.append(t)
             new_listeners.append(listener)
             new_down.append(down)
-        for sid, (t, listener, _down) in old.items():
+            new_held.append(held)
+        for sid, (t, listener, _down, _held) in old.items():
             listener.stop()
             if sid in self._owned:
                 self._owned.discard(sid)
@@ -595,6 +641,7 @@ class ShardedAsynchronous:
         self.transports = new_transports
         self.listeners = new_listeners
         self.shard_down = new_down
+        self.shard_held = new_held
         self.ranges = m.ranges
         self.server_ids = [e.server_id for e in m.entries]
         self.map_version = m.version

@@ -406,6 +406,41 @@ class FleetRouter(ServingFrontend):
                 # spans the WHOLE outage (death detection -> this resume)
                 self._note_resumed(route)
 
+    # ----------------------------------------------------- elastic membership
+    def add_member(self, member: EngineMember) -> None:
+        """Admit a NEW replica mid-run (the autoscaler's scale-up path,
+        ISSUE 16): same wiring the constructor does — unique id, the
+        router owns ``on_tokens``, healthy until a probe says otherwise.
+        The member should already be started (its serve thread beats)."""
+        if member.engine_id in self.members:
+            raise ValueError(f"duplicate engine_id {member.engine_id}")
+        if member.engine.on_tokens is not None:
+            raise ValueError(
+                f"engine {member.engine_id} already has an on_tokens consumer")
+        member.engine.on_tokens = self._on_tokens
+        self.members[member.engine_id] = member
+        self._member_up[member.engine_id] = True
+        print(f"fleet: engine {member.engine_id} admitted (scale-up)",
+              file=sys.stderr)
+
+    def remove_member(self, engine_id: int) -> Optional[EngineMember]:
+        """Retire a replica mid-run (the scale-down / slot-revoke path):
+        mark it down, migrate its in-flight streams to survivors FIRST,
+        then stop it cleanly. Returns the removed member (None if
+        unknown)."""
+        member = self.members.get(engine_id)
+        if member is None:
+            return None
+        now = time.monotonic()
+        self._member_up[engine_id] = False
+        self._migrate_from(engine_id, now)
+        member.stop()
+        del self.members[engine_id]
+        self._member_up.pop(engine_id, None)
+        print(f"fleet: engine {engine_id} retired (scale-down)",
+              file=sys.stderr)
+        return member
+
     # ------------------------------------------------------------------ loop
     def _sweep(self, now: float) -> None:
         self._probe(now)
@@ -443,3 +478,144 @@ class FleetRouter(ServingFrontend):
             "reaped": self.reaped,
             "held_peak": self.held_peak,
         }
+
+
+class FleetAutoscaler:
+    """The coordinator's serving-side ACTUATOR (ISSUE 16): closes the
+    ``check_engine_scaling`` advisory loop.
+
+    Before this, scale advice was an event + callback the harness had to
+    act on by hand. Wire :meth:`on_scale` as the coordinator's
+    ``on_scale`` callback (or call it from a node agent's ``SlotGrant``
+    handler) and the fleet actually changes shape: **up** spawns a fresh
+    replica via ``member_factory`` (an ``EngineMember`` with its own
+    engine + optional coord lease), starts it and admits it to the
+    router; **down** retires the emptiest replica (streams migrate to
+    survivors first). ``min_engines``/``max_engines`` bound the fleet.
+
+    Scale-up MTTR — advice fired -> the new replica's serve loop beating
+    — is sampled per spawn (``scale_up_mttr_s``; the bench JSON reports
+    it), measured at the next :meth:`poll`.
+    """
+
+    def __init__(self, router: FleetRouter, member_factory, *,
+                 min_engines: int = 1, max_engines: int = 8,
+                 clock=time.monotonic):
+        self.router = router
+        self.member_factory = member_factory  # () -> EngineMember (unstarted)
+        self.min_engines = int(min_engines)
+        self.max_engines = int(max_engines)
+        self._clock = clock
+        self.scaled_up = 0
+        self.scaled_down = 0
+        self.refused = 0
+        self.scale_up_mttr_s: List[float] = []
+        self._pending_up: List[Tuple[float, int, float]] = []  # (t0, eid, beat0)
+        self._spawning = 0  # in-flight scale-ups, counted toward max
+        self._retiring = 0
+        self._workers: List[threading.Thread] = []
+        self._mu = threading.Lock()
+
+    def on_scale(self, direction: str, detail: dict) -> None:
+        """The coordinator's ``on_scale`` callback. It runs ON the
+        coordinator's serve thread, and actually spawning a replica is
+        slow (model build + warmup compile + coord join — the join waits
+        on the very serve thread calling us). So this only ADMITS the
+        decision under the capacity bounds; the blocking work runs on a
+        short-lived worker thread. ``quiesce()`` joins stragglers."""
+        with self._mu:
+            n = len(self.router.members)
+            if direction == "up":
+                if n + self._spawning >= self.max_engines:
+                    self.refused += 1
+                    return
+                self._spawning += 1
+                worker = threading.Thread(
+                    target=self._spawn, args=(self._clock(),),
+                    name="fleet-scale-up", daemon=True)
+            elif direction == "down":
+                victim = self._emptiest()
+                if n - self._retiring <= self.min_engines or victim is None:
+                    self.refused += 1
+                    return
+                self._retiring += 1
+                worker = threading.Thread(
+                    target=self._retire, args=(victim.engine_id,),
+                    name="fleet-scale-down", daemon=True)
+            else:
+                return
+            self._workers.append(worker)
+        worker.start()
+
+    def _spawn(self, t0: float) -> None:
+        try:
+            member = self.member_factory()
+            member.start()
+            with self._mu:
+                self.router.add_member(member)
+                self.scaled_up += 1
+                self._pending_up.append((t0, member.engine_id,
+                                         member.last_beat))
+        finally:
+            with self._mu:
+                self._spawning -= 1
+
+    def _retire(self, engine_id: int) -> None:
+        try:
+            if self.router.remove_member(engine_id) is not None:
+                with self._mu:
+                    self.scaled_down += 1
+        finally:
+            with self._mu:
+                self._retiring -= 1
+
+    def quiesce(self, timeout: float = 10.0) -> bool:
+        """Join outstanding spawn/retire workers (tests, end-of-bench)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._mu:
+                self._workers = [w for w in self._workers if w.is_alive()]
+                live = list(self._workers)
+            if not live:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            live[0].join(timeout=0.05)
+
+    def _emptiest(self) -> Optional[EngineMember]:
+        """Least-loaded ALIVE member — retiring it migrates the fewest
+        streams. Never the only member."""
+        candidates = []
+        for eid, m in sorted(self.router.members.items()):
+            busy, _slots, queued = m.pressure()
+            candidates.append((busy + queued, eid, m))
+        if len(candidates) <= 1:
+            return None
+        candidates.sort()
+        return candidates[0][2]
+
+    def poll(self) -> None:
+        """Close pending scale-up MTTR samples: a spawned member whose
+        serve loop has beaten since the spawn is IN SERVICE."""
+        with self._mu:
+            still = []
+            for t0, eid, beat0 in self._pending_up:
+                m = self.router.members.get(eid)
+                if m is None:
+                    continue  # retired before it ever served
+                if m.last_beat > beat0:
+                    self.scale_up_mttr_s.append(m.last_beat - t0)
+                else:
+                    still.append((t0, eid, beat0))
+            self._pending_up = still
+
+    def summary(self) -> dict:
+        self.poll()
+        with self._mu:
+            return {
+                "scaled_up": self.scaled_up,
+                "scaled_down": self.scaled_down,
+                "refused": self.refused,
+                "scale_up_mttr_s": list(self.scale_up_mttr_s),
+                "n_engines": len(self.router.members),
+            }

@@ -133,6 +133,11 @@ class MessageCode(enum.IntEnum):
     StageAssign = 33
     # --- scalable optimizer plane (ISSUE 14): compressed gradient wire ---
     CompressedUpdate = 34
+    # --- multi-tenant scheduler plane (ISSUE 16): preempt / park / resume ---
+    PreemptRequest = 35
+    PreemptDone = 36
+    SlotGrant = 37
+    ResumeRequest = 38
 
 
 #: dedup-key vocabulary (ISSUE 13): WHICH receiver-side guard makes an
@@ -488,6 +493,42 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
             "admission gate (z-scores on the decoded norm — compression "
             "cannot slip the gate), WAL-logs the decoded delta plus this "
             "codec id, then applies — replay never re-decodes"),
+    MessageCode.PreemptRequest: PayloadSchema(
+        fields=("grant_lo", "grant_hi", "snap_lo", "snap_hi"),
+        handled_by=("coord",),
+        dedup_key="request_id",
+        doc="scheduler (via coordinator) -> victim shard member: park "
+            "yourself under grant_id; snap_id names the FleetManifest "
+            "snapshot the scheduler barriered BEFORE issuing the preempt "
+            "(the park-with-manifest gate the sched model checks). The "
+            "member commits its WAL group, reports PreemptDone and stops "
+            "serving WITHOUT a CoordLeave — a parked life, not a dead one"),
+    MessageCode.PreemptDone: PayloadSchema(
+        fields=("grant_lo", "grant_hi", "snap_lo", "snap_hi", "lo_lo",
+                "lo_hi", "hi_lo", "hi_hi", "apply_lo", "apply_hi"),
+        handled_by=("coord",),
+        dedup_key="request_id",
+        doc="parked shard -> coordinator: range [lo,hi) parked at "
+            "apply_seq under snapshot snap_id; the scheduler frees the "
+            "slot and only NOW may grant it to another tenant (the "
+            "double-grant gate the sched model checks)"),
+    MessageCode.SlotGrant: PayloadSchema(
+        fields=("grant_lo", "grant_hi", "tenant", "action", "slot"),
+        handled_by=("coord",),
+        dedup_key="request_id",
+        doc="scheduler -> node agent: actuate a placement decision — "
+            "action 1 grants slot to tenant (the agent spawns that "
+            "tenant's member kind, e.g. an EngineMember for a serving "
+            "tenant), action 0 revokes it (the agent retires the member). "
+            "grant_id makes redelivery first-wins idempotent"),
+    MessageCode.ResumeRequest: PayloadSchema(
+        fields=("grant_lo", "grant_hi", "rank", "snap_lo", "snap_hi"),
+        handled_by=("coord",),
+        dedup_key="request_id",
+        doc="scheduler -> node agent: resume the member parked under "
+            "grant_id as a fresh life of `rank`, restoring snapshot "
+            "snap_id bit-for-bit from the FleetManifest and replaying "
+            "WAL'd deltas exactly once before rejoining the fleet"),
 }
 
 

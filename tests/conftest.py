@@ -133,6 +133,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "sched: multi-tenant scheduler tests (coord/sched.py + "
+        "coord/tenants.py — capacity ledger, admit/pack/preempt/resume "
+        "decisions, the park-and-restore drill, autoscale actuation — "
+        "ISSUE 16); `make sched` selects exactly these — fast units run "
+        "in tier-1, the full drill scenarios are additionally measured "
+        "into slow_tests.txt",
+    )
+    config.addinivalue_line(
+        "markers",
         "netweather: adaptive-wire tests under network weather "
         "(utils/chaos.WeatherRule + the RTO/window/breaker machinery in "
         "utils/messaging.ReliableTransport); `make netweather` selects "

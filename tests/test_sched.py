@@ -1,0 +1,394 @@
+"""ISSUE 16 — the multi-tenant fleet scheduler (coord/sched.py).
+
+Four layers:
+
+1. **Ledger + registry units** — exclusive grants, the double-owner
+   audit, priority ordering and wire-exact tenant ids.
+2. **Scheduler protocol, synchronously** — a real ``Coordinator`` with a
+   fake clock, driven entirely by ``handle()``/``tick()`` calls: admit
+   over free capacity, the preempt → park → hand-over → resume round
+   trip, lease exemption for parked ranks, resume timeout, and the
+   capped decision ring.
+3. **Autoscale actuation** — ``FleetAutoscaler`` closes the
+   ``check_engine_scaling`` advisory loop (spawn on up, retire the
+   emptiest on down, capacity bounds refuse, MTTR sampling).
+4. **The drill** — ``sched_drill`` preempts a live training member
+   mid-run under wire chaos, parks it via the FleetManifest, resumes it
+   bit-for-bit, and proves acked <= applied per (worker, shard), zero
+   double-applied deltas, and 3x byte-identical chaos logs.
+"""
+
+import pytest
+
+from distributed_ml_pytorch_tpu.coord import drill
+from distributed_ml_pytorch_tpu.coord.coordinator import (
+    KIND_ENGINE,
+    KIND_SHARD,
+    Coordinator,
+    encode_join,
+    encode_preempt_done,
+    encode_renew,
+)
+from distributed_ml_pytorch_tpu.coord.sched import (
+    HELD,
+    PARKED,
+    PARKING,
+    RESUMING,
+    CapacityLedger,
+    FleetScheduler,
+)
+from distributed_ml_pytorch_tpu.coord.tenants import (
+    TENANT_SERVING,
+    Tenant,
+    TenantRegistry,
+)
+from distributed_ml_pytorch_tpu.serving.fleet import FleetAutoscaler
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    InProcessTransport,
+    MessageCode,
+)
+
+pytestmark = pytest.mark.sched
+
+TRAIN, SERVE = 1, 2
+
+
+# ------------------------------------------------------- ledger + registry
+
+def test_ledger_exclusive_grant_refused_until_released():
+    ledger = CapacityLedger()
+    slot = ledger.add_slot(rank=3, tenant_id=TRAIN)
+    assert slot.state == HELD and ledger.owned(TRAIN) == [slot]
+    assert not ledger.grant(slot, SERVE, grant_id=1)  # exclusivity refuses
+    assert slot.owners == [TRAIN] and ledger.audit() == []
+    ledger.release(slot, TRAIN)
+    assert ledger.grant(slot, SERVE, grant_id=2)
+    assert slot.owners == [SERVE] and slot.grant_id == 2
+
+
+def test_ledger_audit_flags_double_owner_when_gate_dropped():
+    ledger = CapacityLedger(enforce_exclusive=False)
+    slot = ledger.add_slot(rank=3, tenant_id=TRAIN)
+    assert ledger.grant(slot, SERVE, grant_id=1)  # the bug surface
+    (line,) = ledger.audit()
+    assert "double-granted" in line and f"[{TRAIN}, {SERVE}]" in line
+
+
+def test_registry_priority_order_and_wire_exact_ids():
+    reg = TenantRegistry()
+    reg.register(Tenant(tenant_id=TRAIN, name="train", priority=1))
+    reg.register(Tenant(tenant_id=SERVE, name="serve",
+                        kind=TENANT_SERVING, priority=5))
+    assert [t.tenant_id for t in reg.all()] == [SERVE, TRAIN]
+    assert [t.tenant_id for t in reg.by_priority_asc()] == [TRAIN, SERVE]
+    with pytest.raises(ValueError):
+        reg.register(Tenant(tenant_id=1 << 16, name="too-wide"))
+    reg.set_demand(SERVE, 3)
+    assert reg.get(SERVE).demand == 3
+
+
+# ------------------------------------- scheduler protocol, synchronously
+
+def _harness(*, require_manifest=False, enforce_exclusive=True,
+             lease=60.0, resume_timeout=30.0):
+    """A real Coordinator + scheduler on a fake clock; ranks 1..2 are
+    shard members registered as the training tenant's slots."""
+    now = [0.0]
+    world = InProcessTransport.create_world(4)
+    coord = Coordinator(world[0], 8, lease=lease, speculation=False,
+                        clock=lambda: now[0])
+    reg = TenantRegistry()
+    reg.register(Tenant(tenant_id=TRAIN, name="train", priority=1,
+                        demand=2, min_slots=1))
+    reg.register(Tenant(tenant_id=SERVE, name="serve",
+                        kind=TENANT_SERVING, priority=5, demand=0))
+    sched = FleetScheduler(coord, registry=reg,
+                           require_manifest=require_manifest,
+                           enforce_exclusive=enforce_exclusive,
+                           resume_timeout=resume_timeout)
+    for rank in (1, 2):
+        coord.handle(rank, MessageCode.CoordJoin,
+                     encode_join(KIND_SHARD, rank))
+        sched.register_member_slot(rank, TRAIN)
+    grants = []
+    sched.on_grant = lambda gid, tid, action, slot: grants.append(
+        (gid, tid, action, slot.slot_id))
+    return coord, sched, now, grants, world
+
+
+def _close(world):
+    for t in world.values():
+        t.close()
+
+
+def test_free_slot_admitted_without_preempting_anyone():
+    coord, sched, now, grants, world = _harness()
+    try:
+        sched.ledger.add_slot(rank=None)  # spare capacity
+        sched.registry.set_demand(SERVE, 1)
+        sched.tick(now[0])
+        assert [g[1:3] for g in grants] == [(SERVE, 1)]
+        assert len(sched.ledger.owned(SERVE)) == 1
+        assert sched.preempts_done == 0 and sched.ledger.audit() == []
+        assert any(f"tenant {SERVE}: admit" in d for d in sched.decisions)
+    finally:
+        _close(world)
+
+
+def _park_victim(coord, sched, now):
+    """Drive demand spike -> PreemptRequest -> PreemptDone; returns the
+    victim rank and the serving grant id."""
+    sched.registry.set_demand(SERVE, 1)
+    sched.tick(now[0])
+    p = sched._pending
+    assert p is not None and p["slot"].state == PARKING
+    victim = p["slot"].rank
+    gid = p["grant_id"]
+    coord.handle(victim, MessageCode.PreemptDone,
+                 encode_preempt_done(gid, 0, 4, 8, 17))
+    return victim, gid
+
+
+def test_preempt_parks_victim_then_hands_slot_over_exclusively():
+    coord, sched, now, grants, world = _harness()
+    try:
+        victim, gid = _park_victim(coord, sched, now)
+        assert victim == 2  # last-owned slot of the lowest-priority tenant
+        (slot,) = sched.ledger.owned(SERVE)
+        assert slot.state == PARKED and slot.owners == [SERVE]
+        assert slot.parked["rank"] == victim
+        assert slot.parked == dict(rank=victim, tenant=TRAIN,
+                                   incarnation=victim, snapshot_id=0,
+                                   lo=4, hi=8, apply_seq=17)
+        # the grant fired only AFTER PreemptDone freed the slot
+        assert grants == [(gid, SERVE, 1, slot.slot_id)]
+        assert sched.preempts_done == 1 and len(sched.preempt_mttrs) == 1
+        assert sched.ledger.audit() == []
+        assert sched.parked_ranks() == {victim}
+        # min_slots floor: more demand finds no second victim
+        sched.registry.set_demand(SERVE, 2)
+        sched.tick(now[0])
+        assert sched._pending is None and sched.preempts_done == 1
+    finally:
+        _close(world)
+
+
+def test_parked_rank_is_exempt_from_lease_expiry():
+    coord, sched, now, grants, world = _harness(lease=2.0)
+    try:
+        victim, _ = _park_victim(coord, sched, now)
+        now[0] += 50.0  # way past every lease
+        coord.tick()
+        assert victim in coord.members   # a park, not a death
+        assert 1 not in coord.members    # the unparked silent rank expired
+    finally:
+        _close(world)
+
+
+def test_resume_completes_when_the_rank_rejoins_newer():
+    coord, sched, now, grants, world = _harness()
+    try:
+        victim, _ = _park_victim(coord, sched, now)
+        resumes = []
+        sched.on_resume = lambda gid, parked: resumes.append(parked)
+        sched.registry.set_demand(SERVE, 0)  # off-peak
+        now[0] += 1.0
+        sched.tick(now[0])
+        (slot,) = [s for s in sched.ledger.slots.values()
+                   if s.state == RESUMING]
+        assert resumes and resumes[0]["rank"] == victim
+        # revoke actuated before the restore started
+        assert grants[-1][1:3] == (SERVE, 0)
+        # the rank's new life joins with a newer incarnation
+        coord.handle(victim, MessageCode.CoordJoin,
+                     encode_join(KIND_SHARD, victim + 1))
+        now[0] += 0.5
+        sched.tick(now[0])
+        assert slot.state == HELD and slot.owners == [TRAIN]
+        assert slot.parked is None
+        assert sched.resumes_done == 1 and len(sched.resume_mttrs) == 1
+        assert sched.ledger.audit() == []
+    finally:
+        _close(world)
+
+
+def test_resume_timeout_falls_back_to_parked_not_lost():
+    coord, sched, now, grants, world = _harness(resume_timeout=5.0)
+    try:
+        victim, _ = _park_victim(coord, sched, now)
+        sched.registry.set_demand(SERVE, 0)
+        sched.tick(now[0])
+        now[0] += 6.0  # no rejoin arrives
+        sched.tick(now[0])
+        (slot,) = [s for s in sched.ledger.slots.values()
+                   if s.state == PARKED]
+        assert slot.parked["rank"] == victim  # restore ticket survives
+        assert sched.resumes_done == 0
+        assert any("ABANDONED" in d for d in sched.decisions)
+    finally:
+        _close(world)
+
+
+def test_decisions_ride_a_capped_ring_with_tenant_ids():
+    coord, sched, now, grants, world = _harness()
+    try:
+        for i in range(600):
+            sched._log(SERVE, f"decision {i}")
+        assert sched.decisions.total == 600
+        assert len(sched.decisions) == 512
+        assert sched.decisions.dropped == 88
+        assert all(d.startswith(f"tenant {SERVE}:")
+                   for d in sched.decisions)
+        summary = sched.summary()
+        assert summary["decisions_total"] == 600
+        assert summary["decisions_dropped"] == 88
+    finally:
+        _close(world)
+
+
+def test_require_manifest_gates_the_preempt_behind_the_barrier():
+    coord, sched, now, grants, world = _harness(require_manifest=True)
+    try:
+        sched.registry.set_demand(SERVE, 1)
+        sched.tick(now[0])
+        p = sched._pending
+        assert p is not None and p["snap_requested"] and not p["sent"]
+        sched.tick(now[0])
+        assert not sched._pending["sent"]  # barrier still in flight
+        # the barrier lands: a manifest is durable now
+        coord.manifests_written += 1
+        coord.last_manifest = type("M", (), {"snapshot_id": 7})()
+        sched.tick(now[0])
+        assert sched._pending["sent"] and sched._pending["snap_id"] == 7
+        assert any("snapshot 7" in d for d in sched.decisions)
+    finally:
+        _close(world)
+
+
+# ------------------------------------------------------ autoscale actuation
+
+class _FakeMember:
+    def __init__(self, engine_id):
+        self.engine_id = engine_id
+        self.last_beat = 0.0
+        self.busy = 0
+        self.queued = 0
+        self.stopped = False
+
+    def start(self):
+        pass
+
+    def stop(self):
+        self.stopped = True
+
+    def pressure(self):
+        return self.busy, 1, self.queued
+
+
+class _FakeRouter:
+    def __init__(self, members=()):
+        self.members = {m.engine_id: m for m in members}
+
+    def add_member(self, member):
+        self.members[member.engine_id] = member
+
+    def remove_member(self, engine_id):
+        return self.members.pop(engine_id, None)
+
+
+def test_autoscaler_spawns_retires_and_refuses_at_bounds():
+    now = [10.0]
+    m0 = _FakeMember(0)
+    router = _FakeRouter([m0])
+    next_eid = [1]
+
+    def factory():
+        m = _FakeMember(next_eid[0])
+        next_eid[0] += 1
+        return m
+
+    auto = FleetAutoscaler(router, factory, min_engines=1, max_engines=2,
+                           clock=lambda: now[0])
+    auto.on_scale("up", {})
+    assert auto.quiesce() and auto.scaled_up == 1
+    assert set(router.members) == {0, 1}
+    auto.on_scale("up", {})  # at max_engines
+    assert auto.quiesce() and auto.refused == 1 and len(router.members) == 2
+    # MTTR closes at the first poll after the replica beats
+    auto.poll()
+    assert auto.scale_up_mttr_s == []
+    router.members[1].last_beat = 12.5
+    auto.poll()
+    assert auto.scale_up_mttr_s == [pytest.approx(2.5)]
+    # down retires the EMPTIEST replica
+    m0.busy = 3
+    auto.on_scale("down", {})
+    assert auto.quiesce() and auto.scaled_down == 1
+    assert set(router.members) == {0}
+    auto.on_scale("down", {})  # at min_engines
+    assert auto.quiesce() and auto.refused == 2 and set(router.members) == {0}
+    s = auto.summary()
+    assert s["scaled_up"] == 1 and s["scaled_down"] == 1 and s["refused"] == 2
+
+
+def test_engine_scaling_advice_actually_spawns_a_replica():
+    """The closed loop: an overloaded engine's renewal -> the
+    coordinator's advisory -> FleetAutoscaler spawns a new member."""
+    now = [0.0]
+    world = InProcessTransport.create_world(2)
+    coord = Coordinator(world[0], 8, lease=60.0, speculation=False,
+                        engine_occ_high=0.85, scale_cooldown=1.0,
+                        clock=lambda: now[0])
+    try:
+        router = _FakeRouter([_FakeMember(0)])
+        auto = FleetAutoscaler(router, lambda: _FakeMember(1),
+                               min_engines=1, max_engines=4,
+                               clock=lambda: now[0])
+        coord.on_scale = auto.on_scale
+        coord.handle(1, MessageCode.CoordJoin, encode_join(KIND_ENGINE, 1))
+        coord.handle(1, MessageCode.LeaseRenew,
+                     encode_renew(1, push_count=95, step=4, ewma_ms=40.0))
+        assert coord.check_engine_scaling(now[0]) == "up"
+        assert auto.quiesce() and auto.scaled_up == 1
+        assert set(router.members) == {0, 1}
+        # cooldown rate-limits the next advisory
+        assert coord.check_engine_scaling(now[0]) is None
+    finally:
+        for t in world.values():
+            t.close()
+
+
+# -------------------------------------------------------------- the drill
+
+@pytest.mark.drill
+@pytest.mark.chaos
+def test_sched_drill_preempt_resume_bit_identical_3x(tmp_path):
+    """The acceptance drill, three times over: peak demand preempts a
+    LIVE training shard mid-run under seeded wire chaos, parks it via
+    the FleetManifest, resumes it off-peak with exactly-once WAL
+    replay — and the three runs' chaos logs are byte-identical, so the
+    whole preempt/resume protocol is deterministic under the plan."""
+    chaos_logs = []
+    for rep in range(3):
+        out = drill.sched_drill(base_dir=str(tmp_path / f"rep{rep}"),
+                                seed=0, plan=drill.default_drill_plan(0))
+        assert out["ok"], (out["violations"], out["errors"],
+                           out["stuck_workers"])
+        assert out["violations"] == [] and out["errors"] == []
+        s = out["sched"]
+        assert s["preempts_done"] == 1 and s["resumes_done"] == 1
+        assert s["audit"] == []
+        # the park window produced WAL-only deltas and the restore
+        # replayed them exactly once, bit-for-bit
+        assert out["replayed_updates"] > 0
+        assert out["bit_identical"] is True
+        # acked <= applied per (worker, shard): nothing acked was lost,
+        # nothing was double-applied
+        for worker, per_shard in out["acked"].items():
+            for shard, acked in per_shard.items():
+                assert acked <= out["applied"][worker][shard], (
+                    f"worker {worker} shard {shard}: acked {acked} > "
+                    f"applied {out['applied'][worker][shard]}")
+        assert out["chaos_counts"].get("drop", 0) > 0  # chaos really ran
+        chaos_logs.append(out["chaos_lines"])
+    assert chaos_logs[0] == chaos_logs[1] == chaos_logs[2]
