@@ -184,6 +184,20 @@ sched-demo:
 bench-sched:
 	$(PY) bench_all.py --only sched
 
+# control-plane durability suite (ISSUE 17, coord/coordinator.py): the
+# coordinator's own WAL+checkpoint restart, monotonic epoch fencing of
+# every outbound control frame, the restart grace window, the coordfail
+# distmodel plane, and the kill-the-coordinator drill (crash the arbiter
+# mid-snapshot-barrier AND mid-preemption, restart, fleet re-attaches
+# with nobody evicted and the parked member resumed bit-identically)
+coordfail:
+	$(PY) -m pytest tests/ -q -m coordfail
+
+# control-plane durability bench phase: kill-the-coordinator MTTR, durable
+# restore time, and steps/tokens lost to the outage (zero = fail-open held)
+bench-coordfail:
+	$(PY) bench_all.py --only coordfail
+
 # adaptive-wire suite (ISSUE 7): RTT-driven retransmission, window/credit
 # backpressure, circuit breakers, and seeded network weather (latency /
 # jitter / bandwidth caps / one-way degradation) — the training acceptance
@@ -251,4 +265,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-wire-bytes bench-health bench-gate bench-compute bench-mpmd bench-sched timeline chaos coord drill drill-demo fleet health health-demo mpmd mpmd-demo netweather sched sched-demo soak lint distmodel test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-wire-bytes bench-health bench-gate bench-compute bench-mpmd bench-sched bench-coordfail timeline chaos coord coordfail drill drill-demo fleet health health-demo mpmd mpmd-demo netweather sched sched-demo soak lint distmodel test test-all verify-real-data graph install dist

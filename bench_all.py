@@ -1141,6 +1141,52 @@ def recovery_phase() -> None:
         f"{out['replayed_updates']}, chaos {out['chaos_counts']}")
 
 
+def coordfail_phase() -> None:
+    """Config 3, control-plane durability leg (ISSUE 17): the
+    kill-the-COORDINATOR drill — snapshot barrier broadcast, arbiter
+    crashed before the dones land, restarted from its own checkpoint+WAL
+    — priced as control-plane MTTR (kill → every member re-attached to
+    the successor epoch, grace window closed by traffic), the durable
+    restore time (epoch bump + ckpt load + WAL replay), and the
+    steps/tokens the fleet lost to the outage (zero is the claim:
+    workers train fail-open on the last shard map throughout)."""
+    import tempfile
+
+    from distributed_ml_pytorch_tpu.coord.drill import coordfail_drill
+
+    steps, n_workers, batch = 20, 2, 16
+    out = coordfail_drill(
+        base_dir=tempfile.mkdtemp(prefix="bench_coordfail_"), seed=0,
+        steps=steps, kill_during="snapshot")
+    if not out["ok"] or out["mttr_s"] is None:
+        log(f"coordfail_phase incomplete: ok={out['ok']} "
+            f"errors={out['errors']} violations={out['violations']} "
+            f"events={out['events2'][-5:]}")
+        return
+    steps_done = sum(len(l) for l in out["losses"].values())
+    steps_lost = steps * n_workers - steps_done
+    tokens_lost = steps_lost * batch
+    emit(3, "coordfail_mttr", out["mttr_s"] * 1e3, "ms",
+         "in-process fleet, 1 core",
+         "kill the coordinator mid-snapshot-barrier -> restart from its "
+         f"ckpt+WAL (epoch {out['epochs'][0]} -> {out['epochs'][1]}) -> "
+         f"every member re-attached; {out['restored_members']} member(s) "
+         f"restored, {len(out['evictions'])} evicted during the grace "
+         f"window; {steps_lost} of {steps * n_workers} worker steps "
+         f"({tokens_lost} samples) lost to the outage (fail-open); "
+         "2 workers + 2 shards, LeNet, coord/drill.coordfail_drill")
+    emit(3, "coordfail_restore", out["restore_s"] * 1e3, "ms",
+         "in-process fleet, 1 core",
+         "persisted-epoch bump + checkpoint load + control-plane WAL "
+         "replay (member table, map/snapshot clocks, park table, "
+         "scheduler ledger) — the MTTR component the durable "
+         "coordinator owns")
+    log(f"coordfail_phase: mttr {out['mttr_s'] * 1e3:.0f} ms, restore "
+        f"{out['restore_s'] * 1e3:.0f} ms, outage "
+        f"{out['outage_s'] * 1e3:.0f} ms, steps lost {steps_lost}, "
+        f"chaos {out['chaos_counts']}")
+
+
 def _serving_slot_rate() -> tuple:
     """Tokens/s one engine slot serves (a real ``ServingEngine`` burst,
     compile outside the timed window) plus its p50 TTFT — the measured
@@ -2204,6 +2250,7 @@ PHASES = {
     "sharded_ps": lambda: sharded_ps_phase(),
     "elastic": lambda: elastic_phase(),
     "recovery": lambda: recovery_phase(),
+    "coordfail": lambda: coordfail_phase(),
     "sched": lambda: sched_phase(),
     "health": lambda: health_phase(),
     "mpmd": lambda: mpmd_phase(),
@@ -2236,6 +2283,7 @@ def main(argv=None) -> None:
     sharded_ps_phase()
     elastic_phase()
     recovery_phase()
+    coordfail_phase()
     sched_phase()
     health_phase()
     mpmd_phase()

@@ -30,6 +30,13 @@ invariants the seeded acceptance scenarios only sample:
   DECODED norm. Invariants: *quiescent error bound* (quantization error
   is deferred via the residual, never compounded) and *no poison
   applied* (a decoded outlier never reaches the applied sum).
+- **coordfail** — the control plane's own failure protocol (ISSUE 17):
+  coordinator crash/partition mid-epoch with one preemption in flight, a
+  successor restoring from ckpt+WAL, delayed zombie control frames, a
+  blipped member rejoining. Invariants: *map authority monotonic across
+  coordinator lives* (a stale-epoch command never actuates), *no member
+  evicted during the re-attach grace window*, *no parked member
+  stranded and no slot double-granted across restart*.
 
 Exploration is exhaustive breadth-first over SMALL configurations (2
 workers x 2 updates; 2 lives; 3-stage pipeline slice with 2 steps x 2
@@ -41,7 +48,9 @@ exactly what a seeded scenario suite cannot do.
 **Mutations** re-run a model with one protocol guard removed (the
 soundness corpus: ``ack_before_fsync``, ``no_dedup``,
 ``no_seed_on_restore``, ``no_incarnation_gate``, ``watermark_off_by_one``,
-``no_mb_dedup``, ``no_error_feedback``, ``decode_before_admission``); the
+``no_mb_dedup``, ``no_error_feedback``, ``decode_before_admission``,
+``park_without_manifest``, ``double_grant_slot``, ``no_epoch_fence``,
+``expire_on_restart``, ``forget_parked``); the
 checker must find a counterexample for each. Every
 counterexample is emitted as a JSON artifact carrying the event trace, a
 concrete :class:`~.chaos.ChaosPlan` (deterministic windowed fault rules
@@ -761,12 +770,182 @@ class SchedModel(Model):
 
 
 # =====================================================================
+# coordfail — coordinator crash/restore, epoch fencing, grace window
+# =====================================================================
+
+class CoordFailModel(Model):
+    """The control plane's own failure protocol (ISSUE 17,
+    ``coord/coordinator.py``): the coordinator crashes (or is partitioned
+    into a zombie) mid-epoch with one preemption in flight, a successor
+    restores from ckpt+WAL, a blipped member rejoins — bounded
+    exhaustive over every interleaving of bump / preempt / grant /
+    crash / partition / zombie traffic / rejoin / resume.
+
+    State ::
+
+        (life,      # arbiter life: 1 | 2
+         split,     # 1 = life 1 still runs as a ZOMBIE (partition,
+                    #     not death)
+         wepoch,    # member-side: highest coordinator epoch witnessed
+         mver,      # member-side: adopted map version
+         cver,      # authority-side: durable map version
+         zver,      # zombie's private map version (diverged topology)
+         net,       # in-flight control frames: sorted (version, epoch)
+         parked,    # the preemption victim is parked
+         dur_park,  # the durable park table still holds its ticket
+         owners,    # owners of the victim's slot (0 | 1 | 2)
+         resumed,   # the victim resumed
+         grace,     # successor's re-attach grace window is open
+         rejoined,  # the blipped member re-attached to the successor
+         viol)      # sticky violation latch
+
+    The three guards under test, each dropped by one seeded mutation:
+
+    - *epoch fence* — members reject control frames stamped with an
+      epoch below the highest they have witnessed. ``no_epoch_fence``
+      drops it: a partitioned pre-crash coordinator's diverged map is
+      adopted over the successor's — map authority stops being
+      monotonic across lives.
+    - *grace window* — after a restart, lease expiry is suspended until
+      the restored member's join-retry traffic re-attaches it.
+      ``expire_on_restart`` drops it: the successor evicts a perfectly
+      healthy member that merely straddled the control-plane blip.
+    - *durable park table* — the restore replays WAL'd park tickets, so
+      a crash mid-preemption keeps the victim lease-exempt and its slot
+      single-owner. ``forget_parked`` drops it: the victim is stranded
+      (lease re-armed) or its slot is granted twice.
+    """
+
+    name = "coordfail"
+
+    _VMAX = 2  # map-version bumps per life (state-space bound)
+
+    _OK, _ZOMBIE, _EVICTED, _STRANDED, _DOUBLE_GRANT = 0, 1, 2, 3, 4
+
+    def __init__(self, mutation: Optional[str] = None):
+        self.mutation = mutation
+
+    def initial(self):
+        return (1, 0, 0, 0, 0, 0, (), 0, 0, 0, 0, 0, 1, self._OK)
+
+    def successors(self, st):
+        (life, split, wepoch, mver, cver, zver, net, parked, dur_park,
+         owners, resumed, grace, rejoined, viol) = st
+        mut = self.mutation
+        out = []
+
+        def pack(**kw):
+            vals = dict(life=life, split=split, wepoch=wepoch, mver=mver,
+                        cver=cver, zver=zver, net=net, parked=parked,
+                        dur_park=dur_park, owners=owners, resumed=resumed,
+                        grace=grace, rejoined=rejoined, viol=viol)
+            vals.update(kw)
+            return (vals["life"], vals["split"], vals["wepoch"],
+                    vals["mver"], vals["cver"], vals["zver"], vals["net"],
+                    vals["parked"], vals["dur_park"], vals["owners"],
+                    vals["resumed"], vals["grace"], vals["rejoined"],
+                    vals["viol"])
+
+        # the authority WALs a map bump, then broadcasts (epoch-stamped)
+        if cver < self._VMAX:
+            out.append((("bump", cver + 1), pack(
+                cver=cver + 1,
+                net=tuple(sorted(net + ((cver + 1, life),))))))
+        # preempt: the victim parks; the park ticket is WAL'd atomically
+        # (log-then-mutate), freeing its slot
+        if not parked and not resumed:
+            out.append((("preempt",), pack(parked=1, dur_park=1)))
+        # the freed slot is granted to the waiting tenant
+        if parked and owners == 0:
+            out.append((("grant",), pack(owners=1)))
+        # the arbiter dies / is partitioned away; a successor restores
+        # from ckpt+WAL. A partition leaves life 1 running as a zombie
+        # whose topology now diverges from the successor's.
+        if life == 1:
+            restore = dict(
+                life=2, rejoined=0,
+                grace=0 if mut == "expire_on_restart" else 1,
+                dur_park=0 if mut == "forget_parked" else dur_park)
+            out.append((("crash",), pack(**restore)))
+            out.append((("partition",), pack(split=1, zver=cver,
+                                             **restore)))
+        # the zombie keeps rebalancing its (dead) view of the fleet
+        if split and zver < self._VMAX + 1:
+            out.append((("zombie_bump", zver + 1), pack(
+                zver=zver + 1,
+                net=tuple(sorted(net + ((zver + 1, 1),))))))
+        # a member consumes one in-flight control frame
+        for frame in sorted(set(net)):
+            ver, epoch = frame
+            lst = list(net)
+            lst.remove(frame)
+            if wepoch and epoch < wepoch and mut != "no_epoch_fence":
+                # the fence: stale-epoch command dropped before dispatch
+                out.append((("fence", ver, epoch), pack(net=tuple(lst))))
+                continue
+            kw = dict(net=tuple(lst), wepoch=max(wepoch, epoch))
+            if ver > mver:  # the member's own version gate
+                kw["mver"] = ver
+                if wepoch and epoch < wepoch:
+                    # a dead epoch rebalanced the fleet: authority no
+                    # longer monotonic across coordinator lives
+                    kw["viol"] = self._ZOMBIE
+                    kw["wepoch"] = wepoch
+            out.append((("deliver", ver, epoch), pack(**kw)))
+        # the blipped member's join-retry re-attaches it (closes grace)
+        if life == 2 and not rejoined:
+            out.append((("rejoin",), pack(rejoined=1, grace=0)))
+        # lease sweep: with the grace window open this is suspended; a
+        # member evicted while merely straddling the blip is a violation
+        if life == 2 and not grace:
+            if not rejoined:
+                out.append((("expire_blipped",),
+                            pack(viol=self._EVICTED)))
+            if parked and not dur_park:
+                # the park ticket was forgotten: lease expiry re-armed
+                # on a member that is parked, not dead — the strand
+                out.append((("expire_parked",),
+                            pack(viol=self._STRANDED)))
+        # a successor that forgot the park believes the victim still
+        # holds its slot — the next grant double-books it
+        if life == 2 and parked and not dur_park and owners == 1:
+            out.append((("regrant",), pack(owners=2,
+                                           viol=self._DOUBLE_GRANT)))
+        # off-peak: the durable ticket restores the victim exactly once
+        if parked and dur_park:
+            out.append((("resume",), pack(parked=0, dur_park=0,
+                                          owners=0, resumed=1)))
+        return out
+
+    def invariant(self, st):
+        viol = st[-1]
+        if viol == self._ZOMBIE:
+            return ("stale-epoch command adopted: a zombie pre-crash "
+                    "coordinator rebalanced the successor's fleet (map "
+                    "authority not monotonic across coordinator lives)")
+        if viol == self._EVICTED:
+            return ("restored member evicted during the control-plane "
+                    "blip: no grace window suspended lease expiry until "
+                    "its join-retry re-attached it")
+        if viol == self._STRANDED:
+            return ("parked member stranded: the restart forgot the "
+                    "durable park table, so lease expiry re-armed on a "
+                    "member that is parked, not dead")
+        if viol == self._DOUBLE_GRANT:
+            return ("slot double-granted across coordinator restart: the "
+                    "forgotten park ticket let the successor re-grant a "
+                    "slot whose hand-over was already in flight")
+        return None
+
+
+# =====================================================================
 # registry + counterexample emission
 # =====================================================================
 
 MODELS: Dict[str, Callable[..., Model]] = {
     "ps": PSModel, "lease": LeaseModel, "mpmd": MpmdModel,
-    "copt": CompressModel, "sched": SchedModel}
+    "copt": CompressModel, "sched": SchedModel,
+    "coordfail": CoordFailModel}
 
 #: mutation name -> the model it breaks (the soundness corpus)
 MUTATIONS: Dict[str, str] = {
@@ -780,12 +959,15 @@ MUTATIONS: Dict[str, str] = {
     "decode_before_admission": "copt",
     "park_without_manifest": "sched",
     "double_grant_slot": "sched",
+    "no_epoch_fence": "coordfail",
+    "expire_on_restart": "coordfail",
+    "forget_parked": "coordfail",
 }
 
 #: per-model depth the `make distmodel` gate explores to (deep enough to
 #: cover every mutation's counterexample; small enough to stay seconds)
 DEFAULT_DEPTH = {"ps": 12, "lease": 10, "mpmd": 12, "copt": 12,
-                 "sched": 12}
+                 "sched": 12, "coordfail": 10}
 
 
 def _chaos_plan_for(result: Result) -> dict:
@@ -918,11 +1100,17 @@ def counterexample_artifact(result: Result) -> dict:
     violated invariant, the event trace, the derived chaos plan, and the
     crash script (crash/restart positions within the trace)."""
     assert not result.ok and result.trace is not None
-    # ps/mpmd traces script crash/restart positions; sched traces script
-    # the scheduler's own state transitions (the chaos schedule a replay
-    # drives against the real coordinator)
-    ops = (("park", "resume", "grant", "release", "peak", "offpeak")
-           if result.model == "sched" else ("crash", "restart"))
+    # ps/mpmd traces script crash/restart positions; sched/coordfail
+    # traces script the control plane's own state transitions (the chaos
+    # schedule a replay drives against the real coordinator)
+    if result.model == "sched":
+        ops = ("park", "resume", "grant", "release", "peak", "offpeak")
+    elif result.model == "coordfail":
+        ops = ("preempt", "grant", "crash", "partition", "zombie_bump",
+               "rejoin", "resume", "regrant", "expire_blipped",
+               "expire_parked")
+    else:
+        ops = ("crash", "restart")
     script = [
         {"after_event": i, "op": ev[0],
          "rank": 0 if result.model == "ps" else 1}
@@ -1440,6 +1628,197 @@ def _replay_double_grant_slot(ce: dict, workdir: str,
     return violations
 
 
+def _replay_no_epoch_fence(ce: dict, workdir: str,
+                           mutated: bool) -> List[str]:
+    """The zombie-coordinator schedule against the real ``CoordClient``:
+    a successor (epoch 2) ships its map, then a partitioned pre-crash
+    coordinator's diverged high-version map arrives stamped epoch 1.
+    Correct config: the client's epoch fence drops the zombie frame and
+    the successor's next map still lands. Mutated (``epoch_fence=False``):
+    the zombie map is adopted — and the version gate then locks the
+    member onto a dead coordinator's topology forever."""
+    from distributed_ml_pytorch_tpu.coord.member import CoordClient
+    from distributed_ml_pytorch_tpu.coord.shardmap import (
+        ShardEntry,
+        ShardMap,
+    )
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        InProcessTransport,
+        MessageCode,
+        stamp_epoch,
+    )
+
+    world = InProcessTransport.create_world(2)
+    client = CoordClient(world[1], "shard", renew_interval=30.0,
+                         epoch_fence=not mutated)
+
+    def frame(version, epoch):
+        m = ShardMap(version, 8, [ShardEntry(1, 0, 8)])
+        return stamp_epoch(m.encode(), epoch)
+
+    violations = []
+    try:
+        client._handle(MessageCode.ShardMapUpdate, frame(3, 2))
+        client._handle(MessageCode.ShardMapUpdate, frame(9, 1))  # zombie
+        if client.current_map().version == 9:
+            violations.append(
+                "stale-epoch command adopted on the real client: the "
+                "zombie coordinator's map v9 (epoch 1) displaced the "
+                "successor's v3 (epoch 2)")
+        client._handle(MessageCode.ShardMapUpdate, frame(4, 2))
+        if client.current_map().version not in (4, 9):
+            violations.append(
+                "the successor's follow-up map was refused: the member "
+                f"is wedged on v{client.current_map().version}")
+        if not mutated and client.stale_epoch_dropped < 1:
+            violations.append(
+                "clean config never fenced the zombie frame — the epoch "
+                "fence is not wired where the schema promises")
+    finally:
+        client.stop()
+        for t in world.values():
+            t.close()
+    return violations
+
+
+def _replay_expire_on_restart(ce: dict, workdir: str,
+                              mutated: bool) -> List[str]:
+    """The restart-blip schedule against the real durable coordinator: a
+    life-1 coordinator admits two shard members and dies; its successor
+    restores them from ckpt+WAL and the clock jumps past every lease
+    before any join-retry arrives. Correct config: the grace window
+    suspends expiry, the members rejoin and survive. Mutated
+    (``grace=0``): the successor mass-evicts the restored fleet."""
+    from distributed_ml_pytorch_tpu.coord.coordinator import (
+        KIND_SHARD,
+        Coordinator,
+        encode_join,
+    )
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        InProcessTransport,
+        MessageCode,
+    )
+
+    fake_now = [0.0]
+    world = InProcessTransport.create_world(4)
+    violations = []
+    try:
+        coord = Coordinator(world[0], 8, lease=2.0, speculation=False,
+                            clock=lambda: fake_now[0], durable_dir=workdir)
+        for rank in (1, 2):
+            coord.handle(rank, MessageCode.CoordJoin,
+                         encode_join(KIND_SHARD, rank))
+        # the crash; the successor restores and the blip outlives the lease
+        coord2 = Coordinator(world[0], 8, lease=2.0, speculation=False,
+                             clock=lambda: fake_now[0], durable_dir=workdir,
+                             grace=0.0 if mutated else 30.0)
+        fake_now[0] = 3.0  # one lease past the restore, nobody rejoined yet
+        coord2.tick()
+        evicted = {1, 2} - set(coord2.members)
+        if evicted:
+            violations.append(
+                f"restored member(s) {sorted(evicted)} evicted during "
+                "the control-plane blip: lease expiry was not suspended "
+                "for the re-attach grace window")
+        # the join-retry traffic arrives; survivors must re-attach cleanly
+        for rank in (1, 2):
+            coord2.handle(rank, MessageCode.CoordJoin,
+                          encode_join(KIND_SHARD, rank))
+        if not mutated and set(coord2.members) != {1, 2}:
+            violations.append(
+                "clean config did not re-admit the fleet after the blip")
+    finally:
+        for t in world.values():
+            t.close()
+    return violations
+
+
+def _replay_forget_parked(ce: dict, workdir: str,
+                          mutated: bool) -> List[str]:
+    """The crash-mid-preemption schedule against the real coordinator +
+    scheduler: a serving-demand spike parks a live training member
+    (PreemptDone lands, the park ticket is WAL'd), then the coordinator
+    dies. Correct config: the successor replays the durable park table —
+    the victim stays lease-exempt and its slot restores as PARKED with a
+    clean audit. Mutated (``restore_parked=False``): the ticket is
+    forgotten, lease expiry re-arms on the parked member and it is
+    evicted — the strand-forever bug."""
+    from distributed_ml_pytorch_tpu.coord.coordinator import (
+        KIND_SHARD,
+        Coordinator,
+        encode_join,
+        encode_preempt_done,
+    )
+    from distributed_ml_pytorch_tpu.coord.sched import FleetScheduler
+    from distributed_ml_pytorch_tpu.coord.tenants import (
+        TENANT_SERVING,
+        Tenant,
+        TenantRegistry,
+    )
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        InProcessTransport,
+        MessageCode,
+    )
+
+    def registry():
+        reg = TenantRegistry()
+        reg.register(Tenant(1, "train", priority=1, demand=2, min_slots=1))
+        reg.register(Tenant(2, "serve", kind=TENANT_SERVING, priority=5,
+                            demand=0))
+        return reg
+
+    fake_now = [0.0]
+    world = InProcessTransport.create_world(4)
+    violations = []
+    try:
+        coord = Coordinator(world[0], 8, lease=2.0, speculation=False,
+                            clock=lambda: fake_now[0], durable_dir=workdir)
+        sched = FleetScheduler(coord, registry=registry(),
+                               require_manifest=False)
+        for rank in (1, 2):
+            coord.handle(rank, MessageCode.CoordJoin,
+                         encode_join(KIND_SHARD, rank))
+            sched.register_member_slot(rank, 1)
+        sched.registry.set_demand(2, 1)
+        sched.tick(fake_now[0])  # the demand spike: PreemptRequest out
+        pending = sched._pending
+        assert pending is not None, "the preempt never started"
+        victim = pending["slot"].rank
+        coord.handle(victim, MessageCode.PreemptDone,
+                     encode_preempt_done(pending["grant_id"], 0, 4, 8, 17))
+        coord.tick()  # the periodic checkpoint covers the ledger state
+        # the coordinator dies mid-preemption; a successor restores
+        coord2 = Coordinator(world[0], 8, lease=2.0, speculation=False,
+                             clock=lambda: fake_now[0], durable_dir=workdir,
+                             restore_parked=not mutated)
+        sched2 = FleetScheduler(coord2, registry=registry(),
+                                require_manifest=False)
+        coord2.handle(1, MessageCode.CoordJoin, encode_join(KIND_SHARD, 1))
+        fake_now[0] = 50.0  # past every lease AND the grace window
+        coord2.tick()
+        if victim not in coord2.members:
+            violations.append(
+                f"parked member {victim} stranded: the successor forgot "
+                "the durable park table and lease expiry evicted it")
+        if not mutated:
+            # the restore ticket must survive the restart (the slot may
+            # already be RESUMING — off-peak, the successor legitimately
+            # starts the resume — but the ticket itself is the evidence)
+            ticketed = [s for s in sched2.ledger.slots.values()
+                        if s.parked is not None
+                        and s.parked["rank"] == victim]
+            if len(ticketed) != 1:
+                violations.append(
+                    "clean config lost the park ticket across restart — "
+                    "no slot still carries the victim's restore ticket")
+            if sched2.ledger.audit():
+                violations.extend(sched2.ledger.audit())
+    finally:
+        for t in world.values():
+            t.close()
+    return violations
+
+
 _REPLAYS = {
     ("ps", "ack_before_fsync"): _replay_ack_before_fsync,
     ("ps", "no_dedup"): _replay_no_dedup,
@@ -1448,6 +1827,9 @@ _REPLAYS = {
     ("copt", "decode_before_admission"): _replay_decode_before_admission,
     ("sched", "park_without_manifest"): _replay_park_without_manifest,
     ("sched", "double_grant_slot"): _replay_double_grant_slot,
+    ("coordfail", "no_epoch_fence"): _replay_no_epoch_fence,
+    ("coordfail", "expire_on_restart"): _replay_expire_on_restart,
+    ("coordfail", "forget_parked"): _replay_forget_parked,
 }
 
 

@@ -34,6 +34,13 @@ returns the model; :func:`check` cross-checks it against the code:
   its sentinel ``rest_separator``, and some module of the handled plane
   must actually split on it — otherwise pre-evolution frames decode into
   the wrong section.
+- **DC406** — the coord-plane twin of DC402: in a function that records
+  control-plane transitions through the coordinator's durable log
+  (``self._wal_record(...)``), a mutation of the member table, shard
+  placement, snapshot/rollback clocks or the parked-rank ledger ABOVE
+  the first durable-log call applies a transition the restart replay
+  never sees — a crash in between silently forgets a join, an expiry,
+  a map bump or a parked member.
 
 Like DC105/DC107/DC108, the family is opt-in: it stays silent on a
 package whose schema table carries no protocol-model annotations, so the
@@ -524,6 +531,93 @@ def _check_tail_evolution(model: ProtocolModel,
     return findings
 
 
+# --------------------------------------------------------------- DC406
+
+#: the coordinator's durable-state attributes: the member table, the
+#: shard placement, the snapshot/rollback version clocks and the
+#: parked-rank ledger — everything the control-plane WAL exists to make
+#: crash-safe (``coord/coordinator.py``)
+_COORD_DURABLE_ATTRS = ("members", "shard_map", "_snap_seq", "_roll_seq",
+                        "_parked_durable")
+
+
+def _durable_log_calls(fn: ast.AST) -> List[ast.Call]:
+    """``self._wal_record(...)`` calls — the coordinator's one durable-log
+    idiom (the coord-plane analogue of DC402's ``*wal.append`` receiver).
+    Functions without one — the restore/replay paths, ``checkpoint()``
+    itself — are reconstructing state FROM the log and stay unscoped."""
+    out = []
+    for node in walk_list(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "_wal_record" \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            out.append(node)
+    return out
+
+
+def _coord_state_mutations(fn: ast.AST) -> List[Tuple[int, str]]:
+    """(line, attr) for every mutation of a protected coordinator
+    attribute: ``self.<attr> =`` / ``+=``, ``self.<attr>[k] = ...``,
+    ``del self.<attr>[k]``, and the mutating dict-method calls
+    (``pop`` / ``clear`` / ``update`` / ``setdefault``)."""
+    def protected(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" \
+                and node.attr in _COORD_DURABLE_ATTRS:
+            return node.attr
+        return None
+
+    out: List[Tuple[int, str]] = []
+    for node in walk_list(fn):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, ast.Delete):
+            targets = list(node.targets)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("pop", "clear", "update",
+                                       "setdefault"):
+            attr = protected(node.func.value)
+            if attr:
+                out.append((node.lineno, attr))
+            continue
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            attr = protected(t)
+            if attr:
+                out.append((node.lineno, attr))
+    return out
+
+
+def _check_coord_log_then_mutate(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in pkg:
+        for fn in walk_list(src.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            logs = _durable_log_calls(fn)
+            if not logs:
+                continue
+            first = min(call.lineno for call in logs)
+            for line, attr in _coord_state_mutations(fn):
+                if line < first:
+                    findings.append(Finding(
+                        src.path, line, "DC406",
+                        f"coordinator durable state self.{attr} mutated "
+                        f"BEFORE the first _wal_record at line {first} of "
+                        f"{fn.name}() — a crash in between applies a "
+                        "control-plane transition the restart replay "
+                        "never sees (log-then-mutate inverted)"))
+    return findings
+
+
 # --------------------------------------------------------------- entry
 
 def check(pkg: Package) -> List[Finding]:
@@ -535,4 +629,5 @@ def check(pkg: Package) -> List[Finding]:
     findings.extend(_check_fsync_before_ack(pkg))
     findings.extend(_check_incarnation_gate(model, pkg))
     findings.extend(_check_tail_evolution(model, pkg))
+    findings.extend(_check_coord_log_then_mutate(pkg))
     return findings

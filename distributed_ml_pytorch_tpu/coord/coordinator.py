@@ -42,21 +42,30 @@ loop just feeds it a transport and wall time.
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from distributed_ml_pytorch_tpu.coord.shardmap import ShardMap, rebalance
+from distributed_ml_pytorch_tpu.coord.shardmap import (
+    ShardEntry,
+    ShardMap,
+    rebalance,
+)
 from distributed_ml_pytorch_tpu.utils import obs
+from distributed_ml_pytorch_tpu.utils.durability import atomic_write
 from distributed_ml_pytorch_tpu.utils.messaging import (
     MessageCode,
     Transport,
     _join16,
     _split16,
+    stamp_epoch,
 )
+from distributed_ml_pytorch_tpu.utils.wal import WriteAheadLog
 
 _LOGGER = logging.getLogger(__name__)
 
@@ -69,6 +78,25 @@ KIND_AGENT = 4  # node agent: the scheduler's actuator (ISSUE 16)
 _KIND_NAMES = {KIND_WORKER: "worker", KIND_SHARD: "shard",
                KIND_ENGINE: "engine", KIND_STAGE: "stage",
                KIND_AGENT: "agent"}
+
+#: on-disk names inside a coordinator's ``durable_dir`` (ISSUE 17)
+COORD_EPOCH_NAME = "coord_epoch"
+COORD_CKPT_NAME = "coord_ckpt.json"
+COORD_WAL_NAME = "coord.wal"
+
+
+def _op_to_f32(op: dict) -> np.ndarray:
+    """One coordinator WAL record: the JSON transition, space-padded to a
+    whole number of float32 words so it rides ``utils/wal.py``'s existing
+    float32-payload record format byte-exactly (the WAL never converts an
+    already-float32 array, and replay hands the same bytes back)."""
+    raw = json.dumps(op, sort_keys=True).encode("utf-8")
+    raw += b" " * (-len(raw) % 4)
+    return np.frombuffer(raw, np.float32)
+
+
+def _f32_to_op(payload: np.ndarray) -> dict:
+    return json.loads(payload.tobytes().decode("utf-8"))
 
 
 def encode_join(kind: int, incarnation: int) -> np.ndarray:
@@ -285,6 +313,10 @@ class Coordinator:
         rollback_timeout: float = 30.0,
         reputation_nacks: int = 0,
         reputation_cooldown: float = 10.0,
+        durable_dir: Optional[str] = None,
+        grace: Optional[float] = None,
+        restore_parked: bool = True,
+        ckpt_every: int = 16,
     ):
         self.transport = transport
         self.lease = float(lease)
@@ -380,6 +412,38 @@ class Coordinator:
         self._reputation_block: Dict[int, float] = {}  # rank -> until
         self._block_logged: set = set()
         self.revoked_workers = 0
+        # --- control-plane durability + fencing (ISSUE 17) ----------------
+        # With ``durable_dir`` the coordinator is crash-restartable: every
+        # state transition is WAL'd (log-then-mutate) before any broadcast,
+        # a small JSON checkpoint compacts the log, and a restart replays
+        # ckpt+WAL to reconstruct the member table, version clocks and the
+        # durable parked-rank table. A persisted monotonic EPOCH stamps
+        # every outbound frame (``stamp_epoch``) so a zombie pre-crash life
+        # cannot command the fleet after its successor takes over, and the
+        # restart opens a GRACE window (default = one lease) during which
+        # lease expiry and speculation stay suspended while join-retry
+        # traffic re-populates liveness — a control-plane blip must not
+        # cascade into mass eviction.
+        self.durable_dir = durable_dir
+        self.grace = grace
+        self.restore_parked = bool(restore_parked)
+        self.epoch = 1
+        self._wal = None
+        self._wal_seq = 0
+        self._ckpt_seq = 0
+        self._ckpt_every = max(1, int(ckpt_every))
+        self._ckpt_due = False
+        self._ckpt_path: Optional[str] = None
+        #: rank -> restore ticket of every member the SCHEDULER parked,
+        #: maintained through WAL'd park/unpark transitions — the durable
+        #: twin of ``FleetScheduler.parked_ranks()`` that survives a
+        #: coordinator restart (the strand-forever regression, ISSUE 17)
+        self._parked_durable: Dict[int, dict] = {}
+        self._grace_until = 0.0
+        self._grace_pending: set = set()
+        self._sched_restore: Optional[dict] = None
+        self.restored_members = 0
+        self.stale_frames_fenced = 0  # kept for symmetry with CoordClient
         if restore_manifest is not None:
             # disaster recovery: adopt the manifest's shard map + snapshot
             # clock so rebalances and snapshot ids continue, not restart
@@ -390,6 +454,8 @@ class Coordinator:
             self._log(
                 f"restored from manifest: snapshot {self._snap_seq}, "
                 f"shard map v{self.shard_map.version}")
+        if durable_dir is not None:
+            self._init_durable()
 
     # ------------------------------------------------------------ bookkeeping
     def _log(self, msg: str) -> None:
@@ -399,6 +465,274 @@ class Coordinator:
             # same recorder every other plane writes to (ISSUE 12)
             self.recorder.event("coord", corr=0, msg=msg)
         _LOGGER.info("coordinator: %s", msg)
+
+    # ------------------------------------------- durability (ISSUE 17)
+    # distcheck: ignore[DC205] constructor-time restore: _init_durable runs
+    # from __init__ before the serve thread exists; afterwards every write
+    # to these attributes happens on the serve thread only (handle/tick),
+    # the single-threaded-by-design contract in the module docstring
+    def _init_durable(self) -> None:
+        """Open the persisted epoch / checkpoint / WAL and reconstruct any
+        previous life's state (constructor-time; serve thread not yet up)."""
+        os.makedirs(self.durable_dir, exist_ok=True)
+        epoch_path = os.path.join(self.durable_dir, COORD_EPOCH_NAME)
+        prev_epoch = 0
+        try:
+            with open(epoch_path, "r", encoding="utf-8") as f:
+                prev_epoch = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            prev_epoch = 0
+        # the fence: strictly monotonic across lives, durable BEFORE this
+        # life sends its first frame — two coordinators over one durable_dir
+        # are totally ordered and the member side rejects the older epoch
+        self.epoch = prev_epoch + 1
+        atomic_write(epoch_path, str(self.epoch).encode("utf-8"))
+        self._ckpt_path = os.path.join(self.durable_dir, COORD_CKPT_NAME)
+        state = None
+        try:
+            with open(self._ckpt_path, "rb") as f:
+                state = json.loads(f.read().decode("utf-8"))
+        except (OSError, ValueError):
+            state = None
+        self._wal = WriteAheadLog(
+            os.path.join(self.durable_dir, COORD_WAL_NAME),
+            incarnation=self.epoch)
+        records, wal_stats = self._wal.replay()
+        self._restore_durable(state, records)
+        if prev_epoch and wal_stats.get("torn_tail"):
+            self._log("durable restart: dropped one torn WAL tail record "
+                      "(the crash artifact)")
+
+    def _restore_durable(self, state: Optional[dict], records) -> None:
+        now = self._clock()
+        base_seq = 0
+        if state is not None:
+            base_seq = int(state.get("wal_seq", 0))
+            for rank, kind, inc in state.get("members", ()):
+                self.members[int(rank)] = MemberInfo(
+                    int(rank), int(kind), int(inc), now)
+            m = state.get("map")
+            if m is not None:
+                self.shard_map = ShardMap(
+                    int(m["version"]), int(m["n_params"]),
+                    [ShardEntry(*(int(v) for v in e))
+                     for e in m.get("entries", ())])
+            self._snap_seq = int(state.get("snap_seq", self._snap_seq))
+            self._roll_seq = int(state.get("roll_seq", self._roll_seq))
+            self._next_task = int(state.get("next_task", self._next_task))
+            for rank, parked in (state.get("parked") or {}).items():
+                self._parked_durable[int(rank)] = dict(parked)
+            self._sched_restore = state.get("sched") or None
+        for rec in records:
+            if rec.seq <= base_seq:
+                continue  # the checkpoint already covers it (idempotence)
+            self._wal_seq = max(self._wal_seq, int(rec.seq))
+            try:
+                op = _f32_to_op(rec.payload)
+            except (ValueError, UnicodeDecodeError):
+                continue  # unreadable record: the ckpt/earlier ops stand
+            self._apply_wal_op(op, now)
+        self._wal_seq = max(self._wal_seq, base_seq)
+        self._ckpt_seq = self._wal_seq
+        if not self.restore_parked:
+            # the ``forget_parked`` mutation knob (analysis/distmodel.py):
+            # a restart that drops the durable park table re-arms lease
+            # expiry on every parked member — the strand-forever bug
+            self._parked_durable.clear()
+            if self._sched_restore:
+                for slot in self._sched_restore.get("slots", ()):
+                    slot[5] = None
+        if not (self.members or self._parked_durable
+                or self.shard_map.version):
+            return  # first life over an empty dir: nothing to restore
+        self.restored_members = len(self.members)
+        grace = self.lease if self.grace is None else float(self.grace)
+        self._grace_pending = set(self.members) - set(self._parked_durable)
+        if grace > 0 and self._grace_pending:
+            self._grace_until = now + grace
+        if self.last_manifest is None and self.manifest_dir:
+            # adopt the previous life's published manifest, if one survives
+            try:
+                from distributed_ml_pytorch_tpu.coord.manifest import (
+                    FleetManifest,
+                )
+
+                manifest = FleetManifest.load(self.manifest_path())
+                manifest.validate()
+                self.last_manifest = manifest
+            except Exception:
+                pass
+        self._log(
+            f"restarted as epoch {self.epoch}: restored "
+            f"{len(self.members)} member(s), map v{self.shard_map.version}, "
+            f"snapshot clock {self._snap_seq}, "
+            f"{len(self._parked_durable)} parked rank(s)"
+            + (f"; grace window {grace:.1f}s awaiting "
+               f"{sorted(self._grace_pending)}"
+               if self._grace_until else ""))
+
+    # distcheck: ignore[DC205] WAL replay is constructor-time and
+    # single-threaded (called from _restore_durable under __init__); the
+    # live paths apply these same mutations on the serve thread only,
+    # AFTER logging them (the DC406 log-then-mutate rule)
+    def _apply_wal_op(self, op: dict, now: float) -> None:
+        """Replay one journaled transition (restore path; same mutations
+        the live path applies after logging)."""
+        kind = op.get("op")
+        if kind == "join":
+            self.members[int(op["rank"])] = MemberInfo(
+                int(op["rank"]), int(op["kind"]), int(op["inc"]), now)
+        elif kind in ("leave", "expire", "revoke"):
+            self.members.pop(int(op["rank"]), None)
+        elif kind == "map":
+            self.shard_map = ShardMap(
+                int(op["version"]), int(op["n_params"]),
+                [ShardEntry(*(int(v) for v in e))
+                 for e in op.get("entries", ())])
+        elif kind == "snap":
+            self._snap_seq = max(self._snap_seq, int(op["id"]))
+        elif kind == "roll":
+            self._roll_seq = max(self._roll_seq, int(op["id"]))
+        elif kind == "park":
+            self._parked_durable[int(op["rank"])] = dict(op["parked"])
+        elif kind == "unpark":
+            self._parked_durable.pop(int(op["rank"]), None)
+        # "manifest" carries no state beyond the snap clock (the manifest
+        # FILE is the durable artifact; _restore_durable re-reads it)
+
+    def _wal_record(self, **op) -> None:
+        """Journal one control-plane transition BEFORE applying it — the
+        log-then-mutate discipline distcheck DC406 pins on this module. A
+        memory-only coordinator (no ``durable_dir``) skips the write but
+        every call site still orders log-before-mutate."""
+        if self._wal is None:
+            return
+        self._wal_seq += 1
+        self._wal.append(self._wal_seq, _op_to_f32(op))
+        self._wal.sync()
+
+    def checkpoint(self) -> None:
+        """Write the compact JSON checkpoint and truncate the WAL it now
+        covers. Serve-thread only (like every other decision)."""
+        if self._wal is None or self._ckpt_path is None:
+            return
+        sched_state = None
+        if self.sched is not None:
+            sched_state = {
+                "next_grant": int(self.sched._next_grant),
+                "next_slot": int(self.sched.ledger._next_slot),
+                "slots": [
+                    [int(s.slot_id),
+                     None if s.rank is None else int(s.rank),
+                     [int(o) for o in s.owners], s.state, int(s.grant_id),
+                     s.parked]
+                    for s in self.sched.ledger.slots.values()],
+            }
+        state = {
+            "epoch": int(self.epoch),
+            "wal_seq": int(self._wal_seq),
+            "members": [[m.rank, m.kind, m.incarnation]
+                        for m in self._live()],
+            "map": {
+                "version": int(self.shard_map.version),
+                "n_params": int(self.shard_map.n_params),
+                "entries": [[e.server_id, e.lo, e.hi, e.fresh_lo, e.fresh_hi]
+                            for e in self.shard_map.entries],
+            },
+            "snap_seq": int(self._snap_seq),
+            "roll_seq": int(self._roll_seq),
+            "next_task": int(self._next_task),
+            "parked": {str(r): p for r, p in self._parked_durable.items()},
+            "sched": sched_state,
+        }
+        atomic_write(self._ckpt_path,
+                     json.dumps(state, sort_keys=True).encode("utf-8"))
+        self._ckpt_seq = self._wal_seq
+        self._wal.truncate(self._wal_seq)
+
+    def parked_ranks(self) -> set:
+        """Ranks whose silence is a PARK, not a death — derived from the
+        DURABLE park table union the scheduler's in-memory view, so a
+        coordinator restart cannot silently re-arm lease expiry on a
+        parked member (the ISSUE 17 satellite regression)."""
+        parked = set(self._parked_durable)
+        if self.sched is not None:
+            parked |= self.sched.parked_ranks()
+        return parked
+
+    def note_parked(self, rank: int, parked: dict) -> None:
+        """Scheduler hook: journal a park transition (log-then-mutate) and
+        remember it durably; called by ``FleetScheduler.on_preempt_done``
+        BEFORE the ledger mutates."""
+        self._wal_record(op="park", rank=int(rank), parked=dict(parked))
+        self._parked_durable[int(rank)] = dict(parked)
+        self._ckpt_due = True
+
+    def note_unparked(self, rank: int) -> None:
+        """Scheduler hook: the parked rank's new life rejoined — journal
+        the unpark and drop it from the durable table."""
+        self._wal_record(op="unpark", rank=int(rank))
+        self._parked_durable.pop(int(rank), None)
+        self._ckpt_due = True
+
+    def _restore_sched_state(self, sched) -> None:
+        """Re-seed a freshly attached ``FleetScheduler`` from the previous
+        life's checkpointed ledger (called from its constructor), then
+        reconcile slots against the durable park table — a crash between
+        a WAL'd park and the next checkpoint must still restore the slot
+        as PARKED, never double-grant it."""
+        restore = self._sched_restore
+        if restore is not None:
+            from distributed_ml_pytorch_tpu.coord.sched import Slot
+
+            sched._next_grant = int(restore.get("next_grant", 1))
+            sched.ledger._next_slot = int(restore.get("next_slot", 0))
+            for sid, rank, owners, state, grant_id, parked in \
+                    restore.get("slots", ()):
+                slot = Slot(
+                    slot_id=int(sid),
+                    rank=None if rank is None else int(rank),
+                    owners=[int(o) for o in owners], state=str(state),
+                    grant_id=int(grant_id),
+                    parked=None if parked is None else dict(parked))
+                sched.ledger.slots[slot.slot_id] = slot
+            self._sched_restore = None
+        for slot in sched.ledger.slots.values():
+            durable = self._parked_durable.get(slot.rank)
+            if durable is not None and slot.parked is None:
+                from distributed_ml_pytorch_tpu.coord.sched import PARKED
+
+                slot.parked = dict(durable)
+                slot.state = PARKED
+                self._log(
+                    f"restore: slot {slot.slot_id} reconciled to PARKED "
+                    f"from the durable park table (rank {slot.rank})")
+        # a crash between a WAL'd park and the next checkpoint leaves the
+        # parked rank with NO slot at all (the ledger snapshot predates
+        # the preemption, or never happened) — resynthesize it from the
+        # ticket: owned by the borrower under its original grant, so the
+        # tenant that took the capacity keeps it (no double-grant) and
+        # releasing it drives the resume (no stranded member)
+        known = {s.rank for s in sched.ledger.slots.values()}
+        for rank, durable in sorted(self._parked_durable.items()):
+            if rank in known:
+                continue
+            from distributed_ml_pytorch_tpu.coord.sched import PARKED, Slot
+
+            sid = int(durable.get("slot_id", sched.ledger._next_slot))
+            gid = int(durable.get("grant_id", 0))
+            borrower = durable.get("borrower")
+            slot = Slot(
+                slot_id=sid, rank=int(rank),
+                owners=[] if borrower is None else [int(borrower)],
+                state=PARKED, grant_id=gid, parked=dict(durable))
+            sched.ledger.slots[sid] = slot
+            sched.ledger._next_slot = max(sched.ledger._next_slot, sid + 1)
+            sched._next_grant = max(sched._next_grant, gid + 1)
+            self._log(
+                f"restore: slot {sid} RESYNTHESIZED from the WAL'd park "
+                f"ticket (rank {rank}, borrower {borrower}, grant {gid}) "
+                f"— no checkpoint covered this preemption")
 
     def _live(self, kind: Optional[int] = None) -> List[MemberInfo]:
         out = [m for m in self.members.values()
@@ -446,10 +780,11 @@ class Coordinator:
         wire_open) — the coordinator-side read of ISSUE 7's circuit state."""
         return {m.rank: m.wire_open for m in self._live()}
 
-    # distcheck: ignore[DC205] membership decisions are single-threaded by
-    # design (handle/tick run on the serve thread only — module docstring);
-    # engine_up is an advisory GIL-atomic dict snapshot for the serving
-    # fleet hook, and a one-poll-stale answer is within its contract
+    # membership decisions are single-threaded by design (handle/tick run
+    # on the serve thread only — module docstring); engine_up is an
+    # advisory GIL-atomic dict snapshot for the serving fleet hook, and a
+    # one-poll-stale answer is within its contract. The DC205 anchor for
+    # these attributes now sits on _init_durable/_apply_wal_op above.
     def engine_up(self) -> bool:
         return bool(self._live(KIND_ENGINE))
 
@@ -460,11 +795,16 @@ class Coordinator:
 
     # --------------------------------------------------------------- sends
     def _send(self, rank: int, code: MessageCode, payload: np.ndarray) -> None:
-        """One guarded send: a dead member must never take the hub down."""
+        """One guarded send: a dead member must never take the hub down.
+        Every outbound frame carries this life's epoch fence trailer
+        (ISSUE 17) — the ONE stamping point, mirrored by the one stripping
+        point in ``CoordClient._handle`` — so a zombie pre-crash life's
+        delayed commands are rejected fleet-wide once a successor speaks."""
         if self.transport is None:
             return
         try:
-            self.transport.send(code, payload, dst=rank)
+            self.transport.send(code, stamp_epoch(payload, self.epoch),
+                                dst=rank)
         except (OSError, ConnectionError, KeyError):
             pass  # its lease will expire; the tick path owns the cleanup
 
@@ -499,6 +839,9 @@ class Coordinator:
         """Process one member frame (the run loop's dispatch; synchronous
         and side-effect-complete, so tests call it directly)."""
         now = self._clock()
+        # any frame from a restored member counts as re-attachment: the
+        # grace window (ISSUE 17) closes early once everyone is back
+        self._grace_pending.discard(sender)
         member = self.members.get(sender)
         if code == MessageCode.CoordJoin and payload.size >= 3:
             if not np.isfinite(payload[:3]).all():
@@ -530,6 +873,7 @@ class Coordinator:
             is_new = member is None or member.incarnation != inc
             rebirth = member is not None and inc > member.incarnation
             if is_new:
+                self._wal_record(op="join", rank=sender, kind=kind, inc=inc)
                 self.members[sender] = MemberInfo(sender, kind, inc, now)
                 # a new life's bad_loss counter restarts at 0, so the
                 # watchdog's consumed-evidence high-water mark must
@@ -575,6 +919,7 @@ class Coordinator:
                 self._log(f"ignored stale leave of rank {sender} "
                           f"(inc {inc} != {member.incarnation})")
                 return
+            self._wal_record(op="leave", rank=sender)
             del self.members[sender]
             if member.kind == KIND_WORKER:
                 self.done_workers.add(sender)
@@ -677,16 +1022,34 @@ class Coordinator:
         """Expire leases, rebalance, and (maybe) speculate; returns True if
         membership changed. Call at ~lease/4 cadence (the run loop does)."""
         now = self._clock()
+        # --- restart grace window (ISSUE 17): while it holds, restored
+        # members are presumed alive — expiring them on restart-time
+        # silence would cascade a control-plane blip into mass eviction
+        in_grace = bool(self._grace_until)
+        if in_grace:
+            if not self._grace_pending:
+                self._log("grace window closed early: every restored "
+                          "member re-attached")
+                self._grace_until = 0.0
+                in_grace = False
+            elif now >= self._grace_until:
+                self._log(
+                    f"grace window over; still silent: "
+                    f"{sorted(self._grace_pending)} — lease expiry re-armed")
+                self._grace_until = 0.0
+                self._grace_pending.clear()
+                in_grace = False
         # a PARKED member (ISSUE 16) stops renewing by design: its silence
         # is the scheduler's doing, and expiring it would rebalance its
-        # range away and make the resume impossible
-        parked = (self.sched.parked_ranks()
-                  if self.sched is not None else set())
-        expired = [m for m in self.members.values()
-                   if now - m.last_seen > self.lease
-                   and m.rank not in parked]
+        # range away and make the resume impossible. Derived from the
+        # DURABLE park table union the scheduler view (ISSUE 17).
+        parked = self.parked_ranks()
+        expired = [] if in_grace else [
+            m for m in self.members.values()
+            if now - m.last_seen > self.lease and m.rank not in parked]
         shard_died = False
         for m in expired:
+            self._wal_record(op="expire", rank=m.rank)
             del self.members[m.rank]
             self.speculated.pop(m.rank, None)
             self._log(f"{m.kind_name} {m.rank} lease expired "
@@ -696,7 +1059,7 @@ class Coordinator:
             self._rebalance("lease expiry")
         elif expired:
             self._announce()
-        if self.speculation:
+        if self.speculation and not in_grace:
             self.check_stragglers()
         self.check_engine_scaling(now)
         # --- multi-tenant scheduler pass (ISSUE 16; serve-thread only) ---
@@ -737,11 +1100,26 @@ class Coordinator:
             self.rollbacks_abandoned += 1
             self._flight_dump(f"rollback{self._roll['id']}-abandoned")
             self._roll = None
+        # --- durable checkpoint cadence (ISSUE 17; serve thread, so every
+        # WAL'd op is already applied by the time it is covered) ----------
+        if self._wal is not None and (
+                self._ckpt_due
+                or self._wal_seq - self._ckpt_seq >= self._ckpt_every):
+            self._ckpt_due = False
+            self.checkpoint()
         return bool(expired)
 
     def _rebalance(self, why: str) -> None:
         live = [m.rank for m in self._live(KIND_SHARD)]
-        self.shard_map = rebalance(self.shard_map, live)
+        new_map = rebalance(self.shard_map, live)
+        # log-then-mutate (DC406): the map-version bump is durable BEFORE
+        # the in-memory install and the broadcast below — a restart can
+        # never hand out an older version than a frame already on the wire
+        self._wal_record(
+            op="map", version=new_map.version, n_params=new_map.n_params,
+            entries=[[e.server_id, e.lo, e.hi, e.fresh_lo, e.fresh_hi]
+                     for e in new_map.entries])
+        self.shard_map = new_map
         self._log(
             f"shard map v{self.shard_map.version} on {why}: "
             + (", ".join(f"s{e.server_id}=[{e.lo},{e.hi})"
@@ -764,8 +1142,6 @@ class Coordinator:
     def manifest_path(self) -> Optional[str]:
         if not self.manifest_dir:
             return None
-        import os
-
         from distributed_ml_pytorch_tpu.coord.manifest import MANIFEST_NAME
 
         return os.path.join(self.manifest_dir, MANIFEST_NAME)
@@ -780,8 +1156,7 @@ class Coordinator:
         if not shards:
             self._log("snapshot request ignored: no live shard servers")
             return
-        parked = (self.sched.parked_ranks()
-                  if self.sched is not None else set())
+        parked = self.parked_ranks()
         if any(m.rank in parked for m in shards):
             # a parked shard can never answer the barrier, and a manifest
             # missing its range would not be a fleet snapshot — defer
@@ -789,6 +1164,7 @@ class Coordinator:
             self._log("snapshot request deferred: shard(s) "
                       f"{sorted(r for r in parked)} parked by the scheduler")
             return
+        self._wal_record(op="snap", id=self._snap_seq + 1)
         self._snap_seq += 1
         self._snap = {
             "id": self._snap_seq,
@@ -851,10 +1227,10 @@ class Coordinator:
         )
         path = self.manifest_path()
         if path is not None:
-            import os
-
             os.makedirs(self.manifest_dir, exist_ok=True)
             manifest.write(path)
+        self._wal_record(op="manifest", snap_id=int(manifest.snapshot_id),
+                         map_version=int(manifest.map_version))
         self.last_manifest = manifest
         self.manifests_written += 1
         self._log(
@@ -874,6 +1250,7 @@ class Coordinator:
         offenses = member.nacks - member.nack_base
         if offenses < self.reputation_nacks:
             return
+        self._wal_record(op="revoke", rank=member.rank)
         del self.members[member.rank]
         self.speculated.pop(member.rank, None)
         self._reputation_block[member.rank] = now + self.reputation_cooldown
@@ -958,6 +1335,7 @@ class Coordinator:
             self._log(
                 f"snapshot {self._snap['id']} aborted: rollback supersedes")
             self._snap = None
+        self._wal_record(op="roll", id=self._roll_seq + 1)
         self._roll_seq += 1
         self._roll = {
             "id": self._roll_seq,

@@ -825,6 +825,566 @@ def sched_drill(
     }
 
 
+def coordfail_drill(
+    *,
+    base_dir: str,
+    seed: int = 0,
+    steps: int = 20,
+    snapshot_at: Optional[int] = 4,
+    kill_at: Optional[int] = 8,
+    outage_steps: int = 3,
+    verify_at: Optional[int] = None,
+    kill_during: str = "snapshot",
+    n_workers: int = 2,
+    n_shards: int = 2,
+    plan: Optional[ChaosPlan] = None,
+    lease: float = 2.0,
+    grace: Optional[float] = None,
+    lr: float = 0.05,
+    n_push: int = 2,
+    n_pull: int = 2,
+    batch: int = 16,
+    wal_group_n: int = 4,
+    fixture=None,
+    step_sleep: float = 0.05,
+) -> Dict:
+    """Kill the COORDINATOR mid-flight and prove the fleet survives it
+    (ISSUE 17 tentpole acceptance).
+
+    The control plane finally becomes a crashable rank: the coordinator's
+    transport is chaos-wrapped (``FaultyTransport`` sharing the drill's
+    ``ChaosLog``), and worker 1's step script crashes it silently — serve
+    loop dead, members' control frames raising like dead sockets — while
+    the data plane keeps training fail-open on the last shard map.
+
+    ``kill_during="snapshot"`` crashes the hub right after it broadcasts a
+    snapshot barrier (``SnapshotRequest`` in flight, ``SnapshotDone``
+    frames landing on a dead socket); the restarted life must drive a NEW
+    barrier to a published manifest. ``kill_during="preempt"`` spikes a
+    serving tenant first and crashes the hub with one preemption in
+    flight — the victim shard parked (WAL'd park table), its slot granted
+    away — and the restarted life must neither strand the parked member
+    nor double-grant its slot, then resume it when demand drops.
+
+    Restart = a fresh ``Coordinator`` over the same ``durable_dir``:
+    epoch bumped (every outbound frame of the old life is now
+    stale-fenced), member table / map version / scheduler ledger / park
+    table replayed from checkpoint + WAL, and a restart grace window that
+    suspends lease expiry until join-retry traffic re-populates liveness
+    — the drill asserts NO member is evicted across the outage.
+
+    Determinism: chaos rides star 0's pull channel only (the
+    ``sched_drill`` scoping argument) and the coordinator world carries
+    no fault rules — its death is the step-scripted ``crash()``, and
+    sends to a crashed rank raise BEFORE any channel draw or log record,
+    so outage-window retry traffic cannot perturb the log. The
+    acceptance test asserts byte-identical chaos lines 3x.
+
+    Control-plane MTTR = crash → every live member re-attached to the
+    new life (the grace window closed by traffic, not timeout).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ml_pytorch_tpu.parallel.sharded_ps import (
+        ShardedAsynchronous,
+    )
+    from distributed_ml_pytorch_tpu.utils.serialization import (
+        ravel_model_params,
+    )
+
+    assert kill_during in ("snapshot", "preempt"), kill_during
+    with_sched = kill_during == "preempt"
+    if with_sched:
+        assert n_shards >= 2, "preempt variant needs a survivor shard"
+    if verify_at is None and kill_at is not None:
+        verify_at = kill_at + outage_steps + 3
+    if fixture is not None:
+        x, y, grad_fn, params0 = fixture
+    else:
+        x, y, grad_fn, params0 = _default_fixture(seed)
+    flat0 = np.asarray(ravel_model_params(params0), np.float32)
+    n_params = int(flat0.shape[0])
+    victim = n_shards - 1          # the scheduler's _pick_victim order
+    victim_rank = 1 + victim
+
+    TRAIN_ID, SERVE_ID = 1, 2
+
+    log = ChaosLog()
+    the_plan = plan if plan is not None else default_drill_plan(seed)
+    agent_rank = 1 + n_shards + n_workers
+    coord_world = InProcessTransport.create_world(
+        (2 if with_sched else 1) + n_shards + n_workers)
+    # the tentpole wiring: the COORDINATOR is a crashable chaos rank now,
+    # sharing the drill's fault log; members reach it through siblings of
+    # the same wrapper, so its scripted death is a dead socket fleet-wide
+    coord_hub = FaultyTransport(coord_world[0], ChaosPlan(seed=seed),
+                                log=log)
+    coord_star: Dict[int, FaultyTransport] = {0: coord_hub}
+    for r in coord_world:
+        if r != 0:
+            coord_star[r] = coord_hub.sibling(coord_world[r])
+
+    # data-plane stars: chaos scoped to star 0 only (whose shard is never
+    # parked) so the log stays a pure function of the step script
+    star_chaos: List[Dict[int, FaultyTransport]] = []
+    for i in range(n_shards):
+        world = InProcessTransport.create_world(1 + n_workers)
+        hub = FaultyTransport(
+            world[0], the_plan if i == 0 else ChaosPlan(seed=seed), log=log)
+        star = {0: hub}
+        for r in range(1, 1 + n_workers):
+            star[r] = hub.sibling(world[r])
+        star_chaos.append(star)
+
+    def make_server_transport(i: int) -> ReliableTransport:
+        return ReliableTransport(
+            star_chaos[i][0], ack_timeout=0.05, max_backoff=0.25,
+            max_retries=120, unreliable_codes=DRILL_UNRELIABLE,
+            ack_on_delivery=False)
+
+    rel_workers: List[Dict[int, ReliableTransport]] = []
+    for i in range(n_shards):
+        rel_workers.append({
+            j: ReliableTransport(
+                star_chaos[i][j], ack_timeout=0.05, max_backoff=0.25,
+                max_retries=120, unreliable_codes=DRILL_UNRELIABLE)
+            for j in range(1, 1 + n_workers)})
+
+    manifest_path = os.path.join(base_dir, MANIFEST_NAME)
+    coord_dir = os.path.join(base_dir, "coord")
+
+    def make_coordinator() -> Coordinator:
+        return Coordinator(
+            coord_hub, n_params, lease=lease, speculation=False,
+            manifest_dir=base_dir, durable_dir=coord_dir, grace=grace)
+
+    def make_scheduler(c: Coordinator):
+        from distributed_ml_pytorch_tpu.coord.sched import FleetScheduler
+
+        return FleetScheduler(
+            c, registry=registry, require_manifest=True,
+            actuator_rank=agent_rank, preempt_timeout=60.0,
+            resume_timeout=60.0)
+
+    registry = None
+    coord = make_coordinator()
+    life: Dict[str, object] = {"coord": coord}
+    if with_sched:
+        from distributed_ml_pytorch_tpu.coord.tenants import (
+            TENANT_SERVING,
+            TENANT_TRAINING,
+            Tenant,
+            TenantRegistry,
+        )
+
+        registry = TenantRegistry()
+        registry.register(Tenant(TRAIN_ID, "train", kind=TENANT_TRAINING,
+                                 priority=1, demand=n_shards,
+                                 min_slots=n_shards - 1))
+        registry.register(Tenant(SERVE_ID, "serve", kind=TENANT_SERVING,
+                                 priority=5, demand=0))
+        life["sched"] = make_scheduler(coord)
+        for i in range(n_shards):
+            life["sched"].register_member_slot(1 + i, TRAIN_ID)
+    coord_thread = threading.Thread(
+        target=coord.run, kwargs={"timeout": 600}, daemon=True)
+    coord_thread.start()
+    life["thread"] = coord_thread
+
+    def start_server(i: int) -> ElasticShardServer:
+        client = CoordClient(coord_star[1 + i], "shard",
+                             renew_interval=lease / 4)
+        srv = ElasticShardServer(
+            server_id=1 + i, n_params=n_params,
+            transport=make_server_transport(i), coord=client,
+            init_params=flat0, ckpt_dir=os.path.join(base_dir, f"shard{i}"),
+            ckpt_every=0, wal=True, wal_group_n=wal_group_n)
+        t = threading.Thread(target=srv.run, kwargs={"timeout": 600},
+                             daemon=True)
+        t.start()
+        return srv
+
+    servers: List[ElasticShardServer] = [start_server(i)
+                                         for i in range(n_shards)]
+    retired_servers: List[ElasticShardServer] = []
+    _wait_for(lambda: len(coord.shard_map.entries) == n_shards, 60,
+              "all shard servers to join the map")
+
+    # the live ranks that must RE-ATTACH to the restarted life (a parked
+    # victim is durable-park-exempt, not re-attaching)
+    expected_live = set(range(1, 1 + n_shards + n_workers))
+    if with_sched:
+        expected_live.add(agent_rank)
+        expected_live.discard(victim_rank)
+
+    timings: Dict[str, float] = {}
+    losses: Dict[int, list] = {}
+    opts: Dict[int, object] = {}
+    errors: list = []
+    violations: List[str] = []
+    grants: List[tuple] = []
+    member_epochs: Dict[int, int] = {}
+    stale_drops: Dict[int, int] = {}
+    resumed_info = {"replayed": 0, "bit_identical": None}
+    resume_failed = threading.Event()
+    restored_evt = threading.Event()
+    verify_done = threading.Event()
+    hold_evt = threading.Event()
+    release_evt = threading.Event()
+    held = {j: False for j in range(1, 1 + n_workers)}
+    if kill_at is None:
+        restored_evt.set()
+        verify_done.set()
+
+    # --- the agent (preempt variant): grants/resumes land here ----------
+    agent = None
+    agent_stop = threading.Event()
+    if with_sched:
+        resume_jobs: List[tuple] = []
+        resume_ready = threading.Event()
+        agent = CoordClient(coord_star[agent_rank], "agent",
+                            renew_interval=lease / 4)
+
+        def on_slot_grant(grant_id, tenant_id, action, slot_id):
+            grants.append((grant_id, tenant_id, action, slot_id))
+
+        def on_resume(grant_id, rank, snapshot_id):
+            resume_jobs.append((grant_id, rank, snapshot_id))
+            resume_ready.set()
+
+        agent.on_slot_grant = on_slot_grant
+        agent.on_resume = on_resume
+        agent.join(timeout=30)
+
+        def do_resume(grant_id: int, rank: int, snapshot_id: int) -> None:
+            i = rank - 1
+            old = servers[i]
+            try:
+                if snapshot_id <= 0 or not os.path.exists(manifest_path):
+                    raise FileNotFoundError(
+                        f"no manifest for snapshot {snapshot_id}")
+                manifest = FleetManifest.load(manifest_path)
+                detach = getattr(old.transport, "detach", None)
+                if detach is not None:
+                    detach()
+                client = CoordClient(coord_star[1 + i], "shard",
+                                     renew_interval=lease / 4)
+                srv = ElasticShardServer(
+                    server_id=1 + i, n_params=n_params,
+                    transport=make_server_transport(i), coord=client,
+                    init_params=flat0,
+                    ckpt_dir=os.path.join(base_dir, f"shard{i}"),
+                    ckpt_every=0, wal=True, wal_group_n=wal_group_n)
+                srv.restore_from_manifest(manifest)
+                resumed_info["replayed"] += srv.ps.replayed_updates
+                lo, hi = old.lo, old.hi
+                identical = (
+                    np.array_equal(np.asarray(old.ps.central[lo:hi]),
+                                   np.asarray(srv.ps.central[lo:hi]))
+                    and srv.ps._apply_seq == old.ps._apply_seq
+                    and dict(srv.ps.applied_by_sender)
+                    == dict(old.ps.applied_by_sender))
+                resumed_info["bit_identical"] = identical
+                if not identical:
+                    violations.append(
+                        f"resume of rank {rank} not bit-identical across "
+                        f"the coordinator restart")
+                retired_servers.append(old)
+                servers[i] = srv
+                threading.Thread(target=srv.run, kwargs={"timeout": 600},
+                                 daemon=True).start()
+            except Exception as e:  # noqa: BLE001 — the violation IS the result
+                violations.append(
+                    f"resume lost the parked member: rank {rank} ({e!r})")
+                resume_failed.set()
+
+        def agent_loop() -> None:
+            while not agent_stop.is_set():
+                if not resume_ready.wait(0.05):
+                    continue
+                resume_ready.clear()
+                while resume_jobs:
+                    do_resume(*resume_jobs.pop(0))
+
+        agent_thread = threading.Thread(target=agent_loop, daemon=True)
+        agent_thread.start()
+
+    # --- coordinator life management ------------------------------------
+    def kill_coordinator() -> None:
+        # reap the serve loop FIRST (stop() sends nothing — a silent
+        # death), then crash the endpoint so every member's control
+        # frames raise like a dead socket; the tiny stop->crash gap only
+        # queues frames nobody will read
+        life["coord"].stop()
+        life["thread"].join(timeout=30)
+        coord_hub.crash()
+        timings["killed"] = time.monotonic()
+        timings["map_version_at_kill"] = life["coord"].shard_map.version
+
+    def restore_coordinator() -> None:
+        t0 = time.monotonic()
+        coord_hub.restart()
+        c2 = make_coordinator()
+        if with_sched:
+            life["sched2"] = make_scheduler(c2)
+        t = threading.Thread(target=c2.run, kwargs={"timeout": 600},
+                             daemon=True)
+        life["coord2"], life["thread2"] = c2, t
+        t.start()
+        timings["restored"] = time.monotonic()
+        timings["restore_s"] = timings["restored"] - t0
+
+    # MTTR watcher: re-attached = the restarted life's grace window was
+    # closed by join-retry TRAFFIC (grace_pending drained) and every
+    # expected live rank is in its member table
+    def watch_reattach() -> None:
+        while "killed" not in timings:
+            if watch_stop.wait(0.02):
+                return
+        while not watch_stop.is_set():
+            c2 = life.get("coord2")
+            if (c2 is not None and not c2._grace_pending
+                    and expected_live <= set(c2.members)):
+                timings["reattached"] = time.monotonic()
+                return
+            watch_stop.wait(0.02)
+
+    watch_stop = threading.Event()
+    watcher = None
+    if kill_at is not None:
+        watcher = threading.Thread(target=watch_reattach, daemon=True)
+        watcher.start()
+
+    def _follow(j: int) -> None:
+        if hold_evt.is_set() and not release_evt.is_set() and not held[j]:
+            opts[j].hold_shard(1 + victim)
+            held[j] = True
+        if release_evt.is_set() and held[j] and not resume_failed.is_set():
+            opts[j].release_shard(1 + victim)
+            held[j] = False
+
+    def step_hook(j: int, step: int) -> None:
+        time.sleep(step_sleep)
+        sched = life.get("sched")
+        if j != 1:
+            if kill_at is not None and step == verify_at:
+                # the fleet must OUTLIVE the verify window (a finished
+                # worker leaves, and "everyone re-attached" needs everyone)
+                verify_done.wait(300)
+                if with_sched:
+                    release_evt.wait(300)
+            if with_sched:
+                _follow(j)
+            return
+        if not with_sched and snapshot_at is not None and step == snapshot_at:
+            life["coord"].trigger_snapshot()
+            _wait_for(lambda: os.path.exists(manifest_path)
+                      and life["coord"].manifests_written > 0, 60,
+                      "the pre-kill snapshot barrier to publish")
+        if with_sched and kill_at is not None and step == snapshot_at:
+            timings["peak"] = time.monotonic()
+            registry.set_demand(SERVE_ID, 1)
+        if with_sched and sched is not None and snapshot_at < step \
+                and not hold_evt.is_set() and sched.preempts_done > 0:
+            hold_evt.set()
+        if kill_at is not None:
+            if step == kill_at:
+                if with_sched:
+                    # mid-preemption: the victim is parked (its park WAL'd
+                    # by the doomed life), the serving grant outstanding
+                    _wait_for(lambda: sched.preempts_done > 0
+                              or sched.preempts_aborted > 0, 120,
+                              "the preempt to park the victim")
+                    hold_evt.set()
+                    _follow(1)
+                else:
+                    # mid-barrier: SnapshotRequest broadcast, then death —
+                    # every SnapshotDone lands on a dead socket
+                    life["coord"].trigger_snapshot()
+                kill_coordinator()
+            elif step == kill_at + outage_steps:
+                try:
+                    restore_coordinator()
+                finally:
+                    restored_evt.set()
+            elif step == verify_at:
+                try:
+                    _wait_for(lambda: "reattached" in timings, 120,
+                              "the fleet to re-attach to the new life")
+                    if with_sched:
+                        timings["offpeak"] = time.monotonic()
+                        registry.set_demand(SERVE_ID, 0)
+                        _wait_for(
+                            lambda: life["sched2"].resumes_done > 0
+                            or resume_failed.is_set(), 120,
+                            "the restarted life to resume the parked rank")
+                        release_evt.set()
+                    else:
+                        # the restarted life must drive a barrier of its
+                        # OWN to a published manifest
+                        life["coord2"].trigger_snapshot()
+                        _wait_for(
+                            lambda: life["coord2"].manifests_written > 0,
+                            60, "a post-restart snapshot to publish")
+                finally:
+                    verify_done.set()
+                    if with_sched:
+                        release_evt.set()
+        if with_sched:
+            _follow(1)
+
+    def run_worker(j: int) -> None:
+        try:
+            _run_worker(j)
+        except Exception as e:  # noqa: BLE001 — surfaced to the caller
+            errors.append((j, repr(e)))
+            verify_done.set()
+            release_evt.set()
+
+    def _run_worker(j: int) -> None:
+        client = CoordClient(coord_star[n_shards + j], "worker",
+                             renew_interval=lease / 4)
+        m = client.join(timeout=30)
+        assert m is not None and m.entries, "worker never got a shard map"
+        factory = lambda entry: rel_workers[entry.server_id - 1][j]
+        params = jax.tree.map(jnp.asarray, params0)
+        opt = ShardedAsynchronous(
+            params, lr=lr, n_push=n_push, n_pull=n_pull,
+            transports=[factory(e) for e in m.entries],
+            coord=client, transport_factory=factory, shard_map=m)
+        opts[j] = opt
+        rng = jax.random.key(100 + j)
+        my_losses = losses.setdefault(j, [])
+        for step in range(steps):
+            sel = np.random.default_rng(j * 1000 + step).integers(
+                0, len(x), batch)
+            loss, grads = grad_fn(params, x[sel], y[sel],
+                                  jax.random.fold_in(rng, step))
+            params = opt.step(params, grads)
+            my_losses.append(float(loss))
+            step_hook(j, step)
+        opt.finish()
+        member_epochs[n_shards + j] = client.coord_epoch
+        stale_drops[n_shards + j] = client.stale_epoch_dropped
+        client.close()
+
+    worker_threads = [threading.Thread(target=run_worker, args=(j,),
+                                       daemon=True)
+                      for j in range(1, n_workers + 1)]
+    for t in worker_threads:
+        t.start()
+    for t in worker_threads:
+        t.join(timeout=600)
+    stuck = [t for t in worker_threads if t.is_alive()]
+    watch_stop.set()
+    if watcher is not None:
+        watcher.join(timeout=10)
+    if with_sched:
+        agent_stop.set()
+        member_epochs[agent_rank] = agent.coord_epoch
+        stale_drops[agent_rank] = agent.stale_epoch_dropped
+        agent.close()
+    for srv in servers:
+        c = getattr(srv, "coord", None)
+        if isinstance(c, CoordClient):
+            member_epochs[srv.server_id] = c.coord_epoch
+            stale_drops[srv.server_id] = c.stale_epoch_dropped
+        srv.stop()
+    time.sleep(0.05)
+    final = life.get("coord2") or life["coord"]
+    final.stop()
+    for key in ("thread", "thread2"):
+        t = life.get(key)
+        if t is not None:
+            t.join(timeout=30)
+
+    # ---- sequence accounting (unchanged contract: acked <= applied) ----
+    acked: Dict[int, Dict[int, int]] = {}
+    applied: Dict[int, Dict[int, int]] = {}
+    for i in range(n_shards):
+        acked[i] = {j: (rel_workers[i][j].acked_count(
+            0, MessageCode.ShardPush) + rel_workers[i][j].acked_count(
+            0, MessageCode.GradientUpdate) + rel_workers[i][j].acked_count(
+            0, MessageCode.CompressedUpdate))
+            for j in range(1, 1 + n_workers)}
+        applied[i] = {j: servers[i].ps.applied_by_sender.get(j, 0)
+                      for j in range(1, 1 + n_workers)}
+        for j in range(1, 1 + n_workers):
+            if acked[i][j] > applied[i][j]:
+                violations.append(
+                    f"acked delta lost: shard {i} worker {j}: acked "
+                    f"{acked[i][j]} > applied {applied[i][j]}")
+    accounting_ok = not any(v.startswith("acked delta") for v in violations)
+    if with_sched and "sched2" in life:
+        violations.extend(life["sched2"].ledger.audit())
+
+    for star in rel_workers:
+        for t in star.values():
+            t.close()
+    for srv in servers:
+        close = getattr(srv.transport, "close", None)
+        if close is not None:
+            close()
+    for t in coord_world.values():
+        t.close()
+
+    coord2 = life.get("coord2")
+    events2 = list(coord2.events) if coord2 is not None else []
+    evictions = [e for e in list(life["coord"].events) + events2
+                 if "lease expired" in e]
+    mttr = (timings["reattached"] - timings["killed"]
+            if "reattached" in timings and "killed" in timings else None)
+    ok = (not stuck and not errors and not violations and accounting_ok
+          and not evictions)
+    if kill_at is not None:
+        ok = ok and coord2 is not None and mttr is not None \
+            and coord2.epoch == life["coord"].epoch + 1 \
+            and coord2.shard_map.version >= timings["map_version_at_kill"]
+        if with_sched:
+            ok = ok and life["sched2"].resumes_done > 0 \
+                and bool(resumed_info["bit_identical"])
+        else:
+            ok = ok and coord2.manifests_written > 0
+    return {
+        "ok": ok,
+        "errors": errors,
+        "stuck_workers": len(stuck),
+        "violations": violations,
+        "losses": losses,
+        "acked": acked,
+        "applied": applied,
+        "accounting_ok": accounting_ok,
+        "evictions": evictions,
+        "epochs": (life["coord"].epoch,
+                   coord2.epoch if coord2 is not None else None),
+        "map_versions": (timings.get("map_version_at_kill"),
+                         (coord2 or life["coord"]).shard_map.version),
+        "restored_members": (coord2.restored_members
+                             if coord2 is not None else 0),
+        "member_epochs": member_epochs,
+        "stale_epoch_dropped": sum(stale_drops.values()),
+        "manifests_written": (life["coord"].manifests_written,
+                              coord2.manifests_written
+                              if coord2 is not None else None),
+        "grants": grants,
+        "resumes_done": (life["sched2"].resumes_done
+                         if with_sched and "sched2" in life else None),
+        "bit_identical": resumed_info["bit_identical"],
+        "replayed_updates": resumed_info["replayed"],
+        "chaos_lines": log.lines(),
+        "chaos_counts": log.counts(),
+        "events": list(life["coord"].events),
+        "events2": events2,
+        "mttr_s": mttr,
+        "outage_s": (timings["restored"] - timings["killed"]
+                     if "restored" in timings and "killed" in timings
+                     else None),
+        "restore_s": timings.get("restore_s"),
+        "servers": servers,
+    }
+
+
 def sched_demo(seed: int = 0, base_dir: Optional[str] = None) -> Dict:
     """One self-contained scheduler pass (``coord/cli.py --sched-demo``)."""
     import tempfile

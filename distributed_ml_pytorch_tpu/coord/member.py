@@ -54,6 +54,7 @@ from distributed_ml_pytorch_tpu.utils.messaging import (
     Transport,
     _join16,
     _next_incarnation,
+    strip_epoch,
 )
 
 _KINDS = {"worker": KIND_WORKER, "shard": KIND_SHARD, "engine": KIND_ENGINE,
@@ -141,6 +142,7 @@ class CoordClient:
         on_rollback: Optional[Callable[[int, int], None]] = None,
         on_stage_assign: Optional[Callable[[object], None]] = None,
         rollback_hold_ttl: float = 15.0,
+        epoch_fence: bool = True,
     ):
         if kind not in _KINDS:
             raise ValueError(f"kind must be one of {sorted(_KINDS)}, got {kind!r}")
@@ -185,6 +187,15 @@ class CoordClient:
         self.on_slot_grant = None
         self.on_resume = None
         self.rollback_hold_ttl = float(rollback_hold_ttl)
+        #: ISSUE 17 fencing: highest coordinator epoch witnessed so far.
+        #: A frame stamped with a LOWER epoch comes from a zombie pre-crash
+        #: coordinator (or a delayed frame from its life) and is dropped
+        #: before dispatch — it must not rebalance/preempt/roll back a fleet
+        #: the successor already owns. ``epoch_fence=False`` is the
+        #: distmodel ``no_epoch_fence`` mutation knob, never production.
+        self.epoch_fence = bool(epoch_fence)
+        self.coord_epoch = -1
+        self.stale_epoch_dropped = 0
         self._lock = threading.Lock()
         self._latest_map: Optional[ShardMap] = None
         self._current_version = -1
@@ -226,6 +237,18 @@ class CoordClient:
                 continue  # malformed frame: drop, never die
 
     def _handle(self, code: MessageCode, payload: np.ndarray) -> None:
+        # the ONE strip point for the coordinator epoch fence trailer
+        # (ISSUE 17): every stamped control frame passes here — shard,
+        # stage, and engine serve-loops all consume via their CoordClient
+        # callbacks, so rejecting stale epochs HERE fences every command
+        # path (rebalance, preempt, rollback, ...). Unstamped frames are
+        # pre-fencing peers: accepted unchanged.
+        payload, epoch = strip_epoch(payload)
+        if epoch is not None:
+            if self.epoch_fence and epoch < self.coord_epoch:
+                self.stale_epoch_dropped += 1
+                return
+            self.coord_epoch = max(self.coord_epoch, epoch)
         if code == MessageCode.ShardMapUpdate:
             m = ShardMap.decode(payload)
             with self._lock:

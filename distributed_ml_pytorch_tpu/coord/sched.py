@@ -195,6 +195,12 @@ class FleetScheduler:
         self.preempt_mttrs: List[float] = []
         self.resume_mttrs: List[float] = []
         coord.sched = self
+        # a durable coordinator restart (ISSUE 17) re-seeds the ledger from
+        # its checkpoint and reconciles slots against the WAL'd park table
+        # — the scheduler is usually attached AFTER the restore ran
+        if getattr(coord, "_sched_restore", None) is not None \
+                or getattr(coord, "_parked_durable", None):
+            coord._restore_sched_state(self)
 
     # ---------------------------------------------------------- bookkeeping
     def _log(self, tenant_id: int, msg: str) -> None:
@@ -392,7 +398,7 @@ class FleetScheduler:
             return
         slot = p["slot"]
         member = self.coord.members.get(sender)
-        slot.parked = {
+        parked = {
             "rank": sender,
             "tenant": p["victim"],
             "incarnation": member.incarnation if member is not None else 0,
@@ -400,7 +406,21 @@ class FleetScheduler:
             "lo": lo,
             "hi": hi,
             "apply_seq": apply_seq,
+            # the borrowing side of the hand-over, so a coordinator that
+            # crashes between this park and its next checkpoint can
+            # resynthesize the slot — owner, grant and all — from the
+            # WAL'd ticket alone (never strand the victim, never
+            # double-grant its capacity)
+            "slot_id": slot.slot_id,
+            "borrower": p["for"],
+            "grant_id": grant_id,
         }
+        # journal the park BEFORE the ledger mutates (ISSUE 17): a
+        # coordinator crash right after this line must restore the member
+        # as PARKED — never strand it under a re-armed lease or hand its
+        # slot out twice
+        self.coord.note_parked(sender, parked)
+        slot.parked = parked
         self.ledger.release(slot, p["victim"])
         slot.state = PARKED
         mttr = now - p["started"]
@@ -453,7 +473,9 @@ class FleetScheduler:
         parked = slot.parked
         member = self.coord.members.get(parked["rank"])
         if member is not None and member.incarnation > r["incarnation"]:
-            # the rank's new life joined: the park round-tripped
+            # the rank's new life joined: the park round-tripped — journal
+            # the unpark first (log-then-mutate, ISSUE 17)
+            self.coord.note_unparked(parked["rank"])
             tenant_id = parked["tenant"]
             slot.parked = None
             slot.owners = [tenant_id]

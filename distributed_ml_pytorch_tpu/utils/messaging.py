@@ -204,6 +204,13 @@ class PayloadSchema:
       EVOLVED into multiple sections must declare the sentinel separator
       old frames lack (the ``fleet_metrics`` ``-1`` pattern), and some
       handler on the declared plane must actually split on it (DC405).
+    - ``fenced`` — a coordinator-issued COMMAND (ISSUE 17): the sender
+      appends the epoch fence trailer (:func:`stamp_epoch`) and the
+      member side strips it and rejects stale-epoch frames
+      (:func:`strip_epoch` in ``coord/member.CoordClient``), so a zombie
+      pre-crash coordinator cannot rebalance, preempt or roll back the
+      fleet after its successor takes over. A frame WITHOUT the trailer
+      still decodes (pre-ISSUE-17 coordinators are unfenced).
 
     This table is the single source of truth the ``distcheck`` wire
     checker (``analysis/wire.py``) validates send sites, handler guards
@@ -222,6 +229,7 @@ class PayloadSchema:
     delivery: str = "reliable"
     rest_sections: Tuple[str, ...] = ()
     rest_separator: Optional[float] = None
+    fenced: bool = False
 
     def __post_init__(self):
         if self.dedup_key is not None and self.dedup_key not in DEDUP_KEYS:
@@ -331,13 +339,13 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
         fields=("n_entries", "version_lo", "version_hi", "n_params_lo",
                 "n_params_hi"),
         rest="entries", handled_by=("coord",),
-        dedup_key="version",
+        dedup_key="version", fenced=True,
         doc="encoded ShardMap; 9 floats per entry (coord/shardmap.py)"),
     MessageCode.FleetState: PayloadSchema(
         fields=("version_lo", "version_hi", "n_workers", "n_shards",
                 "n_engines", "workers_done"),
         rest="engine_ranks", handled_by=("coord",),
-        dedup_key="version",
+        dedup_key="version", fenced=True,
         rest_sections=("engine_ranks", "fleet_metrics"), rest_separator=-1.0,
         doc="compact fleet broadcast the serving frontend consumes; the "
             "tail lists live engine coord-ranks (per-engine lease health) "
@@ -348,7 +356,7 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
     MessageCode.SpeculateTask: PayloadSchema(
         fields=("task_id", "victim_rank", "from_step"),
         handled_by=("coord",),
-        dedup_key="request_id",
+        dedup_key="request_id", fenced=True,
         doc="coordinator -> backup AND victim; same id for dedup"),
     MessageCode.SpeculativeUpdate: PayloadSchema(
         fields=("task_lo", "task_hi", "ver_lo", "ver_hi", "lo_lo", "lo_hi",
@@ -366,7 +374,7 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
     MessageCode.SnapshotRequest: PayloadSchema(
         fields=("snap_lo", "snap_hi", "map_lo", "map_hi"),
         handled_by=("coord",),
-        dedup_key="request_id",
+        dedup_key="request_id", fenced=True,
         doc="coordinator -> shard servers: checkpoint at your next version "
             "boundary under this snapshot id / shard-map version"),
     MessageCode.SnapshotDone: PayloadSchema(
@@ -422,7 +430,7 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
         fields=("roll_lo", "roll_hi", "snap_lo", "snap_hi", "map_lo",
                 "map_hi", "phase"),
         handled_by=("coord",),
-        dedup_key="request_id",
+        dedup_key="request_id", fenced=True,
         doc="coordinator -> everyone: the auto-rollback barrier (ISSUE 8). "
             "phase 0 = start (shards restore the named FleetManifest "
             "snapshot in place, workers drop in-flight accumulators and "
@@ -469,7 +477,7 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
         fields=("ver_lo", "ver_hi", "n_stages", "n_params_lo",
                 "n_params_hi"),
         rest="entries", handled_by=("coord",),
-        dedup_key="version",
+        dedup_key="version", fenced=True,
         doc="coordinator -> everyone: the versioned StagePlacement "
             "(coord/stages.py; 10 floats per entry: stage, rank, inc "
             "halves, lo/hi halves, watermark halves). Neighbors react to "
@@ -496,7 +504,7 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
     MessageCode.PreemptRequest: PayloadSchema(
         fields=("grant_lo", "grant_hi", "snap_lo", "snap_hi"),
         handled_by=("coord",),
-        dedup_key="request_id",
+        dedup_key="request_id", fenced=True,
         doc="scheduler (via coordinator) -> victim shard member: park "
             "yourself under grant_id; snap_id names the FleetManifest "
             "snapshot the scheduler barriered BEFORE issuing the preempt "
@@ -515,7 +523,7 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
     MessageCode.SlotGrant: PayloadSchema(
         fields=("grant_lo", "grant_hi", "tenant", "action", "slot"),
         handled_by=("coord",),
-        dedup_key="request_id",
+        dedup_key="request_id", fenced=True,
         doc="scheduler -> node agent: actuate a placement decision — "
             "action 1 grants slot to tenant (the agent spawns that "
             "tenant's member kind, e.g. an EngineMember for a serving "
@@ -524,7 +532,7 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
     MessageCode.ResumeRequest: PayloadSchema(
         fields=("grant_lo", "grant_hi", "rank", "snap_lo", "snap_hi"),
         handled_by=("coord",),
-        dedup_key="request_id",
+        dedup_key="request_id", fenced=True,
         doc="scheduler -> node agent: resume the member parked under "
             "grant_id as a fresh life of `rank`, restoring snapshot "
             "snap_id bit-for-bit from the FleetManifest and replaying "
@@ -925,6 +933,39 @@ def _split16(value: int) -> Tuple[float, float]:
 
 def _join16(lo: float, hi: float) -> int:
     return (int(lo) & 0xFFFF) | ((int(hi) & 0xFFFF) << 16)
+
+
+#: the coordinator epoch fence trailer (ISSUE 17): every outbound frame a
+#: coordinator life sends carries ``[FENCE_SEPARATOR, FENCE_MAGIC,
+#: epoch_lo, epoch_hi]`` appended AFTER the schema's payload. A trailer
+#: (not a head field) keeps every existing decoder layout untouched —
+#: rest-bearing frames (ShardMapUpdate entries, FleetState tails) have no
+#: spare head slot, and the member side strips the trailer BEFORE any
+#: decode (``CoordClient._handle``). The separator alone is not enough
+#: (FleetState tails already use -1 sections and payload floats are
+#: arbitrary), so a magic constant no legitimate tail produces guards the
+#: match; a frame without the trailer decodes as pre-ISSUE-17 (unfenced
+#: coordinator — accepted, like the other optional-tail evolutions).
+FENCE_SEPARATOR = -2.0
+FENCE_MAGIC = 91217.0
+
+
+def stamp_epoch(payload: np.ndarray, epoch: int) -> np.ndarray:
+    """Append the coordinator epoch fence trailer to one outbound frame."""
+    return np.concatenate([
+        np.asarray(payload, np.float32),
+        np.asarray([FENCE_SEPARATOR, FENCE_MAGIC, *_split16(int(epoch))],
+                   np.float32)])
+
+
+def strip_epoch(payload: np.ndarray):
+    """Split a frame into ``(body, epoch)``; ``epoch`` is ``None`` for an
+    unstamped (pre-fencing) frame. The inverse of :func:`stamp_epoch`."""
+    if (payload.size >= 4
+            and float(payload[-4]) == FENCE_SEPARATOR
+            and float(payload[-3]) == FENCE_MAGIC):
+        return payload[:-4], _join16(payload[-2], payload[-1])
+    return payload, None
 
 
 _INC_LOCK = threading.Lock()

@@ -142,6 +142,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "coordfail: control-plane durability tests (coord/coordinator.py "
+        "WAL+checkpoint restart, epoch fencing, the restart grace window, "
+        "the coordfail distmodel plane and the kill-the-coordinator drill "
+        "— ISSUE 17); `make coordfail` selects exactly these — fast units "
+        "run in tier-1, the 3x drill acceptance is additionally in "
+        "slow_tests.txt",
+    )
+    config.addinivalue_line(
+        "markers",
         "netweather: adaptive-wire tests under network weather "
         "(utils/chaos.WeatherRule + the RTO/window/breaker machinery in "
         "utils/messaging.ReliableTransport); `make netweather` selects "

@@ -288,6 +288,71 @@ def test_expired_member_readmitted_by_join_retry():
             tr.close()
 
 
+@pytest.mark.coordfail
+def test_member_fails_open_through_long_coordinator_outage():
+    """The ``coord_down`` contract (ISSUE 17): a coordinator outage far
+    longer than the lease must not take the member down with it. The
+    client flags ``coord_down`` on the dead socket, keeps the LAST shard
+    map (training steps on), keeps its renew/rejoin loop alive, and the
+    serving plane keeps admitting — a rollback hold whose completion
+    broadcast died with the coordinator
+    expires via its TTL instead of wedging the frontend. On revival the
+    join retry re-attaches cleanly: ``coord_down`` clears, the member is
+    re-admitted, its range restored."""
+    from distributed_ml_pytorch_tpu.utils.chaos import ChaosPlan, FaultyTransport
+
+    world = InProcessTransport.create_world(2)
+    fw, _ = FaultyTransport.wrap_world(world, ChaosPlan())
+    coord = Coordinator(fw[0], 100, lease=0.3, speculation=False)
+    t = threading.Thread(target=coord.run, kwargs={"timeout": 60},
+                         daemon=True)
+    t.start()
+    client = CoordClient(fw[1], "shard", renew_interval=0.075,
+                         rollback_hold_ttl=0.5)
+    try:
+        m = client.join(timeout=10)
+        assert m is not None and m.entries
+        v0 = client.current_map().version
+        assert client.coord_down is False
+        # a rollback barrier opens... and its completion broadcast will
+        # die with the coordinator — only the TTL can release the hold
+        client.fleet.note_rollback(True, ttl=0.5)
+
+        fw[0].crash()  # the arbiter dies mid-flight: a dead socket now
+        deadline = time.monotonic() + 10
+        while not client.coord_down and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert client.coord_down, "dead socket never flagged"
+        time.sleep(1.2)  # outage = 4x lease: a LONG control-plane blip
+        # fail-open, in every plane: the member still holds the last map
+        # (the data plane keeps stepping on it), still flags the outage,
+        # its renew/rejoin loop is still breathing, and the orphaned
+        # rollback hold has TTL-expired instead of wedging admission
+        assert client.coord_down
+        assert client.current_map() is not None
+        assert client.current_map().version == v0
+        assert not client.fleet.rollback_active()
+
+        fw[0].restart()  # revival: the join retry closes the loop
+        deadline = time.monotonic() + 10
+        while (client.coord_down or 1 not in coord.members) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not client.coord_down, "revival never cleared coord_down"
+        assert 1 in coord.members, "member never re-admitted"
+        deadline = time.monotonic() + 10
+        while coord.shard_map.entry_for(1) is None \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert coord.shard_map.entry_for(1) is not None  # range restored
+    finally:
+        client.close()
+        coord.stop()
+        t.join(timeout=10)
+        for tr in fw.values():
+            tr.close()
+
+
 def test_heartbeat_sender_self_heals_peer_down():
     from distributed_ml_pytorch_tpu.utils.chaos import ChaosPlan, FaultyTransport
     from distributed_ml_pytorch_tpu.utils.failure import HeartbeatSender

@@ -1,0 +1,588 @@
+"""ISSUE 17 — control-plane durability and fencing (coord/coordinator.py).
+
+The coordinator becomes a crash-restartable member of its own fleet:
+
+1. **Durable restart** — every control-plane transition is WAL'd
+   (log-then-mutate, distcheck DC406) and periodically checkpointed;
+   a new life replays ckpt+WAL and reconstructs the member table, the
+   version clocks, and — critically — the durable parked-rank table.
+2. **Epoch fencing** — a persisted monotonic epoch stamps every
+   outbound control frame; members reject stale-epoch commands, so a
+   zombie pre-crash coordinator cannot rebalance, preempt, or roll
+   back the fleet its successor owns.
+3. **Restart grace window** — lease expiry and speculation stay
+   suspended until the join-retry traffic re-populates liveness; a
+   control-plane blip must not cascade into mass eviction.
+
+The drill/model acceptance (kill the coordinator mid-snapshot and
+mid-preemption, bounded-exhaustive `coordfail` plane) lives in
+test_distmodel.py and the slow drill test at the bottom of this file.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.coord.coordinator import (
+    KIND_SHARD,
+    Coordinator,
+    encode_join,
+    encode_leave,
+    encode_preempt_done,
+    encode_preempt_request,
+    encode_renew,
+    encode_rollback_request,
+)
+from distributed_ml_pytorch_tpu.coord.member import CoordClient
+from distributed_ml_pytorch_tpu.coord.sched import (
+    PARKED,
+    FleetScheduler,
+)
+from distributed_ml_pytorch_tpu.coord.shardmap import ShardEntry, ShardMap
+from distributed_ml_pytorch_tpu.coord.tenants import (
+    TENANT_SERVING,
+    Tenant,
+    TenantRegistry,
+)
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    InProcessTransport,
+    MessageCode,
+    stamp_epoch,
+    strip_epoch,
+)
+
+pytestmark = pytest.mark.coordfail
+
+TRAIN, SERVE = 1, 2
+
+
+def _close(world):
+    for t in world.values():
+        t.close()
+
+
+def _registry():
+    reg = TenantRegistry()
+    reg.register(Tenant(tenant_id=TRAIN, name="train", priority=1,
+                        demand=2, min_slots=1))
+    reg.register(Tenant(tenant_id=SERVE, name="serve",
+                        kind=TENANT_SERVING, priority=5, demand=0))
+    return reg
+
+
+def _durable_coord(world, tmp_path, now, *, lease=2.0, **kw):
+    return Coordinator(world[0], 8, lease=lease, speculation=False,
+                       clock=lambda: now[0], durable_dir=str(tmp_path),
+                       **kw)
+
+
+def _attach_sched(coord, *, with_members=True):
+    sched = FleetScheduler(coord, registry=_registry(),
+                           require_manifest=False)
+    if with_members:
+        for rank in (1, 2):
+            coord.handle(rank, MessageCode.CoordJoin,
+                         encode_join(KIND_SHARD, rank))
+            sched.register_member_slot(rank, TRAIN)
+    return sched
+
+
+def _park_victim(coord, sched, now):
+    """Demand spike -> PreemptRequest -> PreemptDone; the victim parks."""
+    sched.registry.set_demand(SERVE, 1)
+    sched.tick(now[0])
+    p = sched._pending
+    assert p is not None
+    victim, gid = p["slot"].rank, p["grant_id"]
+    coord.handle(victim, MessageCode.PreemptDone,
+                 encode_preempt_done(gid, 0, 4, 8, 17))
+    return victim, gid
+
+
+# ------------------------------------- satellite: durable park exemption
+
+@pytest.mark.sched
+def test_restart_preserves_parked_rank_lease_exemption(tmp_path):
+    """THE strand-forever regression (ISSUE 17 satellite 1): a member
+    parked mid-preemption must survive a coordinator crash-restart —
+    before the durable park table, the successor's lease sweep silently
+    evicted it (its exemption lived only in the dead scheduler's RAM)."""
+    now = [0.0]
+    world = InProcessTransport.create_world(4)
+    try:
+        coord = _durable_coord(world, tmp_path, now, lease=2.0)
+        sched = _attach_sched(coord)
+        victim, _ = _park_victim(coord, sched, now)
+        assert victim in coord.members
+
+        # the coordinator crashes; its successor restores from disk
+        coord2 = _durable_coord(world, tmp_path, now, lease=2.0)
+        assert coord2.epoch == coord.epoch + 1
+        assert coord2.parked_ranks() == {victim}
+
+        now[0] += 50.0  # way past every lease AND the grace window
+        coord2.tick()
+        assert victim in coord2.members  # a park, not a death
+        assert 1 not in coord2.members   # the unparked silent rank expired
+    finally:
+        _close(world)
+
+
+@pytest.mark.sched
+def test_restart_reconciles_sched_slot_to_parked(tmp_path):
+    """A successor's freshly attached scheduler re-learns the park from
+    the durable table: the slot comes back PARKED with the restore
+    ticket intact, so the resume path still works and the slot can
+    never be double-granted."""
+    now = [0.0]
+    world = InProcessTransport.create_world(4)
+    try:
+        coord = _durable_coord(world, tmp_path, now)
+        sched = _attach_sched(coord)
+        victim, _ = _park_victim(coord, sched, now)
+        coord.tick()  # checkpoint covers the ledger state too
+
+        coord2 = _durable_coord(world, tmp_path, now)
+        sched2 = FleetScheduler(coord2, registry=_registry(),
+                                require_manifest=False)
+        parked_slots = [s for s in sched2.ledger.slots.values()
+                        if s.state == PARKED]
+        assert len(parked_slots) == 1
+        slot = parked_slots[0]
+        assert slot.rank == victim
+        assert slot.parked["rank"] == victim
+        assert slot.parked["apply_seq"] == 17
+        assert sched2.ledger.audit() == []
+    finally:
+        _close(world)
+
+
+# --------------------------------------------------- durable restart core
+
+def test_restart_restores_members_map_and_epoch(tmp_path):
+    """ckpt+WAL replay reconstructs the member table and version clocks;
+    the persisted epoch is strictly monotonic across lives."""
+    now = [0.0]
+    world = InProcessTransport.create_world(4)
+    try:
+        coord = _durable_coord(world, tmp_path, now)
+        assert coord.epoch == 1  # first life over an empty dir
+        for rank in (1, 2):
+            coord.handle(rank, MessageCode.CoordJoin,
+                         encode_join(KIND_SHARD, rank))
+        v1 = coord.shard_map.version
+        assert v1 > 0
+
+        coord2 = _durable_coord(world, tmp_path, now)
+        assert coord2.epoch == 2
+        assert set(coord2.members) == {1, 2}
+        assert coord2.members[1].incarnation == 1
+        assert coord2.shard_map.version == v1
+        assert [(e.server_id, e.lo, e.hi) for e in coord2.shard_map.entries] \
+            == [(e.server_id, e.lo, e.hi) for e in coord.shard_map.entries]
+        assert coord2.restored_members == 2
+    finally:
+        _close(world)
+
+
+def test_wal_records_after_checkpoint_replay_on_top_of_it(tmp_path):
+    """A checkpoint covers its prefix; ops journaled AFTER it replay on
+    top — the seq gate makes restore idempotent, never double-applied."""
+    now = [0.0]
+    world = InProcessTransport.create_world(4)
+    try:
+        coord = _durable_coord(world, tmp_path, now)
+        for rank in (1, 2):
+            coord.handle(rank, MessageCode.CoordJoin,
+                         encode_join(KIND_SHARD, rank))
+        coord.checkpoint()
+        # post-ckpt: rank 2 leaves (WAL-only — no newer checkpoint)
+        coord.handle(2, MessageCode.CoordLeave, encode_leave(2))
+        assert 2 not in coord.members
+
+        coord2 = _durable_coord(world, tmp_path, now)
+        assert set(coord2.members) == {1}
+    finally:
+        _close(world)
+
+
+def test_first_life_over_empty_dir_has_no_grace_window(tmp_path):
+    now = [0.0]
+    world = InProcessTransport.create_world(2)
+    try:
+        coord = _durable_coord(world, tmp_path, now, lease=2.0)
+        assert coord.restored_members == 0 and coord._grace_until == 0.0
+        coord.handle(1, MessageCode.CoordJoin, encode_join(KIND_SHARD, 1))
+        now[0] += 50.0
+        coord.tick()
+        assert 1 not in coord.members  # normal lease expiry, no grace
+    finally:
+        _close(world)
+
+
+# ------------------------------------------------------ restart grace window
+
+def test_grace_window_suspends_lease_expiry_until_reattach(tmp_path):
+    """A control-plane blip must not cascade into mass eviction: after a
+    restart, restored members are exempt from lease expiry until the
+    grace window ends — members that re-attach inside it survive."""
+    now = [0.0]
+    world = InProcessTransport.create_world(4)
+    try:
+        coord = _durable_coord(world, tmp_path, now, lease=2.0)
+        for rank in (1, 2):
+            coord.handle(rank, MessageCode.CoordJoin,
+                         encode_join(KIND_SHARD, rank))
+
+        coord2 = _durable_coord(world, tmp_path, now, lease=2.0,
+                                grace=10.0)
+        now[0] = 5.0  # past every lease, inside the grace window
+        coord2.tick()
+        assert set(coord2.members) == {1, 2}  # nobody evicted blind
+        # rank 1 re-attaches (the join-retry traffic); rank 2 stays silent
+        coord2.handle(1, MessageCode.CoordJoin, encode_join(KIND_SHARD, 1))
+        now[0] = 11.0
+        coord2.handle(1, MessageCode.LeaseRenew, encode_renew(1))
+        now[0] = 12.0  # grace over: expiry re-armed
+        coord2.tick()
+        assert 1 in coord2.members
+        assert 2 not in coord2.members  # truly-dead member finally expires
+    finally:
+        _close(world)
+
+
+def test_grace_window_closes_early_when_every_member_reattaches(tmp_path):
+    now = [0.0]
+    world = InProcessTransport.create_world(4)
+    try:
+        coord = _durable_coord(world, tmp_path, now, lease=2.0)
+        for rank in (1, 2):
+            coord.handle(rank, MessageCode.CoordJoin,
+                         encode_join(KIND_SHARD, rank))
+
+        coord2 = _durable_coord(world, tmp_path, now, lease=2.0,
+                                grace=100.0)
+        for rank in (1, 2):
+            coord2.handle(rank, MessageCode.CoordJoin,
+                          encode_join(KIND_SHARD, rank))
+        coord2.tick()
+        assert coord2._grace_until == 0.0  # closed early, not at +100 s
+        now[0] = 5.0  # silence past the lease is fatal again
+        coord2.tick()
+        assert coord2.members == {}
+    finally:
+        _close(world)
+
+
+def test_expire_on_restart_knob_disables_the_grace_window(tmp_path):
+    """``grace=0`` is the distmodel ``expire_on_restart`` mutation: the
+    successor evicts every restored member the instant its (unrenewable)
+    lease reads stale — the mass-eviction cascade the window prevents."""
+    now = [0.0]
+    world = InProcessTransport.create_world(4)
+    try:
+        coord = _durable_coord(world, tmp_path, now, lease=2.0)
+        for rank in (1, 2):
+            coord.handle(rank, MessageCode.CoordJoin,
+                         encode_join(KIND_SHARD, rank))
+
+        coord2 = _durable_coord(world, tmp_path, now, lease=2.0, grace=0.0)
+        now[0] = 3.0  # one lease past the restore
+        coord2.tick()
+        assert coord2.members == {}  # everyone evicted before rejoining
+    finally:
+        _close(world)
+
+
+# ---------------------------------------------------------- epoch fencing
+
+def _client(world, rank=1, **kw):
+    return CoordClient(world[rank], "shard", renew_interval=30.0, **kw)
+
+
+def _map_frame(version, epoch):
+    m = ShardMap(version, 8, [ShardEntry(1, 0, 8)])
+    return stamp_epoch(m.encode(), epoch)
+
+
+def test_stale_epoch_rebalance_rejected_on_the_wire():
+    """Command path 1/3 (rebalance): a zombie pre-crash coordinator's
+    ShardMapUpdate — stamped with its old epoch — must not move the
+    member, whatever map version it claims."""
+    world = InProcessTransport.create_world(2)
+    client = _client(world)
+    try:
+        client._handle(MessageCode.ShardMapUpdate, _map_frame(3, epoch=2))
+        assert client.current_map().version == 3
+        assert client.coord_epoch == 2
+        client._handle(MessageCode.ShardMapUpdate, _map_frame(9, epoch=1))
+        assert client.current_map().version == 3  # zombie map refused
+        assert client.stale_epoch_dropped == 1
+        # the live coordinator still advances the member normally
+        client._handle(MessageCode.ShardMapUpdate, _map_frame(4, epoch=2))
+        assert client.current_map().version == 4
+    finally:
+        client.stop()
+        _close(world)
+
+
+def test_stale_epoch_preempt_rejected_on_the_wire():
+    """Command path 2/3 (preempt): a zombie's PreemptRequest must never
+    park a member of the successor's fleet."""
+    world = InProcessTransport.create_world(2)
+    client = _client(world)
+    preempts = []
+    client.on_preempt = lambda gid, snap: preempts.append((gid, snap))
+    try:
+        client._handle(MessageCode.PreemptRequest,
+                       stamp_epoch(encode_preempt_request(7, 3), 2))
+        assert preempts == [(7, 3)]
+        client._handle(MessageCode.PreemptRequest,
+                       stamp_epoch(encode_preempt_request(8, 4), 1))
+        assert preempts == [(7, 3)]  # zombie preempt dropped
+        assert client.stale_epoch_dropped == 1
+    finally:
+        client.stop()
+        _close(world)
+
+
+def test_stale_epoch_rollback_rejected_on_the_wire():
+    """Command path 3/3 (rollback): a zombie's RollbackRequest must not
+    hold admission or roll the data plane back."""
+    world = InProcessTransport.create_world(2)
+    client = _client(world)
+    rollbacks = []
+    client.on_rollback = lambda rid, phase: rollbacks.append((rid, phase))
+    try:
+        client._handle(MessageCode.ShardMapUpdate, _map_frame(1, epoch=2))
+        client._handle(
+            MessageCode.RollbackRequest,
+            stamp_epoch(encode_rollback_request(5, 1, 2, 0), 1))
+        assert rollbacks == []  # zombie barrier dropped
+        assert not client.fleet.rollback_active()
+        assert client.stale_epoch_dropped == 1
+        client._handle(
+            MessageCode.RollbackRequest,
+            stamp_epoch(encode_rollback_request(5, 1, 2, 0), 2))
+        assert rollbacks == [(5, 0)]
+        assert client.fleet.rollback_active()
+    finally:
+        client.stop()
+        _close(world)
+
+
+def test_unstamped_frames_accepted_for_compatibility():
+    """A pre-fencing coordinator's frames carry no epoch trailer and are
+    accepted unchanged — mixed-version fleets keep working."""
+    world = InProcessTransport.create_world(2)
+    client = _client(world)
+    try:
+        m = ShardMap(3, 8, [ShardEntry(1, 0, 8)])
+        client._handle(MessageCode.ShardMapUpdate, m.encode())
+        assert client.current_map().version == 3
+        assert client.coord_epoch == -1  # no epoch ever witnessed
+        assert client.stale_epoch_dropped == 0
+    finally:
+        client.stop()
+        _close(world)
+
+
+def test_no_epoch_fence_knob_lets_the_zombie_wedge_the_member():
+    """The distmodel ``no_epoch_fence`` mutation, on the real client: with
+    the fence off, a zombie's high-version map is adopted — and the live
+    coordinator's NEXT map is then refused by the version gate, wedging
+    the member on a dead coordinator's topology."""
+    world = InProcessTransport.create_world(2)
+    client = _client(world, epoch_fence=False)
+    try:
+        client._handle(MessageCode.ShardMapUpdate, _map_frame(3, epoch=2))
+        client._handle(MessageCode.ShardMapUpdate, _map_frame(9, epoch=1))
+        assert client.current_map().version == 9  # the zombie won
+        client._handle(MessageCode.ShardMapUpdate, _map_frame(4, epoch=2))
+        assert client.current_map().version == 9  # successor locked out
+        assert client.stale_epoch_dropped == 0
+    finally:
+        client.stop()
+        _close(world)
+
+
+def test_coordinator_stamps_every_outbound_frame_with_its_epoch(tmp_path):
+    """The one stamp point: whatever a durable coordinator sends arrives
+    wearing its persisted epoch."""
+    now = [0.0]
+    world = InProcessTransport.create_world(2)
+    try:
+        coord = _durable_coord(world, tmp_path, now)
+        coord.handle(1, MessageCode.CoordJoin, encode_join(KIND_SHARD, 1))
+        seen = []
+        while True:
+            msg = world[1].recv(timeout=0.05)
+            if msg is None:
+                break
+            _sender, code, payload = msg
+            _body, epoch = strip_epoch(payload)
+            seen.append((code, epoch))
+        assert seen, "the join must be answered"
+        assert all(epoch == coord.epoch for _code, epoch in seen), seen
+    finally:
+        _close(world)
+
+
+# ------------------------------ crash mid-preemption, BEFORE a checkpoint
+
+@pytest.mark.sched
+def test_sched_slot_resynthesized_from_wal_park_ticket(tmp_path):
+    """A crash between the WAL'd park and the next checkpoint leaves the
+    successor's scheduler with NO ledger snapshot at all — the slot must
+    be resynthesized from the park ticket alone: PARKED, still owned by
+    the borrowing tenant under its original grant (no double-grant), and
+    releasing it still drives the resume (no stranded member)."""
+    from distributed_ml_pytorch_tpu.coord.sched import RESUMING
+
+    now = [0.0]
+    world = InProcessTransport.create_world(4)
+    try:
+        coord = _durable_coord(world, tmp_path, now, ckpt_every=10_000)
+        sched = _attach_sched(coord)
+        victim, gid = _park_victim(coord, sched, now)
+        # NO coord.tick(): the checkpoint never covers this preemption
+
+        coord2 = _durable_coord(world, tmp_path, now, ckpt_every=10_000)
+        reg2 = _registry()
+        reg2.set_demand(SERVE, 1)  # peak persists across the restart
+        sched2 = FleetScheduler(coord2, registry=reg2,
+                                require_manifest=False)
+        slots = [s for s in sched2.ledger.slots.values()
+                 if s.rank == victim]
+        assert len(slots) == 1, sched2.ledger.slots
+        slot = slots[0]
+        assert slot.state == PARKED
+        assert slot.owners == [SERVE]       # the borrower kept its grant
+        assert slot.grant_id == gid
+        assert slot.parked["rank"] == victim
+        assert sched2.ledger.audit() == []
+
+        # serve demand already satisfied by the resynthesized slot: a
+        # tick must NOT hand the victim's capacity out a second time
+        sched2.tick(now[0])
+        assert [s for s in sched2.ledger.owned(SERVE)] == [slot]
+        assert sched2._pending is None
+
+        # demand drop: the release drives the resume — never a strand
+        reg2.set_demand(SERVE, 0)
+        sched2.tick(now[0])
+        assert slot.state == RESUMING
+        assert sched2._resuming is not None
+        assert sched2._resuming["slot"] is slot
+    finally:
+        _close(world)
+
+
+# ------------------------------------ system: kill-the-coordinator drill
+
+_DRILL_STEPS = 20
+
+
+@pytest.fixture(scope="module")
+def coordfail_fixture():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ml_pytorch_tpu.data import load_cifar10
+    from distributed_ml_pytorch_tpu.models import LeNet
+    from distributed_ml_pytorch_tpu.training.trainer import (
+        cross_entropy_loss,
+    )
+
+    model = LeNet()
+    x, y, *_ = load_cifar10(n_train=256, n_test=32, synthetic=True)
+
+    @jax.jit
+    def grad_fn(p, bx, by, rng):
+        def loss_fn(q):
+            logits = model.apply({"params": q}, bx, train=True,
+                                 rngs={"dropout": rng})
+            return cross_entropy_loss(logits, by)
+
+        return jax.value_and_grad(loss_fn)(p)
+
+    params0 = model.init(
+        jax.random.key(0), jnp.zeros((1, 32, 32, 3)))["params"]
+    return x, y, grad_fn, params0
+
+
+@pytest.mark.drill
+def test_coordfail_drill_snapshot_kill_three_runs_byte_identical(
+        coordfail_fixture, tmp_path, lock_witness):
+    """THE tentpole acceptance, mid-barrier flavor, 3x with identical
+    seeds: the coordinator broadcasts a snapshot barrier and is crashed
+    before the dones land; the fleet trains fail-open through the
+    outage, re-attaches to the restarted epoch with NO member evicted
+    (grace window), the new life drives a barrier of its own to a
+    manifest, every run converges into the fault-free corridor, and the
+    chaos log renders byte-identically run after run."""
+    from distributed_ml_pytorch_tpu.coord.drill import coordfail_drill
+
+    clean = coordfail_drill(
+        base_dir=str(tmp_path / "clean"), seed=7, steps=_DRILL_STEPS,
+        kill_at=None, fixture=coordfail_fixture)
+    assert clean["ok"], (clean["errors"], clean["events"])
+    assert clean["evictions"] == []
+    clean_final = np.mean(
+        [np.mean(l[-4:]) for l in clean["losses"].values()])
+
+    logs, finals = [], []
+    for run in range(3):
+        out = coordfail_drill(
+            base_dir=str(tmp_path / f"run{run}"), seed=7,
+            steps=_DRILL_STEPS, kill_during="snapshot",
+            fixture=coordfail_fixture)
+        assert out["ok"], (out["errors"], out["violations"],
+                           out["events"], out["events2"])
+        assert out["accounting_ok"], (out["acked"], out["applied"])
+        # the restart contract, in one line each:
+        assert out["epochs"] == (1, 2)              # fencing is armed
+        assert out["evictions"] == []               # grace held everyone
+        assert out["restored_members"] >= 2         # ckpt+WAL replayed
+        assert out["map_versions"][1] >= out["map_versions"][0]
+        assert out["manifests_written"][1] > 0      # life 2 barriers work
+        assert out["mttr_s"] is not None and out["mttr_s"] < 60
+        # every live member learned the successor's epoch
+        assert set(out["member_epochs"].values()) == {2}
+        logs.append(out["chaos_lines"])
+        finals.append(np.mean(
+            [np.mean(l[-4:]) for l in out["losses"].values()]))
+    assert logs[0] and logs[0] == logs[1] == logs[2], (
+        "chaos log not byte-identical across coordinator-kill runs")
+    for final in finals:
+        assert abs(final - clean_final) < 0.5, (final, clean_final)
+
+
+@pytest.mark.drill
+@pytest.mark.sched
+def test_coordfail_drill_preempt_kill_never_strands_parked(
+        coordfail_fixture, tmp_path, lock_witness):
+    """THE tentpole acceptance, mid-preemption flavor: the coordinator is
+    crashed with the victim parked and the serving grant outstanding.
+    The restarted life restores the park from the WAL, never re-grants
+    the slot, and when demand drops it resumes the victim bit-identically
+    — the parked member outlives its arbiter."""
+    from distributed_ml_pytorch_tpu.coord.drill import coordfail_drill
+
+    out = coordfail_drill(
+        base_dir=str(tmp_path / "preempt"), seed=7, steps=24,
+        kill_at=10, verify_at=16, kill_during="preempt",
+        fixture=coordfail_fixture)
+    assert out["ok"], (out["errors"], out["violations"],
+                       out["events"], out["events2"])
+    assert out["accounting_ok"], (out["acked"], out["applied"])
+    assert out["violations"] == []
+    assert out["epochs"] == (1, 2)
+    assert out["evictions"] == []
+    assert out["resumes_done"] == 1
+    assert out["bit_identical"] is True
+    assert out["replayed_updates"] > 0
+    # exactly ONE serving grant ever issued (then its revoke) — the
+    # restart did not hand the parked slot out a second time
+    grant_actions = [(g[1], g[2]) for g in out["grants"]]
+    assert grant_actions == [(SERVE, 1), (SERVE, 0)], out["grants"]
+    assert out["mttr_s"] is not None and out["mttr_s"] < 60

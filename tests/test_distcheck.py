@@ -1137,12 +1137,35 @@ _PROTO_SENDERS = """
 """
 
 
+_PROTO_COORD = """
+    class Arbiter:
+        def admit(self, sender, kind, inc):
+            self._wal_record(op="join", rank=sender, kind=kind, inc=inc)
+            self.members[sender] = (kind, inc)
+
+        def expire(self, rank):
+            self._wal_record(op="expire", rank=rank)
+            self.members.pop(rank, None)
+
+        def park(self, rank, ticket):
+            self._wal_record(op="park", rank=rank, parked=ticket)
+            self._parked_durable[rank] = dict(ticket)
+
+        def _apply_wal_op(self, op):
+            # the restore path reconstructs FROM the log and never logs —
+            # it carries no _wal_record call, so DC406 leaves it unscoped
+            if op["op"] == "join":
+                self.members[op["rank"]] = op["inc"]
+"""
+
+
 def _proto_files(**overrides):
     files = {
         "utils/messaging.py": _PROTO_MESSAGING,
         "parallel/server.py": _PROTO_SERVER,
         "coord/hub.py": _PROTO_HUB,
         "parallel/worker.py": _PROTO_SENDERS,
+        "coord/arbiter.py": _PROTO_COORD,
     }
     files.update(overrides)
     return files
@@ -1225,6 +1248,36 @@ def test_dc405_decoder_must_split_on_declared_separator(tmp_path):
     active, _ = _run(tmp_path, broken)
     assert _codes(active) == ["DC405"]
     assert "splits on it" in active[0].message
+
+
+def test_dc406_member_table_mutation_above_durable_log(tmp_path):
+    broken = _proto_files(**{"coord/arbiter.py": _PROTO_COORD.replace(
+        """self._wal_record(op="join", rank=sender, kind=kind, inc=inc)
+            self.members[sender] = (kind, inc)""",
+        """self.members[sender] = (kind, inc)
+            self._wal_record(op="join", rank=sender, kind=kind, inc=inc)""")})
+    active, _ = _run(tmp_path, broken)
+    assert _codes(active) == ["DC406"]
+    assert "the restart replay never sees" in active[0].message
+
+
+def test_dc406_expiry_pop_and_park_ledger_above_durable_log(tmp_path):
+    """Both mutation shapes the coordinator actually uses — the
+    ``members.pop`` eviction and the parked-ledger subscript write — are
+    flagged when hoisted above their log records."""
+    broken = _proto_files(**{"coord/arbiter.py": _PROTO_COORD.replace(
+        """self._wal_record(op="expire", rank=rank)
+            self.members.pop(rank, None)""",
+        """self.members.pop(rank, None)
+            self._wal_record(op="expire", rank=rank)""").replace(
+        """self._wal_record(op="park", rank=rank, parked=ticket)
+            self._parked_durable[rank] = dict(ticket)""",
+        """self._parked_durable[rank] = dict(ticket)
+            self._wal_record(op="park", rank=rank, parked=ticket)""")})
+    active, _ = _run(tmp_path, broken)
+    assert _codes(active) == ["DC406", "DC406"]
+    attrs = sorted(f.message.split()[3] for f in active)
+    assert attrs == ["self._parked_durable", "self.members"]
 
 
 def test_dc4xx_silent_without_protocol_annotations(tmp_path):

@@ -159,7 +159,13 @@ def test_preempt_parks_victim_then_hands_slot_over_exclusively():
         assert slot.parked["rank"] == victim
         assert slot.parked == dict(rank=victim, tenant=TRAIN,
                                    incarnation=victim, snapshot_id=0,
-                                   lo=4, hi=8, apply_seq=17)
+                                   lo=4, hi=8, apply_seq=17,
+                                   # the borrowing side rides the ticket
+                                   # so a coordinator crash between the
+                                   # WAL'd park and the next checkpoint
+                                   # can resynthesize the slot (ISSUE 17)
+                                   slot_id=slot.slot_id, borrower=SERVE,
+                                   grant_id=gid)
         # the grant fired only AFTER PreemptDone freed the slot
         assert grants == [(gid, SERVE, 1, slot.slot_id)]
         assert sched.preempts_done == 1 and len(sched.preempt_mttrs) == 1
