@@ -91,6 +91,12 @@ bench-compute:
 chaos:
 	$(PY) -m pytest tests/ -q -m chaos
 
+# codec-plane suite (utils/codecs.py, ISSUE 18): WIRE_PLANES registry
+# totality over the codec-id-bearing schemas, loss-contract numerics
+# (int8 bound, tok16 exactness, delta-reply identity on the real server)
+codec:
+	$(PY) -m pytest tests/ -q -m codec
+
 # elastic control-plane suite (coord/): membership + leases, coordinator-
 # driven shard rebalancing (the join/crash acceptance scenario), straggler
 # speculation with first-result-wins dedup, serving fleet hook
@@ -265,4 +271,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-wire-bytes bench-health bench-gate bench-compute bench-mpmd bench-sched bench-coordfail timeline chaos coord coordfail drill drill-demo fleet health health-demo mpmd mpmd-demo netweather sched sched-demo soak lint distmodel test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-wire-bytes bench-health bench-gate bench-compute bench-mpmd bench-sched bench-coordfail timeline chaos codec coord coordfail drill drill-demo fleet health health-demo mpmd mpmd-demo netweather sched sched-demo soak lint distmodel test test-all verify-real-data graph install dist

@@ -2241,6 +2241,127 @@ def wire_bytes_phase() -> None:
                  ">= 3x with convergence in the fault-free corridor "
                  "(tests/test_compress.py)")
 
+    # --- ISSUE 18: the codec plane's OTHER hot wires, same discipline —
+    # exact frame arithmetic from the registry, encode AND decode CPU
+    # inside every timed loop. Rows: activations (pipeline codes 30/31),
+    # delta pull replies (the real server's _reply_delta path), and the
+    # serving migration's quantized KV lane.
+    from distributed_ml_pytorch_tpu.utils import codecs
+    from distributed_ml_pytorch_tpu.utils.compress import (
+        CODEC_DENSE,
+        CODEC_INT8,
+    )
+
+    def _codec_ladder(tag, code, x, head_floats, note, iters=20):
+        """Price one plane's dense-vs-int8 rungs: exact bytes/frame and
+        encode+decode frames/s; returns {mode: bytes}."""
+        out = {}
+        for mode, cid in (("dense", CODEC_DENSE), ("int8", CODEC_INT8)):
+            try:
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    got, body = codecs.encode_body(code, x, cid)
+                    codecs.decode_body(code, got, body, x.size)
+                dt = time.perf_counter() - t0
+                nbytes = int((head_floats + body.size) * 4)
+                out[mode] = nbytes
+                emit(7, f"{tag}_wire_bytes_per_frame_{mode}", nbytes,
+                     "bytes", "registry encode_body/decode_body",
+                     f"exact frame bytes ({head_floats}-float head + "
+                     f"body) of one {mode} {code.name} frame of "
+                     f"{x.size} floats; {note}")
+                emit(7, f"{tag}_codec_frames_per_s_{mode}", iters / dt,
+                     "frames/sec", "registry encode_body/decode_body",
+                     f"{mode} encode + decode round trips/s on one core "
+                     f"({iters / dt * nbytes / 1e6:.1f} MB/s on-wire)")
+            except Exception as e:  # noqa: BLE001 — one rung, one row
+                log(f"wire_bytes codec ladder ({tag}/{mode}) failed: {e}")
+        return out
+
+    act = rng.normal(scale=2.0, size=8 * 128 * 256).astype(np.float32)
+    act_bytes = _codec_ladder(
+        "act", MessageCode.ActivationShip, act, 8,
+        "the MPMD corridor acceptance holds the loss trajectory within "
+        "tolerance of the uncompressed pipeline (tests/test_mpmd.py)")
+    if {"dense", "int8"} <= set(act_bytes):
+        emit(7, "act_wire_compression_ratio_int8",
+             act_bytes["dense"] / act_bytes["int8"], "x fewer bytes",
+             "derived", "dense / int8 bytes per activation frame "
+             "(codes 30/31, parallel/mpmd.py); acceptance bar is >= 3x "
+             "with the loss corridor held")
+
+    kv = rng.normal(scale=0.5, size=1024 * 128).astype(np.float32)
+    kv_bytes = _codec_ladder(
+        "kv_migrate", MessageCode.KvMigrate, kv, 9,
+        "the token lane of the same frame rides tok16 (exact), so "
+        "migrated-stream token identity never depends on this rung")
+    if {"dense", "int8"} <= set(kv_bytes):
+        emit(7, "kv_migrate_compression_ratio_int8",
+             kv_bytes["dense"] / kv_bytes["int8"], "x fewer bytes",
+             "derived", "dense / int8 bytes per migrated KV lane "
+             "(serving/fleet.py handoff; kv_quant recipe)")
+
+    # delta pull replies: the REAL server reply path (ParameterRequest
+    # with a held stamp -> _reply_delta -> Listener install), so the
+    # bytes are what the server actually put on the wire
+    world = None
+    try:
+        from distributed_ml_pytorch_tpu.parallel.async_ps import (
+            Listener,
+        )
+        from distributed_ml_pytorch_tpu.utils.messaging import (
+            InProcessTransport,
+        )
+
+        world = InProcessTransport.create_world(2)
+        ps = ParameterServer(
+            params=rng.normal(scale=0.01, size=n).astype(np.float32),
+            transport=world[0])
+        lst = Listener(transport=world[1])
+
+        def delta_pull():
+            before = ps.delta_reply_wire_floats
+            ps.handle(1, MessageCode.ParameterRequest, lst.held_stamp())
+            msg = world[1].recv(timeout=5.0)
+            assert msg is not None
+            lst.receive(msg[0], msg[1], msg[2])
+            return (ps.delta_reply_wire_floats - before) * 4
+
+        full_bytes = delta_pull()  # first pull: full dense install
+        upd = rng.normal(scale=1e-4, size=n).astype(np.float32)
+        n_pulls, delta_bytes, spent = 6, 0, 0.0
+        for _ in range(n_pulls):
+            ps.handle(1, MessageCode.GradientUpdate, upd)
+            t0 = time.perf_counter()
+            delta_bytes = delta_pull()
+            spent += time.perf_counter() - t0
+        emit(7, "pull_reply_bytes_full", full_bytes, "bytes",
+             "in-process, real _reply_delta path",
+             f"exact wire bytes of the full dense fallback install of "
+             f"the {n}-param vector (version miss / restore / rebalance "
+             "path)")
+        emit(7, "pull_reply_bytes_delta_steady", delta_bytes, "bytes",
+             "in-process, real _reply_delta path",
+             "exact wire bytes of one steady-state top-k delta reply "
+             "(server tracks the worker's last-pulled view; "
+             "per-worker error feedback keeps the tracked mirror "
+             "bitwise equal to the installed view)")
+        emit(7, "pull_reply_roundtrips_delta", n_pulls / spent,
+             "pulls/sec", "in-process, real _reply_delta path",
+             f"steady-state delta pulls/s incl. encode + decode + "
+             f"install ({delta_bytes * n_pulls / spent / 1e6:.1f} MB/s "
+             "on-wire)")
+        emit(7, "pull_reply_compression_ratio_delta",
+             full_bytes / max(delta_bytes, 1), "x fewer bytes",
+             "derived", "full / steady-state delta reply bytes "
+             "(parallel/async_ps.py); acceptance bar is >= 4x with "
+             "drill restores bit-exact (full fallback re-fences)")
+    except Exception as e:  # noqa: BLE001 — one ladder, one table leg
+        log(f"wire_bytes pull-reply ladder failed: {e}")
+    finally:
+        for tr in (world or {}).values():
+            tr.close()
+
 
 #: phases addressable via ``--only`` (``make bench-wire`` runs the wire
 #: legs without paying for the full table)

@@ -30,6 +30,13 @@ invariants the seeded acceptance scenarios only sample:
   DECODED norm. Invariants: *quiescent error bound* (quantization error
   is deferred via the residual, never compounded) and *no poison
   applied* (a decoded outlier never reaches the applied sum).
+- **dpull** — the delta-encoded pull-reply plane (ISSUE 18): a server
+  tracking each worker's last-shipped view answers pulls with top-k
+  deltas against that base or a full fallback, replies get lost or
+  delayed across a crash-restore that re-fills the same version numbers
+  with different bytes. Invariant: *stamp-authenticated view* (a worker
+  whose held stamp matches the server's current ``(epoch, ver)`` holds
+  exactly the central bytes).
 - **coordfail** — the control plane's own failure protocol (ISSUE 17):
   coordinator crash/partition mid-epoch with one preemption in flight, a
   successor restoring from ckpt+WAL, delayed zombie control frames, a
@@ -49,6 +56,7 @@ exactly what a seeded scenario suite cannot do.
 soundness corpus: ``ack_before_fsync``, ``no_dedup``,
 ``no_seed_on_restore``, ``no_incarnation_gate``, ``watermark_off_by_one``,
 ``no_mb_dedup``, ``no_error_feedback``, ``decode_before_admission``,
+``stale_delta_base``, ``no_full_fallback_on_restore``,
 ``park_without_manifest``, ``double_grant_slot``, ``no_epoch_fence``,
 ``expire_on_restart``, ``forget_parked``); the
 checker must find a counterexample for each. Every
@@ -651,6 +659,149 @@ class CompressModel(Model):
 
 
 # =====================================================================
+# dpull — delta-encoded pull replies: held-stamp check + restore fence
+# =====================================================================
+
+class DeltaPullModel(Model):
+    """The delta-encoded ``ShardParams`` pull-reply plane (ISSUE 18,
+    ``parallel/async_ps.py``): one worker pulls from one server that
+    tracks the worker's last-shipped view and answers with either a FULL
+    reply or a DELTA against that tracked base. Replies may be lost or
+    arbitrarily delayed; the server may crash-restore, losing its
+    un-fsynced tail and then re-filling the SAME version numbers with
+    DIFFERENT bytes (a life-1 push adds 2 where a life-0 push added 1).
+
+    State ::
+
+        (pushes, pulls, drops, restores,   # remaining event budgets
+         s_epoch,    # server pull epoch (bumped by the restore fence)
+         s_ver,      # server apply version
+         s_central,  # abstract central value
+         life,       # 0 before the crash-restore, 1 after
+         base,       # None | (epoch, ver, val): server's mirror of the
+                     #   worker's view, updated at every reply cut
+         w,          # None | (epoch, ver, val): worker's installed view
+         net)        # in-flight replies, sorted tuple of
+                     #   ("F", epoch, ver, val) |
+                     #   ("D", epoch, base_ver, ver, dval)
+
+    A pull carries the worker's held stamp; the clean server ships a
+    delta only when its tracked base matches BOTH the held stamp and the
+    current epoch, else it falls back to a full reply. The clean worker
+    applies a delta only when its held stamp equals the frame's
+    ``(epoch, base_ver)``. A restore always clears the (in-memory) base
+    table and — this is the fence — bumps the pull epoch so zombie
+    replies cut in the previous life can never be mistaken for current.
+
+    Invariant: *stamp-authenticated view* — a worker whose held stamp
+    equals the server's CURRENT ``(epoch, ver)`` holds exactly
+    ``s_central``. (A stale stamp is allowed to carry stale bytes; the
+    protocol heals it with a full reply on the next pull.)
+
+    Mutations: ``stale_delta_base`` (the server skips the held-stamp
+    check and ships a delta against whatever base it tracks — after a
+    LOST reply advanced the tracked base past the worker, the delta
+    applies onto the wrong base; pairs with the worker trusting the
+    server blindly, the real stack's ``delta_trust``);
+    ``no_full_fallback_on_restore`` (the restore skips the epoch bump,
+    so a zombie delta cut before the crash applies cleanly onto a
+    same-numbered-but-different-bytes post-restore history).
+    """
+
+    name = "dpull"
+
+    def __init__(self, pushes: int = 3, pulls: int = 3, drops: int = 1,
+                 restores: int = 1, mutation: Optional[str] = None):
+        self.pushes = pushes
+        self.pulls = pulls
+        self.drops = drops
+        self.restores = restores
+        self.mutation = mutation
+
+    def initial(self):
+        return (self.pushes, self.pulls, self.drops, self.restores,
+                0, 0, 0, 0, None, None, ())
+
+    def successors(self, st):
+        (pushes, pulls, drops, restores,
+         s_epoch, s_ver, s_central, life, base, w, net) = st
+        mut = self.mutation
+        out = []
+        if pushes > 0:
+            # a life-1 push adds 2 where a life-0 push added 1: the
+            # re-filled history reuses version NUMBERS with new bytes
+            out.append((("push", s_ver + 1), (
+                pushes - 1, pulls, drops, restores, s_epoch, s_ver + 1,
+                s_central + (2 if life else 1), life, base, w, net)))
+        if pulls > 0:
+            held = (w[0], w[1]) if w is not None else None
+            if mut == "stale_delta_base":
+                use_delta = base is not None
+            else:
+                use_delta = (base is not None and held is not None
+                             and held == (base[0], base[1])
+                             and base[0] == s_epoch)
+            if use_delta:
+                frame = ("D", s_epoch, base[1], s_ver,
+                         s_central - base[2])
+                kind = "delta"
+            else:
+                frame = ("F", s_epoch, s_ver, s_central)
+                kind = "full"
+            out.append((("pull", kind, s_ver), (
+                pushes, pulls - 1, drops, restores, s_epoch, s_ver,
+                s_central, life, (s_epoch, s_ver, s_central), w,
+                tuple(sorted(net + (frame,))))))
+        for frame in sorted(set(net)):
+            lst = list(net)
+            lst.remove(frame)
+            rest = tuple(lst)
+            if drops > 0:
+                out.append((("drop_reply", frame[0], frame[2]), (
+                    pushes, pulls, drops - 1, restores, s_epoch, s_ver,
+                    s_central, life, base, w, rest)))
+            if frame[0] == "F":
+                new_w = (frame[1], frame[2], frame[3])
+            else:
+                _, f_epoch, f_base_ver, f_ver, dval = frame
+                trust = (mut == "stale_delta_base")
+                applies = (w is not None
+                           and (trust
+                                or (w[0], w[1]) == (f_epoch, f_base_ver)))
+                if not applies:
+                    # base miss: the frame is discarded, the worker
+                    # keeps its view and will full-sync on a later pull
+                    out.append((("deliver", "miss", f_ver), (
+                        pushes, pulls, drops, restores, s_epoch, s_ver,
+                        s_central, life, base, w, rest)))
+                    continue
+                new_w = (f_epoch, f_ver, w[2] + dval)
+            out.append((("deliver", frame[0], frame[2]), (
+                pushes, pulls, drops, restores, s_epoch, s_ver,
+                s_central, life, base, new_w, rest)))
+        if restores > 0:
+            # crash-restore to the (initial) checkpoint: the in-memory
+            # base table is gone either way; only the FENCE — the epoch
+            # bump that invalidates pre-crash stamps — is the mutation
+            bump = 0 if mut == "no_full_fallback_on_restore" else 1
+            out.append((("restore",), (
+                pushes, pulls, drops, restores - 1, s_epoch + bump, 0,
+                0, 1, None, w, net)))
+        return out
+
+    def invariant(self, st):
+        (_pushes, _pulls, _drops, _restores,
+         s_epoch, s_ver, s_central, _life, _base, w, _net) = st
+        if w is not None and (w[0], w[1]) == (s_epoch, s_ver) \
+                and w[2] != s_central:
+            return ("delta-reply divergence: the worker's held stamp "
+                    f"matches the server's current (epoch {s_epoch}, "
+                    f"ver {s_ver}) but its view {w[2]} != central "
+                    f"{s_central} — a delta applied onto the wrong base")
+        return None
+
+
+# =====================================================================
 # sched — lease + preempt + park/resume exclusivity and durability
 # =====================================================================
 
@@ -944,7 +1095,7 @@ class CoordFailModel(Model):
 
 MODELS: Dict[str, Callable[..., Model]] = {
     "ps": PSModel, "lease": LeaseModel, "mpmd": MpmdModel,
-    "copt": CompressModel, "sched": SchedModel,
+    "copt": CompressModel, "dpull": DeltaPullModel, "sched": SchedModel,
     "coordfail": CoordFailModel}
 
 #: mutation name -> the model it breaks (the soundness corpus)
@@ -957,6 +1108,8 @@ MUTATIONS: Dict[str, str] = {
     "no_mb_dedup": "mpmd",
     "no_error_feedback": "copt",
     "decode_before_admission": "copt",
+    "stale_delta_base": "dpull",
+    "no_full_fallback_on_restore": "dpull",
     "park_without_manifest": "sched",
     "double_grant_slot": "sched",
     "no_epoch_fence": "coordfail",
@@ -967,7 +1120,7 @@ MUTATIONS: Dict[str, str] = {
 #: per-model depth the `make distmodel` gate explores to (deep enough to
 #: cover every mutation's counterexample; small enough to stay seconds)
 DEFAULT_DEPTH = {"ps": 12, "lease": 10, "mpmd": 12, "copt": 12,
-                 "sched": 12, "coordfail": 10}
+                 "dpull": 12, "sched": 12, "coordfail": 10}
 
 
 def _chaos_plan_for(result: Result) -> dict:
@@ -1105,6 +1258,8 @@ def counterexample_artifact(result: Result) -> dict:
     # schedule a replay drives against the real coordinator)
     if result.model == "sched":
         ops = ("park", "resume", "grant", "release", "peak", "offpeak")
+    elif result.model == "dpull":
+        ops = ("push", "pull", "deliver", "drop_reply", "restore")
     elif result.model == "coordfail":
         ops = ("preempt", "grant", "crash", "partition", "zombie_bump",
                "rejoin", "resume", "regrant", "expire_blipped",
@@ -1819,12 +1974,154 @@ def _replay_forget_parked(ce: dict, workdir: str,
     return violations
 
 
+def _replay_stale_delta_base(ce: dict, workdir: str,
+                             mutated: bool) -> List[str]:
+    """The dpull stale-base schedule against the real ``ParameterServer``
+    / ``Listener`` delta-reply plane: a worker full-syncs, then a delta
+    reply is LOST while the server's tracked base advances past it, then
+    the worker pulls again with its (now stale) held stamp. Mutated —
+    ``_delta_check_held`` off and a blindly-trusting worker — the server
+    ships a delta against the advanced base and the worker's view
+    diverges from central; clean, the held-stamp miss forces a full
+    dense install and the views stay bitwise identical."""
+    import numpy as np
+
+    from distributed_ml_pytorch_tpu.parallel.async_ps import Listener
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        InProcessTransport,
+        MessageCode,
+    )
+
+    world = InProcessTransport.create_world(2)
+    try:
+        ps = _mk_ps(workdir, world[0])
+        lst = Listener(transport=world[1])  # receive() driven inline
+        if mutated:
+            ps._delta_check_held = False
+            lst.delta_trust = True
+
+        def pull(deliver: bool = True):
+            ps.handle(1, MessageCode.ParameterRequest, lst.held_stamp())
+            msg = world[1].recv(timeout=0.5)
+            if msg is not None and deliver:
+                lst.receive(msg[0], msg[1], msg[2])
+            return msg
+
+        pull()  # first pull: full dense install seeds the worker's view
+        ps.handle(1, MessageCode.GradientUpdate, np.ones(4, np.float32))
+        ps.commit()
+        # this pull's (delta) reply is LOST in flight — but the server's
+        # tracked base has ALREADY advanced to the view it never shipped
+        pull(deliver=False)
+        ps.handle(1, MessageCode.GradientUpdate,
+                  np.full(4, 2.0, np.float32))
+        ps.commit()
+        pull()  # stale held stamp: clean full-falls-back, mutated deltas
+        violations = []
+        if lst._view is None or not np.array_equal(lst._view, ps.central):
+            violations.append(
+                "delta-reply divergence: the server shipped a delta "
+                "against a base the worker never pulled and the worker's "
+                "view no longer matches central")
+        if not mutated:
+            if lst.full_installs < 2:
+                violations.append(
+                    "clean config never took the full fallback — the "
+                    "held-stamp check is not wired")
+            if ps.delta_replies < 1:
+                violations.append(
+                    "clean config never shipped a delta — the delta "
+                    "plane is not wired")
+    finally:
+        for t in world.values():
+            t.close()
+    return violations
+
+
+def _replay_no_full_fallback_on_restore(ce: dict, workdir: str,
+                                        mutated: bool) -> List[str]:
+    """The dpull zombie-across-restore schedule against the real stack: a
+    delta reply is cut just before a crash that loses the un-fsynced WAL
+    tail; the restored server re-fills the SAME version number with
+    DIFFERENT bytes; the zombie reply then lands. Clean, the restore
+    bumps the pull epoch so the worker's resulting stamp can never match
+    the new life's; mutated (``_delta_reset_on_restore`` off) the stamps
+    collide and the worker claims the current version with stale bytes."""
+    import numpy as np
+
+    from distributed_ml_pytorch_tpu.parallel.async_ps import Listener
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        InProcessTransport,
+        MessageCode,
+    )
+
+    world = InProcessTransport.create_world(2)
+    try:
+        ps = _mk_ps(workdir, world[0])
+        lst = Listener(transport=world[1])
+        # life 0: one durable push, then a full install at its version
+        ps.handle(1, MessageCode.GradientUpdate, np.ones(4, np.float32))
+        ps.commit()
+        durable = _sync_size(ps)
+        ps.handle(1, MessageCode.ParameterRequest, lst.held_stamp())
+        msg = world[1].recv(timeout=0.5)
+        assert msg is not None
+        lst.receive(msg[0], msg[1], msg[2])  # worker holds (epoch 0, v1)
+        # an un-fsynced push, and the delta reply cut from it — the reply
+        # is DELAYED in flight (the zombie)
+        ps.handle(1, MessageCode.GradientUpdate, np.ones(4, np.float32))
+        ps.handle(1, MessageCode.ParameterRequest, lst.held_stamp())
+        zombie = world[1].recv(timeout=0.5)
+        # CRASH before the covering fsync: power loss drops the tail push
+        os.truncate(ps.wal.path, durable)
+
+        ps2 = _mk_ps(workdir, world[0])
+        if mutated:
+            ps2._delta_reset_on_restore = False
+        ps2.maybe_restore()  # back to v1; the FENCE is the epoch bump
+        # life 1 re-fills version number 2 with different bytes
+        ps2.handle(1, MessageCode.GradientUpdate,
+                   np.full(4, 5.0, np.float32))
+        ps2.commit()
+        if zombie is not None:
+            lst.receive(zombie[0], zombie[1], zombie[2])
+        violations = []
+        if lst._held == (ps2._pull_epoch, ps2._apply_seq) \
+                and not np.array_equal(lst._view, ps2.central):
+            violations.append(
+                "zombie delta reply crossed the restore: the worker "
+                "claims the server's current (epoch, version) while "
+                "holding the dead life's bytes")
+        if not mutated:
+            if ps2._pull_epoch < 1:
+                violations.append(
+                    "clean config did not bump the pull epoch on restore "
+                    "— the fence is not wired")
+            # the worker's stale-epoch stamp must heal via full fallback
+            ps2.handle(1, MessageCode.ParameterRequest, lst.held_stamp())
+            msg = world[1].recv(timeout=0.5)
+            if msg is not None:
+                lst.receive(msg[0], msg[1], msg[2])
+            if lst._view is None \
+                    or not np.array_equal(lst._view, ps2.central):
+                violations.append(
+                    "clean config's post-restore pull did not full-sync "
+                    "the worker bitwise")
+    finally:
+        for t in world.values():
+            t.close()
+    return violations
+
+
 _REPLAYS = {
     ("ps", "ack_before_fsync"): _replay_ack_before_fsync,
     ("ps", "no_dedup"): _replay_no_dedup,
     ("ps", "no_seed_on_restore"): _replay_no_seed_on_restore,
     ("copt", "no_error_feedback"): _replay_no_error_feedback,
     ("copt", "decode_before_admission"): _replay_decode_before_admission,
+    ("dpull", "stale_delta_base"): _replay_stale_delta_base,
+    ("dpull", "no_full_fallback_on_restore"):
+        _replay_no_full_fallback_on_restore,
     ("sched", "park_without_manifest"): _replay_park_without_manifest,
     ("sched", "double_grant_slot"): _replay_double_grant_slot,
     ("coordfail", "no_epoch_fence"): _replay_no_epoch_fence,

@@ -41,6 +41,14 @@ returns the model; :func:`check` cross-checks it against the code:
   the first durable-log call applies a transition the restart replay
   never sees — a crash in between silently forgets a join, an expiry,
   a map bump or a parked member.
+- **DC407** — a codec-id-bearing frame sent around the codec plane
+  (ISSUE 18): a send site for a code whose schema declares a ``codec``
+  head field, in an enclosing function with NO registry encoder call
+  (``encode_body`` / ``encode_range`` / ``*.encode``) — the body never
+  went through ``utils/codecs``, so the codec id it stamps is
+  unenforced: the receiver decodes under a contract (admissible rungs,
+  loss bound) the sender never honored. The messaging layer itself and
+  ``utils/codecs.py`` are exempt (they ARE the plumbing).
 
 Like DC105/DC107/DC108, the family is opt-in: it stays silent on a
 package whose schema table carries no protocol-model annotations, so the
@@ -618,6 +626,61 @@ def _check_coord_log_then_mutate(pkg: Package) -> List[Finding]:
     return findings
 
 
+# --------------------------------------------------------------- DC407
+
+def _enclosing_function(tree: ast.AST, line: int) -> Optional[ast.AST]:
+    """The innermost function definition whose span covers ``line``."""
+    best = None
+    for node in walk_list(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        end = getattr(node, "end_lineno", node.lineno)
+        if node.lineno <= line <= end and \
+                (best is None or node.lineno > best.lineno):
+            best = node
+    return best
+
+
+def _has_registry_encode(fn: ast.AST) -> bool:
+    """Any encoder-family call in scope: ``codecs.encode_body``, the
+    push path's ``encoder.encode_range``, a codec instance's
+    ``.encode`` — the naming convention the codec plane owns."""
+    for node in walk_list(fn):
+        if isinstance(node, ast.Call) and \
+                "encode" in call_name(node).lower():
+            return True
+    return False
+
+
+def _check_codec_send_routing(model: ProtocolModel,
+                              pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    by_path = {src.path: src for src in pkg}
+    for code in sorted(model.specs):
+        spec = model.specs[code]
+        sch = spec.schema
+        if sch is None or "codec" not in sch.fields:
+            continue
+        for site in spec.sends:
+            if site.path.endswith(_LAYER_MODULE) or \
+                    site.path.endswith("utils/codecs.py"):
+                continue
+            src = by_path.get(site.path)
+            if src is None:
+                continue
+            fn = _enclosing_function(src.tree, site.line)
+            if fn is not None and not _has_registry_encode(fn):
+                findings.append(Finding(
+                    site.path, site.line, "DC407",
+                    f"MessageCode.{code} frames carry a codec id but "
+                    f"{fn.name}() sends one without any registry "
+                    "encoder call (encode_body / encode_range / "
+                    "*.encode) in scope — the body bypassed the codec "
+                    "plane, so the codec id it stamps is a claim the "
+                    "receiver's decode contract never verified"))
+    return findings
+
+
 # --------------------------------------------------------------- entry
 
 def check(pkg: Package) -> List[Finding]:
@@ -630,4 +693,5 @@ def check(pkg: Package) -> List[Finding]:
     findings.extend(_check_incarnation_gate(model, pkg))
     findings.extend(_check_tail_evolution(model, pkg))
     findings.extend(_check_coord_log_then_mutate(pkg))
+    findings.extend(_check_codec_send_routing(model, pkg))
     return findings

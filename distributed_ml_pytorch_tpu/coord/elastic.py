@@ -169,6 +169,10 @@ class ElasticShardServer:
         self.ps.pull_reply_head = np.asarray(
             [*_split16(max(0, self.map_version)), *_split16(self.lo),
              *_split16(self.hi)], np.float32)
+        # codec plane (ISSUE 18): a resize/rebalance re-fences the delta
+        # reply plane too — tracked worker bases may describe a different
+        # range, so the next delta-opted pull gets a full dense install
+        self.ps.reset_pull_bases()
 
     def _apply_map_locked(self, m: ShardMap) -> None:
         if m.version <= self.map_version:

@@ -487,6 +487,7 @@ def mpmd_scenario(
     straggler_factor: float = 0.0,
     cfg=None,
     timeout: float = 240.0,
+    act_codec: str = "dense",
 ) -> Dict:
     """Run one MPMD pipeline fleet script (see module docstring).
 
@@ -622,7 +623,7 @@ def mpmd_scenario(
             throttle=(throttle if throttle_stage == i else 0.0),
             step_hook=hook,
             recorder=make_recorder(f"stage{i}", transport),
-            obs_dir=obs_dir)
+            obs_dir=obs_dir, act_codec=act_codec)
 
     stages: List[MpmdStage] = []
     stage_threads: List[threading.Thread] = []
@@ -643,7 +644,7 @@ def mpmd_scenario(
             None, cfg, S, M, standby_transport, client,
             mb_size=mb, seq_len=seq, lr=lr, seed=seed, ckpt_root=base_dir,
             recorder=make_recorder("standby", standby_transport),
-            obs_dir=obs_dir)
+            obs_dir=obs_dir, act_codec=act_codec)
         t = threading.Thread(target=standby_member.run,
                              kwargs={"timeout": timeout + 60}, daemon=True)
         t.start()

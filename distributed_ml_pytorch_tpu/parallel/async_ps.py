@@ -47,12 +47,21 @@ from distributed_ml_pytorch_tpu.utils.durability import atomic_write
 from distributed_ml_pytorch_tpu.utils.health import (
     admission_from_args as _admission_from_args,
 )
+from distributed_ml_pytorch_tpu.utils import codecs
+from distributed_ml_pytorch_tpu.utils.compress import (
+    CODEC_DENSE,
+    CODEC_TOPK,
+    CompressionError,
+    body_crc,
+)
 from distributed_ml_pytorch_tpu.utils.messaging import (
     SERVER_RANK,
     MessageCode,
     MessageListener,
     Transport,
+    _join16,
     _next_incarnation,
+    _split16,
     send_message,
 )
 from distributed_ml_pytorch_tpu.utils.serialization import (
@@ -219,6 +228,29 @@ class ParameterServer:
         #: of a bare ``ParameterUpdate`` — the elastic plane's versioned
         #: wire. ``ElasticShardServer`` re-stamps it on every resize.
         self.pull_reply_head: Optional[np.ndarray] = None
+        # --- codec plane (ISSUE 18): delta-encoded pull replies ---------
+        #: pull epoch: bumped (and the base table cleared) on every
+        #: restore / rollback / resize — the fence that forces the next
+        #: reply to every worker back to a full dense install. The epoch
+        #: rides the DeltaParams head, so a worker holding a pre-restore
+        #: view can NEVER have a post-restore delta applied onto it.
+        self._pull_epoch = 0
+        #: sender -> (epoch, version, view): the worker's exact
+        #: materialized vector, mirrored by replaying our own encode ->
+        #: decode at send time. Error feedback is structural: the next
+        #: delta is ``central - view``, which already contains everything
+        #: the last lossy reply could not represent.
+        self._pull_bases: dict = {}
+        self.delta_replies = 0
+        self.full_replies = 0
+        #: wire floats actually sent on DeltaParams replies (head + body)
+        self.delta_reply_wire_floats = 0
+        #: distmodel mutation knobs (analysis/distmodel.py `dpull`): the
+        #: clean server checks the worker's held stamp before shipping a
+        #: delta, and re-fences the base table on restore. Flipping either
+        #: reproduces the model's counterexample on this real stack.
+        self._delta_check_held = True
+        self._delta_reset_on_restore = True
         self._stop = threading.Event()
 
     def stop(self) -> None:
@@ -383,7 +415,20 @@ class ParameterServer:
             restored = bool(self._replay_wal()) or restored
         if restored:
             self._restored = True
+            if self._delta_reset_on_restore:
+                # a restored life must re-fence the delta plane: any base
+                # tracked by the dead life describes a worker view this
+                # life cannot prove, and the epoch bump forces full
+                # replies even if version NUMBERS happen to line up again
+                self.reset_pull_bases()
         return restored
+
+    def reset_pull_bases(self) -> None:
+        """Fence the delta-reply plane (restore / rollback / resize): drop
+        every tracked worker base and bump the pull epoch so the next
+        reply to each worker is a full dense install."""
+        self._pull_epoch += 1
+        self._pull_bases.clear()
 
     def _restore_optimizer_state(self, meta) -> None:
         """Adopt the checkpoint's optimizer generation (the one whose CRC
@@ -523,6 +568,11 @@ class ParameterServer:
         discarded = max(0, before_seq - self._apply_seq)
         self.rolled_back_updates += discarded
         self._restored = True
+        if self._delta_reset_on_restore:
+            # rollback rewinds apply seqs the delta plane may have already
+            # stamped onto replies: same version number, different bytes.
+            # The epoch bump is what keeps those from ever colliding.
+            self.reset_pull_bases()
         _LOGGER.warning(
             "rollback: restored apply seq %d (ckpt %d + %d WAL records), "
             "DISCARDED %d applied update(s) past the good snapshot",
@@ -582,7 +632,14 @@ class ParameterServer:
                 "dropping truncated CompressedUpdate from %d "
                 "(%d floats, head is 12)", sender, payload.size)
         elif code == MessageCode.ParameterRequest:
-            self._reply(sender, self.central)
+            # codec plane (ISSUE 18): a non-empty request tail is the
+            # worker's held stamp ``[held_epoch, held_ver_lo, held_ver_hi]``
+            # opting into delta replies; empty is the legacy full pull
+            if payload.size >= 3 and np.isfinite(payload[:3]).all():
+                held = (int(payload[0]), _join16(payload[1], payload[2]))
+                self._reply_delta(sender, held)
+            else:
+                self._reply(sender, self.central)
             self.staleness.on_pull(sender)
             if self.combine == "adasum":
                 # the worker now sees everything applied so far: its
@@ -757,6 +814,69 @@ class ParameterServer:
             _LOGGER.warning(
                 "UpdateNack to worker %d failed (peer gone?) — the "
                 "quarantine stands; its next pull resyncs it anyway", sender)
+
+    def _reply_delta(self, sender: int, held: Tuple[int, int]) -> None:
+        """Answer a delta-opted pull (ISSUE 18): ship ``central - view``
+        on the top-k rung when this server tracks the worker's exact
+        materialized view at the held stamp, a full dense install (codec
+        0) otherwise — version miss, epoch fence, first pull, resize.
+
+        The tracked base is updated by replaying our OWN encode -> decode,
+        so server and worker views stay bitwise identical and the next
+        delta automatically carries the error feedback (everything the
+        top-k body could not represent is still in ``central - view``)."""
+        central = self.central
+        ver = self._apply_seq
+        epoch = self._pull_epoch
+        base = self._pull_bases.get(sender)
+        held_epoch, held_ver = held
+        use_delta = (
+            base is not None
+            and held_epoch >= 0
+            and base[2].shape == central.shape)
+        if use_delta and self._delta_check_held:
+            # the held stamp must name EXACTLY the view we track — a
+            # worker that missed a reply (or a server tracking a base the
+            # worker never pulled) falls back to a full install. Skipping
+            # this check is the `stale_delta_base` mutation.
+            use_delta = (base[0] == held_epoch == epoch
+                         and base[1] == held_ver)
+        if use_delta:
+            raw = central - base[2]
+            cid, body = codecs.encode_body(
+                MessageCode.DeltaParams, raw, CODEC_TOPK)
+            base_ver = base[1]
+        else:
+            cid, body = codecs.encode_body(
+                MessageCode.DeltaParams, central, CODEC_DENSE)
+            base_ver = 0
+        decoded = codecs.decode_body(
+            MessageCode.DeltaParams, cid, body, central.size)
+        view = (base[2] + decoded) if use_delta else decoded
+        self._pull_bases[sender] = (epoch, ver, view.astype(np.float32))
+        n = int(central.size)
+        crc = body_crc(body)
+        head = np.asarray(
+            [float(cid), float(epoch), *_split16(base_ver), *_split16(ver),
+             *_split16(0), *_split16(n), *_split16(n), *_split16(crc)],
+            np.float32)
+        if use_delta:
+            self.delta_replies += 1
+        else:
+            self.full_replies += 1
+        self.delta_reply_wire_floats += int(head.size) + int(body.size)
+        try:
+            send_message(
+                MessageCode.DeltaParams, np.concatenate([head, body]),
+                dst=sender, transport=self.transport)
+        except (OSError, ConnectionError, KeyError):
+            # the reply is lost but the BASE TABLE already moved on: the
+            # held-stamp check above is what turns that into a full
+            # install on the worker's next pull instead of divergence
+            _LOGGER.warning(
+                "delta reply to worker %d failed (peer gone?) — dropping "
+                "it; its next pull full-syncs via the held-stamp miss",
+                sender)
 
     def _reply(self, sender: int, payload: np.ndarray) -> None:
         """Answer one worker; a worker that died between its request and
@@ -1041,10 +1161,80 @@ class Listener(MessageListener):
         #: triggers ONE resync pull, not one per frame)
         self.nacks = 0
         self._nacks_pending = 0
+        # --- codec plane (ISSUE 18): delta-reply state -------------------
+        #: the worker's materialized view of the central vector and the
+        #: (epoch, version) stamp it sits at — what the next pull's held
+        #: stamp names, and the base the next delta applies onto
+        self._view: Optional[np.ndarray] = None
+        self._held: Optional[Tuple[int, int]] = None
+        #: deltas dropped because the stamped base was not the held view
+        self.delta_base_miss = 0
+        self.delta_installs = 0
+        self.full_installs = 0
+        #: mutation knob (analysis/distmodel.py `stale_delta_base`): the
+        #: clean listener refuses a delta whose base stamp is not exactly
+        #: its held view; True applies it blindly onto whatever it has
+        self.delta_trust = False
+
+    def held_stamp(self) -> np.ndarray:
+        """This worker's pull-request tail: ``[held_epoch, held_ver_lo,
+        held_ver_hi]`` (epoch −1 = no materialized view, force a full
+        dense reply)."""
+        with self._lock:
+            if self._held is None or self._view is None:
+                return np.asarray([-1.0, 0.0, 0.0], np.float32)
+            epoch, ver = self._held
+            return np.asarray([float(epoch), *_split16(ver)], np.float32)
+
+    def _on_delta_params(self, parameter: np.ndarray) -> None:
+        # head: codec epoch base(2) ver(2) lo(2) hi(2) n(2) crc(2) = 14
+        if parameter.size < 15 or not np.isfinite(parameter[:14]).all():
+            return  # malformed: drop, never die
+        cid = int(parameter[0])
+        epoch = int(parameter[1])
+        base_ver = _join16(parameter[2], parameter[3])
+        ver = _join16(parameter[4], parameter[5])
+        lo = _join16(parameter[6], parameter[7])
+        hi = _join16(parameter[8], parameter[9])
+        n = _join16(parameter[10], parameter[11])
+        crc = _join16(parameter[12], parameter[13])
+        body = parameter[14:]
+        # range-gate + integrity on the STAMP before paying for a decode
+        if hi - lo != n or body_crc(body) != crc:
+            return
+        try:
+            decoded = codecs.decode_body(
+                MessageCode.DeltaParams, cid, body, n)
+        except CompressionError:
+            return
+        with self._lock:
+            if cid == CODEC_DENSE:
+                # full install: adopt unconditionally (the fallback rung)
+                self._view = decoded
+                self._held = (epoch, ver)
+                self.full_installs += 1
+            else:
+                ok = (self._view is not None and self._view.size == n
+                      and (self.delta_trust
+                           or self._held == (epoch, base_ver)))
+                if not ok:
+                    # a delta against a base this worker never
+                    # materialized: drop it and let the next pull's held
+                    # stamp (or epoch mismatch) force a full reply
+                    self.delta_base_miss += 1
+                    return
+                self._view = (self._view + decoded).astype(np.float32)
+                self._held = (epoch, ver)
+                self.delta_installs += 1
+            self._latest = self._view
+            self._latest_stamp = None
+        self._got_update.set()
 
     def receive(self, sender: int, message_code: MessageCode, parameter: np.ndarray) -> None:
         _LOGGER.info("Processing message: %s", message_code.name)
-        if message_code == MessageCode.ParameterUpdate:
+        if message_code == MessageCode.DeltaParams:
+            self._on_delta_params(parameter)
+        elif message_code == MessageCode.ParameterUpdate:
             with self._lock:
                 self._latest = parameter
                 self._latest_stamp = None  # legacy unversioned reply
@@ -1211,11 +1401,17 @@ class Asynchronous:
         compress: Optional[str] = None,
         compress_opts: Optional[dict] = None,
         error_feedback: bool = True,
+        delta_pull: bool = False,
     ):
         validate_downpour_args(lr, n_push, n_pull)
         self.lr = float(lr)
         self.n_push = int(n_push)
         self.n_pull = int(n_pull)
+        #: codec plane (ISSUE 18): opt into delta-encoded pull replies —
+        #: every ParameterRequest carries the listener's held stamp and
+        #: the server answers on the DeltaParams wire (top-k delta in
+        #: steady state, full dense install on any miss/restore/resize)
+        self.delta_pull = bool(delta_pull)
         self.transport = transport
         self.idx = 0
         self.unravel = make_unraveler(params)
@@ -1241,7 +1437,8 @@ class Asynchronous:
             # already runs on central params; on timeout it proceeds locally
             # and the normal failure path applies.
             send_message(
-                MessageCode.ParameterRequest, np.zeros(0, np.float32), transport=transport
+                MessageCode.ParameterRequest, self._pull_payload(),
+                transport=transport
             )
             if not self.listener.wait_for_update(timeout=install_timeout):
                 print(
@@ -1341,6 +1538,13 @@ class Asynchronous:
         self._guarded_send(
             lambda: send_message(code, payload, transport=self.transport))
 
+    def _pull_payload(self) -> np.ndarray:
+        """The ParameterRequest body: empty for a legacy full pull, the
+        listener's held stamp when this worker opted into delta replies."""
+        if self.delta_pull:
+            return self.listener.held_stamp()
+        return np.zeros(0, np.float32)
+
     def _resync_on_nacks(self) -> None:
         """The nack response (ISSUE 8): a quarantined push means this
         worker's view may be diverging from the central params it can no
@@ -1355,7 +1559,7 @@ class Asynchronous:
                 "admission gate — resyncing with a fresh pull",
                 file=sys.stderr,
             )
-            self._send(MessageCode.ParameterRequest, np.zeros(0, np.float32))
+            self._send(MessageCode.ParameterRequest, self._pull_payload())
 
     def boundary(self, gap: int) -> Optional[np.ndarray]:
         """Host-side communication for inter-step gap ``gap`` (the point
@@ -1381,7 +1585,7 @@ class Asynchronous:
             # bounded by one chunk); clear the flag so it cannot go stale
             self._hold_updates = False
         if gap % self.n_pull == 0:
-            self._send(MessageCode.ParameterRequest, np.zeros(0, np.float32))
+            self._send(MessageCode.ParameterRequest, self._pull_payload())
         self.idx = gap
         return latest
 
@@ -1403,7 +1607,7 @@ class Asynchronous:
         # ships the accumulator as a dummy payload — an empty payload is the
         # intent (the request carries no information)
         if self.idx % self.n_pull == 0:
-            self._send(MessageCode.ParameterRequest, np.zeros(0, np.float32))
+            self._send(MessageCode.ParameterRequest, self._pull_payload())
 
         if held:
             self.skipped_updates += 1

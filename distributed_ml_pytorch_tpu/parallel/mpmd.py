@@ -85,6 +85,12 @@ from distributed_ml_pytorch_tpu.parallel.pipeline import (
 )
 from distributed_ml_pytorch_tpu.utils import obs
 from distributed_ml_pytorch_tpu.utils.durability import atomic_write
+from distributed_ml_pytorch_tpu.utils import codecs
+from distributed_ml_pytorch_tpu.utils.compress import (
+    CODEC_DENSE,
+    CODEC_INT8,
+    CompressionError,
+)
 from distributed_ml_pytorch_tpu.utils.messaging import (
     MessageCode,
     Transport,
@@ -483,6 +489,7 @@ class MpmdStage:
         step_hook: Optional[Callable[["MpmdStage", int], None]] = None,
         recorder: Optional["obs.SpanRecorder"] = None,
         obs_dir: Optional[str] = None,
+        act_codec: str = "dense",
     ):
         self.cfg = cfg
         self.S = int(n_stages)
@@ -501,6 +508,14 @@ class MpmdStage:
         self.retain_steps = int(retain_steps)
         self.step_hook = step_hook
         self.ranges = stage_param_ranges(cfg, self.S)
+        #: codec plane (ISSUE 18): activation bodies (SHIP_ACT fwd,
+        #: ActivationGrad bwd) ride the registry rung named here; token /
+        #: target / loss bodies are always dense (codec 0) by contract.
+        #: Retained buffers hold RAW float32 and are re-encoded at ship
+        #: time, so replayed frames are byte-identical to the originals.
+        if act_codec not in ("dense", "int8"):
+            raise ValueError(f"act_codec must be dense|int8, got {act_codec}")
+        self._act_cid = CODEC_INT8 if act_codec == "int8" else CODEC_DENSE
 
         self.stage: Optional[int] = None
         self.programs: Optional[StagePrograms] = None
@@ -532,7 +547,7 @@ class MpmdStage:
             "fwd": 0, "bwd": 0, "updates": 0, "dup_inputs_dropped": 0,
             "dup_grads_dropped": 0, "stale_dropped": 0, "reshipped": 0,
             "send_failed": 0, "snapshots": 0, "malformed_dropped": 0,
-            "busy_s": 0.0,
+            "busy_s": 0.0, "act_dense_floats": 0, "act_wire_floats": 0,
         }
         # --- flight recorder (ISSUE 12) ---------------------------------
         #: spans + exclusive-state attribution for THIS member's serve
@@ -687,13 +702,26 @@ class MpmdStage:
     def _send_frame(self, dst_rank: int, code: MessageCode, step: int,
                     mbi: int, kind: int, body: np.ndarray) -> None:
         ver = self._placement_version()
+        # codec plane (ISSUE 18): activations may ride a lossy rung; token
+        # / target / loss bodies are exact by contract, so they stay dense.
+        lossy_ok = (code == MessageCode.ActivationGrad
+                    or (code == MessageCode.ActivationShip
+                        and kind == SHIP_ACT))
+        want_cid = self._act_cid if lossy_ok else CODEC_DENSE
+        cid, coded = codecs.encode_body(code, body, want_cid)
+        if lossy_ok:
+            self.stats["act_dense_floats"] += int(body.size)
+            self.stats["act_wire_floats"] += int(coded.size)
         if code == MessageCode.ActivationShip:
             head = np.asarray(
-                [*_split16(step), float(mbi), float(kind), *_split16(ver)],
+                [*_split16(step), float(mbi), float(kind), *_split16(ver),
+                 float(cid)],
                 np.float32)
         else:
             head = np.asarray(
-                [*_split16(step), float(mbi), *_split16(ver)], np.float32)
+                [*_split16(step), float(mbi), *_split16(ver), float(cid)],
+                np.float32)
+        body = coded
         # credit-blocked send time is the WIRE's fault, not compute's:
         # carve it out of the serve loop's current state (ISSUE 12)
         stats = getattr(self.transport, "stats", None)
@@ -741,21 +769,21 @@ class MpmdStage:
     # -------------------------------------------------------------- receive
     def handle(self, sender: int, code: MessageCode,
                payload: np.ndarray) -> None:
-        if code == MessageCode.ActivationShip and payload.size >= 7:
-            if not np.isfinite(payload[:6]).all():
+        if code == MessageCode.ActivationShip and payload.size >= 8:
+            if not np.isfinite(payload[:7]).all():
                 return
             step = _join16(payload[0], payload[1])
             mbi = int(payload[2])
             kind = int(payload[3])
             self._adopt_corr(step, mbi)
-            self._on_ship(step, mbi, kind, payload[6:])
-        elif code == MessageCode.ActivationGrad and payload.size >= 6:
-            if not np.isfinite(payload[:5]).all():
+            self._on_ship(step, mbi, kind, int(payload[6]), payload[7:])
+        elif code == MessageCode.ActivationGrad and payload.size >= 7:
+            if not np.isfinite(payload[:6]).all():
                 return
             step = _join16(payload[0], payload[1])
             mbi = int(payload[2])
             self._adopt_corr(step, mbi)
-            self._on_grad(step, mbi, payload[5:])
+            self._on_grad(step, mbi, int(payload[5]), payload[6:])
 
     def _adopt_corr(self, step: int, mbi: int) -> None:
         """Bind the envelope's correlation id (restored into the thread-
@@ -768,7 +796,7 @@ class MpmdStage:
         if corr and (step, mbi) not in self._mb_corr:
             self._mb_corr[(step, mbi)] = corr
 
-    def _on_ship(self, step: int, mbi: int, kind: int,
+    def _on_ship(self, step: int, mbi: int, kind: int, cid: int,
                  body: np.ndarray) -> None:
         if self.stage is None or not (0 <= mbi < self.M):
             return
@@ -778,7 +806,19 @@ class MpmdStage:
         want = (self.mb_size * self.seq_len
                 if kind in (SHIP_TOKENS, SHIP_TARGETS)
                 else self.mb_size * self.seq_len * self.cfg.d_model)
-        if body.size != want or not np.isfinite(body).all():
+        # decode BEFORE the size/finite gates: the gates judge the decoded
+        # body, and only SHIP_ACT may ride a lossy rung — a lossy codec id
+        # on a token/target frame is malformed, not merely imprecise
+        if kind != SHIP_ACT and cid != CODEC_DENSE:
+            self.stats["malformed_dropped"] += 1
+            return
+        try:
+            body = codecs.decode_body(
+                MessageCode.ActivationShip, cid, body, want)
+        except CompressionError:
+            self.stats["malformed_dropped"] += 1
+            return
+        if not np.isfinite(body).all():
             self.stats["malformed_dropped"] += 1
             return
         if kind == SHIP_TARGETS:
@@ -805,11 +845,18 @@ class MpmdStage:
             return
         inp[mbi] = body
 
-    def _on_grad(self, step: int, mbi: int, body: np.ndarray) -> None:
+    def _on_grad(self, step: int, mbi: int, cid: int,
+                 body: np.ndarray) -> None:
         if self.stage is None or self.programs.last or not (0 <= mbi < self.M):
             return
-        if (body.size != self.mb_size * self.seq_len * self.cfg.d_model
-                or not np.isfinite(body).all()):
+        try:
+            body = codecs.decode_body(
+                MessageCode.ActivationGrad, cid, body,
+                self.mb_size * self.seq_len * self.cfg.d_model)
+        except CompressionError:
+            self.stats["malformed_dropped"] += 1
+            return
+        if not np.isfinite(body).all():
             self.stats["malformed_dropped"] += 1
             return
         if step < self.step:
@@ -1241,8 +1288,14 @@ class MpmdDriver:
     def _send(self, dst: int, step: int, mbi: int, kind: int,
               body: np.ndarray) -> None:
         ver = self._placement.version if self._placement is not None else 0
+        # driver ships tokens/targets — exact by contract, so the
+        # registry's dense rung (codec 0, a passthrough) is the only one
+        # this site may stamp
+        cid, coded = codecs.encode_body(
+            MessageCode.ActivationShip, body.ravel(), CODEC_DENSE)
         head = np.asarray(
-            [*_split16(step), float(mbi), float(kind), *_split16(ver)],
+            [*_split16(step), float(mbi), float(kind), *_split16(ver),
+             float(cid)],
             np.float32)
         # one correlation id per (step, mb), minted at first ship and
         # reused by re-ships — the envelope carries it fleet-wide
@@ -1253,7 +1306,7 @@ class MpmdDriver:
             with obs.corr_scope(corr):
                 self.transport.send(
                     MessageCode.ActivationShip,
-                    np.concatenate([head, body.ravel()]), dst=dst)
+                    np.concatenate([head, coded]), dst=dst)
         except (OSError, ConnectionError, KeyError):
             self.stats["send_failed"] += 1
 
@@ -1326,12 +1379,13 @@ class MpmdDriver:
             self._drain_placement()
             if msg is not None:
                 _sender, code, payload = msg
-                if (code == MessageCode.ActivationShip and payload.size >= 7
-                        and np.isfinite(payload[:6]).all()
-                        and int(payload[3]) == SHIP_LOSS):
+                if (code == MessageCode.ActivationShip and payload.size >= 8
+                        and np.isfinite(payload[:7]).all()
+                        and int(payload[3]) == SHIP_LOSS
+                        and int(payload[6]) == CODEC_DENSE):
                     step = _join16(payload[0], payload[1])
                     mbi = int(payload[2])
-                    body = payload[6:]
+                    body = payload[7:]
                     if (step, mbi) in self._ce:
                         self.stats["dup_loss_dropped"] += 1
                     elif (0 <= step < steps and 0 <= mbi < self.M

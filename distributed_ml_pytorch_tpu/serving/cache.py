@@ -290,6 +290,20 @@ class SlotKVPool:
         mask[list(slot_indices)] = True
         self.cache = _reset_slots_jit(self.cache, jnp.asarray(mask))
 
+    def slot_kv(self, slot: int) -> np.ndarray:
+        """One slot's KV lane as a flat float32 vector (every floating
+        cache leaf's row for ``slot``, concatenated in tree order) — the
+        body a migration handoff ships on the ``KvMigrate`` wire (ISSUE
+        18). With ``kv_quant`` the leaves are already the int8+scale
+        recipe; the float32 view is the wire's common currency either way."""
+        parts = [
+            np.asarray(leaf[slot], np.float32).ravel()
+            for leaf in jax.tree.leaves(self.cache)
+            if jnp.issubdtype(leaf.dtype, jnp.floating)]
+        if not parts:
+            return np.zeros(0, np.float32)
+        return np.concatenate(parts)
+
     def live_lengths(self) -> np.ndarray:
         """Per-slot live sequence length (prompt + generated), from the
         cache's own cursors — the observability face of slot occupancy."""

@@ -256,6 +256,16 @@ class ServingEngine:
                 return True
         return False
 
+    def kv_lane(self, request_id: int) -> Optional[np.ndarray]:
+        """The flat KV-cache lane behind a live request's slot, or None
+        when the request holds no slot (queued / finished). The migration
+        handoff (``serving/fleet.py``, ISSUE 18) ships this on the
+        ``KvMigrate`` wire under the pool's ``kv_quant`` recipe."""
+        for slot, req in enumerate(self._slot_req):
+            if req is not None and req.request_id == request_id:
+                return self.pool.slot_kv(slot)
+        return None
+
     # ------------------------------------------------------------ schedule
     def step(self) -> bool:
         """One scheduling round: evict → admit → decode one block. Returns

@@ -57,7 +57,18 @@ from distributed_ml_pytorch_tpu.serving.frontend import (
     ServingFrontend,
     _Route,
 )
-from distributed_ml_pytorch_tpu.utils.messaging import MessageCode, Transport
+from distributed_ml_pytorch_tpu.utils import codecs
+from distributed_ml_pytorch_tpu.utils.compress import (
+    CODEC_DENSE,
+    CompressionError,
+    body_crc,
+)
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    MessageCode,
+    Transport,
+    _join16,
+    _split16,
+)
 
 
 class EngineMember:
@@ -199,6 +210,16 @@ class FleetRouter(ServingFrontend):
         self.recorder = None
         self.parked = 0              # submits parked awaiting ANY engine
         self._mttr: List[float] = []  # per-death seconds: detect -> resumed
+        # --- codec plane (ISSUE 18): KvMigrate handoffs ------------------
+        #: decoded handoffs parked by the loopback receiver, keyed by the
+        #: dying stream's old route key: (token ids, kv lane or None)
+        self._handoffs: Dict[int, Tuple[List[int], Optional[np.ndarray]]] = {}
+        self.handoffs = 0            # KvMigrate frames shipped + decoded
+        self.handoff_drops = 0       # malformed/failed handoff frames
+        #: raw float32 the handoff bodies WOULD have cost dense, vs what
+        #: the coded wire actually carried (head + body floats)
+        self.handoff_dense_floats = 0
+        self.handoff_wire_floats = 0
         for m in members:
             m.engine.on_tokens = self._on_tokens
         super().__init__(None, transport, **kw)
@@ -335,8 +356,17 @@ class FleetRouter(ServingFrontend):
         dead = self.members.get(dead_id)
         resumed = 0
         for old_key, route in moving:
+            # ship the stream's state over the KvMigrate wire FIRST (the
+            # engine's slot still holds the KV lane until the cancel), so
+            # the resubmission below re-prefills from the DECODED tokens —
+            # any number of migrations stays token-identical because the
+            # tok16 packing is exact (ISSUE 18)
+            self._ship_handoff(old_key, route, dead)
             if dead is not None:
                 dead.engine.cancel(old_key)  # free state if it ever revives
+            handoff = self._handoffs.pop(old_key, None)
+            if handoff is not None:
+                route.tokens = handoff[0]
             new_key = next(self._route_ids)
             if not route.service_lost_at:
                 route.service_lost_at = now  # MTTR anchors at DETECTION
@@ -371,6 +401,82 @@ class FleetRouter(ServingFrontend):
                   f"engine {dead_id} in "
                   f"{(time.monotonic() - now) * 1e3:.1f} ms",
                   file=sys.stderr)
+
+    # ------------------------------------------------------ KvMigrate wire
+    def _ship_handoff(self, old_key: int, route: _Route,
+                      dead: Optional[EngineMember]) -> None:
+        """Encode one dying stream's resumable state as a ``KvMigrate``
+        frame and put it on the loopback wire (ISSUE 18): the token
+        history rides the exact tok16 packing (two u16 ids per float — it
+        is what the resubmission re-prefills from, so the codec is
+        load-bearing), and the dead engine's KV lane rides the registry's
+        ``kv_quant`` rung (int8 per-block absmax) for pricing + bound
+        verification. The codec head field names the KV rung."""
+        tokens = np.asarray(route.tokens, np.float32)
+        try:
+            tok_body = (codecs.Tok16Codec().encode(tokens)
+                        if tokens.size else np.zeros(0, np.float32))
+        except (CompressionError, ValueError):
+            self.handoff_drops += 1
+            return
+        kv = None
+        if dead is not None:
+            try:
+                kv = dead.engine.kv_lane(old_key)
+            except Exception:  # noqa: BLE001 — a dying engine may throw
+                kv = None
+        if kv is not None and kv.size and np.isfinite(kv).all():
+            cid, kv_body = codecs.encode_body(MessageCode.KvMigrate, kv)
+            n_kv = int(kv.size)
+        else:
+            cid, kv_body, n_kv = CODEC_DENSE, np.zeros(0, np.float32), 0
+        body = np.concatenate([tok_body, kv_body])
+        crc = body_crc(body)
+        head = np.asarray(
+            [float(cid), *_split16(old_key), *_split16(int(tokens.size)),
+             *_split16(n_kv), *_split16(crc)], np.float32)
+        self.handoff_dense_floats += int(tokens.size) + n_kv
+        self.handoff_wire_floats += int(head.size) + int(body.size)
+        self._send_handoff(MessageCode.KvMigrate,
+                           np.concatenate([head, body]))
+
+    def _send_handoff(self, code: MessageCode, frame: np.ndarray) -> None:
+        """The handoff 'wire' is an in-process loopback — migrations stay
+        inside the router — but the frame is real: everything the resumed
+        stream needs crosses this boundary encoded, so the codec plane is
+        on the hook for token identity, not just pricing."""
+        self._on_handoff(0, code, frame)
+
+    def _on_handoff(self, sender: int, code: MessageCode,
+                    payload: np.ndarray) -> None:
+        if code == MessageCode.KvMigrate and payload.size >= 10:
+            if not np.isfinite(payload[:9]).all():
+                self.handoff_drops += 1
+                return
+            cid = int(payload[0])
+            key = _join16(payload[1], payload[2])
+            n_tok = _join16(payload[3], payload[4])
+            n_kv = _join16(payload[5], payload[6])
+            crc = _join16(payload[7], payload[8])
+            body = payload[9:]
+            # integrity-gate on the stamp BEFORE paying for a decode
+            if body_crc(body) != crc:
+                self.handoff_drops += 1
+                return
+            tw = codecs.Tok16Codec().wire_floats(n_tok)
+            try:
+                toks = codecs.Tok16Codec().decode(body[:tw], n_tok, 0)
+                kv = (codecs.decode_body(
+                    MessageCode.KvMigrate, cid, body[tw:], n_kv)
+                    if n_kv else None)
+            except CompressionError:
+                self.handoff_drops += 1
+                return
+            if kv is not None and not np.isfinite(kv).all():
+                self.handoff_drops += 1
+                return
+            self._handoffs[key] = ([int(t) for t in toks], kv)
+            self.handoffs += 1
 
     def _note_resumed(self, route: _Route) -> None:
         """Close one stream's outage window: count the migration and record
@@ -471,6 +577,10 @@ class FleetRouter(ServingFrontend):
             },
             "migrations": self.migrations,
             "migration_failures": self.migration_failures,
+            "handoffs": self.handoffs,
+            "handoff_drops": self.handoff_drops,
+            "handoff_dense_floats": self.handoff_dense_floats,
+            "handoff_wire_floats": self.handoff_wire_floats,
             "parked": self.parked,
             "mttr_s": self.mttr_s(),
             "shed": self.shed,

@@ -1,0 +1,292 @@
+"""The codec plane (ISSUE 18): one registry for every hot wire.
+
+PR 13/14 compressed exactly one wire — gradient pushes — with a codec
+that lived as a special of ``utils/compress.py``. This module turns that
+one-off into a SUBSYSTEM: every ``WIRE_SCHEMAS`` entry that declares a
+``codec`` field resolves here to a :class:`WirePlane` naming which codec
+ids may ride that wire, the plane's **loss contract**, and the fixed
+codec parameter both ends share (so the frame head needs one codec-id
+float, not a parameter block).
+
+Loss contracts (the vocabulary the totality test pins):
+
+- ``exact`` — decode(encode(x)) == x bit-for-bit. Token ids and other
+  integer payloads must ride exact rungs (:class:`Tok16Codec` packs two
+  sub-2^16 ids per float32 word; ``CODEC_DENSE`` is the identity).
+- ``bounded`` — elementwise ``|x - x̂| <= scale_block / 2`` with
+  ``scale_block = max(absmax_block, eps) / 127`` (the int8 per-block
+  absmax recipe, same as the serving cache's ``kv_quant``). One-shot
+  payloads — activations, activation cotangents, migrated KV — carry no
+  residual, so the bound itself is the whole guarantee
+  (:func:`int8_bound` computes the per-element allowance the numerics
+  tests assert against).
+- ``error-feedback`` — individually lossy, but the receiver-tracked sum
+  is exact: what frame t could not represent is folded into frame t+1
+  (``compress.CompressingEncoder`` for pushes; the parameter server's
+  per-worker pull base for delta replies, where
+  ``base + decoded_delta == central - residual`` holds exactly by
+  construction).
+
+Order on the receiving side IS the protocol, unchanged from PR 13:
+decode -> admission on the DECODED norm -> WAL (decoded payload + codec
+id) -> apply; elastic receivers range-gate on the stamp before paying
+for a decode. ``distcheck`` DC407 statically rejects a send site that
+writes a codec-id-bearing frame without routing the body through
+:func:`encode_body` / a registry encoder.
+
+Quickstart — trace one coded wire end to end::
+
+    from distributed_ml_pytorch_tpu.utils import codecs
+    from distributed_ml_pytorch_tpu.utils.messaging import MessageCode
+
+    plane = codecs.plane_for(MessageCode.ActivationShip)
+    cid, body = codecs.encode_body(MessageCode.ActivationShip, acts)
+    x_hat = codecs.decode_body(MessageCode.ActivationShip, cid,
+                               body, n=acts.size)
+    assert (abs(acts - x_hat) <= codecs.int8_bound(acts, plane.param)).all()
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from distributed_ml_pytorch_tpu.utils.compress import (
+    CODEC_DENSE,
+    CODEC_INT8,
+    CODEC_NAMES,
+    CODEC_TOPK,
+    CompressionError,
+    Int8Codec,
+    TopKCodec,
+    _CODECS_BY_ID,
+)
+
+#: codec ids 0-2 live in utils/compress.py; the codec plane adds the
+#: exact token-packing rung (two sub-2^16 ids per float32 word)
+CODEC_TOK16 = 3
+
+#: loss-contract vocabulary (the totality test pins membership)
+CONTRACTS = ("exact", "bounded", "error-feedback")
+
+
+class Tok16Codec:
+    """EXACT packing of non-negative integer ids below 2^16: two ids per
+    float32 word (bit-pattern packing — the wire carries the words as
+    opaque 4-byte lanes; values are recovered bit-for-bit, never via
+    float arithmetic). Token histories are what serving migration must
+    preserve EXACTLY: the resumed stream re-prefills from these ids, so
+    token identity of a migrated stream is a property of this codec."""
+
+    id = CODEC_TOK16
+    name = "tok16"
+
+    @property
+    def param(self) -> int:
+        return 0
+
+    def encode(self, x: np.ndarray) -> np.ndarray:
+        ids = np.asarray(x).ravel()
+        ii = np.rint(ids).astype(np.int64)
+        if ids.size and (np.abs(np.asarray(ids, np.float64) - ii).max()
+                         > 0):
+            raise ValueError("tok16 encodes integer ids only")
+        if ids.size and ((ii < 0).any() or (ii >= (1 << 16)).any()):
+            raise ValueError("tok16 ids must be in [0, 2^16)")
+        u = ii.astype(np.uint16)
+        if u.size % 2:
+            u = np.concatenate([u, np.zeros(1, np.uint16)])
+        return u.view(np.float32).copy()
+
+    def decode(self, body: np.ndarray, n: int, param: int) -> np.ndarray:
+        body = np.ascontiguousarray(np.asarray(body, np.float32).ravel())
+        if body.size != (n + 1) // 2:
+            raise CompressionError(
+                f"tok16 body holds {body.size} words, expected "
+                f"{(n + 1) // 2} for n={n}")
+        u = body.view(np.uint16)[:n]
+        return u.astype(np.float32)
+
+    def wire_floats(self, n: int) -> int:
+        return (n + 1) // 2
+
+
+# the compress-module decode tables learn the new rung, so a uniform
+# frame decoder (decode_update / WAL replay) resolves it like any other
+_CODECS_BY_ID.setdefault(CODEC_TOK16, Tok16Codec)
+CODEC_NAMES.setdefault(CODEC_TOK16, "tok16")
+
+
+@dataclasses.dataclass(frozen=True)
+class WirePlane:
+    """One coded wire: which codec ids may ride it, under what loss
+    contract, and the fixed codec parameter both ends share."""
+
+    code_name: str            # MessageCode member name (the schema key)
+    contract: str             # one of CONTRACTS
+    codec_ids: Tuple[int, ...]  # admissible codec ids on this wire
+    default_id: int           # what encode_body picks when unspecified
+    param: int                # shared codec parameter (int8 block size)
+    k_frac: float             # top-k fraction where CODEC_TOPK is legal
+    bound: Optional[str]      # the stated bound, for bounded planes
+    fallback: str             # what restores exactness when lossy fails
+
+    def __post_init__(self):
+        if self.contract not in CONTRACTS:
+            raise ValueError(
+                f"unknown loss contract {self.contract!r} "
+                f"(vocabulary: {CONTRACTS})")
+        if self.default_id not in self.codec_ids:
+            raise ValueError(
+                f"default codec {self.default_id} not admissible on "
+                f"{self.code_name} ({self.codec_ids})")
+
+
+#: int8 block sizes per plane — small enough that one activation
+#: outlier cannot crush a whole microbatch's resolution, big enough
+#: that the per-block f32 scale stays a rounding error of the wire
+ACT_BLOCK = 256
+DELTA_BLOCK = 1024
+KV_BLOCK = 128
+
+#: the registry: every WIRE_SCHEMAS entry declaring a ``codec`` field
+#: MUST appear here (and nothing else may) — tests/test_codecs.py
+#: cross-checks both directions against the schema table.
+WIRE_PLANES: Dict[str, WirePlane] = {
+    "CompressedUpdate": WirePlane(
+        code_name="CompressedUpdate", contract="error-feedback",
+        codec_ids=(CODEC_INT8, CODEC_TOPK), default_id=CODEC_INT8,
+        param=DELTA_BLOCK, k_frac=0.01, bound=None,
+        fallback="per-worker CompressingEncoder residual (what a push "
+                 "could not represent rides the next push)"),
+    "ActivationShip": WirePlane(
+        code_name="ActivationShip", contract="bounded",
+        codec_ids=(CODEC_DENSE, CODEC_INT8), default_id=CODEC_INT8,
+        param=ACT_BLOCK, k_frac=0.0,
+        bound="|x - x̂| <= max(absmax_block, 1e-12)/127 / 2 per element",
+        fallback="token/target/loss kinds ride CODEC_DENSE (exact); "
+                 "int8 is legal for activations only"),
+    "ActivationGrad": WirePlane(
+        code_name="ActivationGrad", contract="bounded",
+        codec_ids=(CODEC_DENSE, CODEC_INT8), default_id=CODEC_INT8,
+        param=ACT_BLOCK, k_frac=0.0,
+        bound="|x - x̂| <= max(absmax_block, 1e-12)/127 / 2 per element",
+        fallback="CODEC_DENSE (exact) when the stage is configured "
+                 "uncompressed"),
+    "DeltaParams": WirePlane(
+        code_name="DeltaParams", contract="error-feedback",
+        codec_ids=(CODEC_DENSE, CODEC_INT8, CODEC_TOPK),
+        default_id=CODEC_TOPK, param=DELTA_BLOCK, k_frac=0.02, bound=None,
+        fallback="full dense reply (CODEC_DENSE install) on version "
+                 "miss, epoch change, restore, or rebalance — the "
+                 "drill/manifest machinery only ever sees bit-exact "
+                 "installs"),
+    "KvMigrate": WirePlane(
+        code_name="KvMigrate", contract="bounded",
+        codec_ids=(CODEC_DENSE, CODEC_INT8), default_id=CODEC_INT8,
+        param=KV_BLOCK, k_frac=0.0,
+        bound="|kv - k̂v| <= max(absmax_block, 1e-12)/127 / 2 per element",
+        fallback="token history rides Tok16 (exact) in the same frame; "
+                 "the resumed stream re-prefills from it, so token "
+                 "identity never depends on the KV rung"),
+}
+
+
+def plane_for(code) -> Optional[WirePlane]:
+    """The registered plane for a MessageCode (or its name), else None."""
+    name = getattr(code, "name", code)
+    return WIRE_PLANES.get(str(name))
+
+
+def coded_wires() -> Dict[str, WirePlane]:
+    """Name -> plane for every registered coded wire (a copy)."""
+    return dict(WIRE_PLANES)
+
+
+def _instance(codec_id: int, plane: WirePlane):
+    if codec_id == CODEC_INT8:
+        return Int8Codec(block=plane.param)
+    if codec_id == CODEC_TOPK:
+        return TopKCodec(k_frac=plane.k_frac)
+    if codec_id == CODEC_TOK16:
+        return Tok16Codec()
+    raise CompressionError(f"unknown codec id {codec_id}")
+
+
+def encode_body(code, x: np.ndarray, codec_id: Optional[int] = None,
+                ) -> Tuple[int, np.ndarray]:
+    """Registry-routed body encode for one coded wire: ``(codec_id,
+    body)``. ``codec_id=None`` picks the plane's default; anything not
+    admissible on the plane is refused loudly (a send site cannot quietly
+    put a lossy rung on an exact wire)."""
+    plane = plane_for(code)
+    if plane is None:
+        raise CompressionError(
+            f"{getattr(code, 'name', code)} is not a registered coded "
+            "wire (utils/codecs.WIRE_PLANES)")
+    cid = plane.default_id if codec_id is None else int(codec_id)
+    if cid not in plane.codec_ids:
+        raise CompressionError(
+            f"codec id {cid} is not admissible on {plane.code_name} "
+            f"(allowed: {plane.codec_ids})")
+    x = np.asarray(x, np.float32).ravel()
+    if cid == CODEC_DENSE:
+        return cid, x
+    return cid, _instance(cid, plane).encode(x)
+
+
+def decode_body(code, codec_id: int, body: np.ndarray, n: int,
+                ) -> np.ndarray:
+    """Registry-routed body decode: the receiver names the wire and the
+    frame names the codec; the plane supplies the shared parameter. A
+    codec id the plane never admits is a malformed frame, not a decode."""
+    plane = plane_for(code)
+    if plane is None:
+        raise CompressionError(
+            f"{getattr(code, 'name', code)} is not a registered coded "
+            "wire (utils/codecs.WIRE_PLANES)")
+    cid = int(codec_id)
+    if cid not in plane.codec_ids:
+        raise CompressionError(
+            f"codec id {cid} is not admissible on {plane.code_name} "
+            f"(allowed: {plane.codec_ids})")
+    body = np.asarray(body, np.float32).ravel()
+    if cid == CODEC_DENSE:
+        if body.size != n:
+            raise CompressionError(
+                f"dense body holds {body.size} floats, expected {n}")
+        return body.copy()
+    codec = _instance(cid, plane)
+    return codec.decode(body, n, plane.param)
+
+
+def wire_floats(code, n: int, codec_id: Optional[int] = None) -> int:
+    """Exact body floats one frame of ``n`` elements costs on this wire
+    under ``codec_id`` (default: the plane's default) — the bench's
+    frame arithmetic, not an estimate."""
+    plane = plane_for(code)
+    if plane is None:
+        raise CompressionError(
+            f"{getattr(code, 'name', code)} is not a registered coded "
+            "wire (utils/codecs.WIRE_PLANES)")
+    cid = plane.default_id if codec_id is None else int(codec_id)
+    if cid == CODEC_DENSE:
+        return int(n)
+    return int(_instance(cid, plane).wire_floats(int(n)))
+
+
+def int8_bound(x: np.ndarray, block: int) -> np.ndarray:
+    """The per-element absolute-error allowance of the int8 per-block
+    absmax recipe over ``x``: ``scale_block / 2`` broadcast to each
+    element — what the ``bounded`` contract promises and the numerics
+    tests assert elementwise."""
+    x = np.asarray(x, np.float32).ravel()
+    n = x.size
+    nblocks = -(-n // block)
+    padded = np.zeros(nblocks * block, np.float32)
+    padded[:n] = x
+    absmax = np.max(np.abs(padded.reshape(nblocks, block)), axis=1)
+    scales = np.maximum(absmax / 127.0, 1e-12).astype(np.float32)
+    return np.repeat(scales / 2.0, block)[:n]

@@ -138,6 +138,9 @@ class MessageCode(enum.IntEnum):
     PreemptDone = 36
     SlotGrant = 37
     ResumeRequest = 38
+    # --- codec plane (ISSUE 18): delta pull replies + KV migration ---
+    DeltaParams = 39
+    KvMigrate = 40
 
 
 #: dedup-key vocabulary (ISSUE 13): WHICH receiver-side guard makes an
@@ -260,9 +263,14 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
         dedup_key="idempotent",
         doc="central flat params (server push / construction install)"),
     MessageCode.ParameterRequest: PayloadSchema(
-        handled_by=("ps", "coord"),
+        rest="held", handled_by=("ps", "coord"),
         dedup_key="idempotent",
-        doc="empty pull request (also the TCP hello frame)"),
+        doc="pull request (also the TCP hello frame). Empty = legacy "
+            "full pull. A delta-enabled worker appends its held stamp "
+            "[held_epoch, held_ver_lo, held_ver_hi] (ISSUE 18): the "
+            "server may then answer with a DeltaParams frame against "
+            "exactly that (epoch, version) instead of the dense reply; "
+            "held_epoch -1 forces a full reply (first pull / base miss)"),
     MessageCode.GradientUpdate: PayloadSchema(
         rest="params", handled_by=("ps", "coord"),
         dedup_key="env_seq", durability="wal_before_ack",
@@ -446,7 +454,8 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
             "snapshot at apply_seq under this map version; all-reported "
             "completes the rollback barrier (MTTR measured)"),
     MessageCode.ActivationShip: PayloadSchema(
-        fields=("step_lo", "step_hi", "mb", "kind", "ver_lo", "ver_hi"),
+        fields=("step_lo", "step_hi", "mb", "kind", "ver_lo", "ver_hi",
+                "codec"),
         rest="payload", rest_min=1, handled_by=("ps",),
         dedup_key="step_mb",
         doc="MPMD pipeline data plane (ISSUE 10): stage s -> s+1 activation "
@@ -454,16 +463,22 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
             "StagePlacement version. kind 0 = activation, 1 = tokens "
             "(driver -> first stage), 2 = targets (driver -> last stage), "
             "3 = per-microbatch ce_sum report (last stage -> driver). "
-            "Receivers dedup by (step, mb) so chaos dups, reliability "
-            "redelivery and watermark replay can never double-apply a "
-            "microbatch"),
+            "codec (ISSUE 18, utils/codecs.py) names the body encoding — "
+            "0 = dense f32 (mandatory for token/target/loss kinds: exact "
+            "contract), 1 = int8 per-block absmax for activations "
+            "(bounded contract, |x - x̂| <= scale/2); the receiver "
+            "DECODES before its size/finite gates. Receivers dedup by "
+            "(step, mb) so chaos dups, reliability redelivery and "
+            "watermark replay can never double-apply a microbatch"),
     MessageCode.ActivationGrad: PayloadSchema(
-        fields=("step_lo", "step_hi", "mb", "ver_lo", "ver_hi"),
+        fields=("step_lo", "step_hi", "mb", "ver_lo", "ver_hi", "codec"),
         rest="payload", rest_min=1, handled_by=("ps",),
         dedup_key="step_mb",
         doc="MPMD backward hand-off: stage s+1 -> s activation cotangent "
-            "for (step, microbatch); same (step, mb) dedup discipline as "
-            "ActivationShip (no microbatch's gradient applied twice)"),
+            "for (step, microbatch); same (step, mb) dedup discipline and "
+            "codec-plane discipline (ISSUE 18: 0 = dense, 1 = int8 "
+            "bounded) as ActivationShip (no microbatch's gradient applied "
+            "twice)"),
     MessageCode.StageReady: PayloadSchema(
         fields=("stage", "inc_lo", "inc_hi", "wm_lo", "wm_hi"),
         handled_by=("coord",),
@@ -537,6 +552,38 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
             "grant_id as a fresh life of `rank`, restoring snapshot "
             "snap_id bit-for-bit from the FleetManifest and replaying "
             "WAL'd deltas exactly once before rejoining the fleet"),
+    MessageCode.DeltaParams: PayloadSchema(
+        fields=("codec", "epoch", "base_lo", "base_hi", "ver_lo", "ver_hi",
+                "lo_lo", "lo_hi", "hi_lo", "hi_hi", "n_lo", "n_hi",
+                "crc_lo", "crc_hi"),
+        rest="body", rest_min=1, handled_by=("ps",),
+        dedup_key="version",
+        doc="server -> worker delta pull reply (ISSUE 18, utils/codecs.py "
+            "DeltaParams plane, error-feedback contract): the body decodes "
+            "to central[lo:hi) MINUS the worker's held base at (epoch, "
+            "base version) — the server tracks each worker's exact "
+            "materialized view, so base + decoded == central - residual "
+            "holds exactly by construction. codec 0 = dense FULL install "
+            "(the fallback rung: version miss, epoch change, restore, "
+            "rebalance), 2 = top-k delta (the steady-state rung: the "
+            "inter-pull delta is naturally sparse). A worker applies a "
+            "delta only when (epoch, base) equals its held stamp, else it "
+            "drops the reply and re-pulls full; crc guards the body like "
+            "CompressedUpdate"),
+    MessageCode.KvMigrate: PayloadSchema(
+        fields=("codec", "id_lo", "id_hi", "n_tok_lo", "n_tok_hi",
+                "n_kv_lo", "n_kv_hi", "crc_lo", "crc_hi"),
+        rest="handoff", rest_min=1, handled_by=("serving",),
+        dedup_key="request_id",
+        doc="serving migration handoff (ISSUE 18, utils/codecs.py "
+            "KvMigrate plane): the retiring engine's stream state for "
+            "request id — n_tok token-history ids packed EXACT via tok16 "
+            "(two ids per word; the resumed stream re-prefills from "
+            "these, so token identity never depends on the lossy rung), "
+            "then the slot's KV lane (n_kv elements) under `codec` (0 = "
+            "dense f32, 1 = int8 per-block absmax, the serving cache's "
+            "kv_quant recipe; bounded contract, verified at the "
+            "receiver). crc covers the whole handoff body"),
 }
 
 

@@ -124,6 +124,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "codec: codec-plane tests (utils/codecs.py — the WIRE_PLANES "
+        "registry's totality over codec-id-bearing WIRE_SCHEMAS, loss "
+        "contracts, the int8 bound, delta-reply identity, tok16 "
+        "exactness — ISSUE 18); `make codec` selects exactly these — "
+        "all fast, all in tier-1",
+    )
+    config.addinivalue_line(
+        "markers",
         "distmodel: bounded protocol model checking (analysis/"
         "distmodel.py — exactly-once / lease / watermark-replay "
         "invariants, the seeded-mutation soundness corpus, and the "

@@ -1,0 +1,233 @@
+"""ISSUE 18 — the codec plane registry (utils/codecs.py).
+
+Three layers:
+
+1. **Totality** — the ``WIRE_PLANES`` registry and the ``WIRE_SCHEMAS``
+   table agree in BOTH directions: every schema that declares a
+   ``codec`` field resolves to a registered plane, no plane names a wire
+   whose schema forgot the field, every admissible codec id resolves to
+   a real codec class, and every stated loss contract is vocabulary.
+2. **Numerics** — each contract's promise holds concretely: the int8
+   per-block-absmax bound elementwise (``|x - x̂| <= scale/2``), tok16
+   bit-exactness over the full id range, and the delta-reply identity
+   ``base + decoded_delta == central - residual`` BITWISE on the real
+   parameter server (the server's tracked base mirrors the worker by
+   replaying its own encode→decode).
+3. **Refusals** — a lossy rung on a wire that never admits it, a dense
+   body of the wrong size, and an unregistered wire are all loud errors
+   at the registry boundary, not silent corruption downstream.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.utils import codecs
+from distributed_ml_pytorch_tpu.utils.compress import (
+    CODEC_DENSE,
+    CODEC_INT8,
+    CODEC_NAMES,
+    CODEC_TOPK,
+    CompressionError,
+    _CODECS_BY_ID,
+)
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    MessageCode,
+    WIRE_SCHEMAS,
+)
+
+pytestmark = pytest.mark.codec
+
+
+# ------------------------------------------------------------- totality
+
+def _schema_codec_wires():
+    """MessageCode names whose schema declares a ``codec`` head field."""
+    return {code.name for code, schema in WIRE_SCHEMAS.items()
+            if "codec" in schema.fields}
+
+
+def test_every_codec_bearing_schema_resolves_to_a_plane():
+    missing = _schema_codec_wires() - set(codecs.WIRE_PLANES)
+    assert not missing, (
+        f"schemas declare a codec field but the registry has no plane: "
+        f"{sorted(missing)}")
+
+
+def test_every_plane_names_a_codec_bearing_schema():
+    ghosts = set(codecs.WIRE_PLANES) - _schema_codec_wires()
+    assert not ghosts, (
+        f"planes registered for wires whose schema declares no codec "
+        f"field: {sorted(ghosts)}")
+    for name, plane in codecs.WIRE_PLANES.items():
+        assert plane.code_name == name
+        assert hasattr(MessageCode, name)
+
+
+def test_every_plane_contract_is_vocabulary_and_stated():
+    for plane in codecs.WIRE_PLANES.values():
+        assert plane.contract in codecs.CONTRACTS
+        if plane.contract == "bounded":
+            assert plane.bound, (
+                f"{plane.code_name}: a bounded plane must state its "
+                "bound")
+        assert plane.fallback, (
+            f"{plane.code_name}: every lossy plane must name what "
+            "restores exactness")
+
+
+def test_every_admissible_codec_id_is_registered():
+    for plane in codecs.WIRE_PLANES.values():
+        assert plane.default_id in plane.codec_ids
+        for cid in plane.codec_ids:
+            assert cid in CODEC_NAMES, (
+                f"{plane.code_name} admits unnamed codec id {cid}")
+            if cid != CODEC_DENSE:
+                assert cid in _CODECS_BY_ID, (
+                    f"{plane.code_name} admits codec id {cid} with no "
+                    "registered codec class")
+
+
+def test_plane_for_accepts_code_and_name():
+    plane = codecs.plane_for(MessageCode.ActivationShip)
+    assert plane is codecs.plane_for("ActivationShip")
+    assert plane is not None and plane.contract == "bounded"
+    assert codecs.plane_for(MessageCode.GradientUpdate) is None
+
+
+def test_tok16_rung_is_registered_in_the_compress_tables():
+    assert _CODECS_BY_ID[codecs.CODEC_TOK16] is codecs.Tok16Codec
+    assert CODEC_NAMES[codecs.CODEC_TOK16] == "tok16"
+
+
+# ------------------------------------------------------------- numerics
+
+@pytest.mark.parametrize("code", [MessageCode.ActivationShip,
+                                  MessageCode.ActivationGrad,
+                                  MessageCode.KvMigrate])
+def test_int8_bound_holds_elementwise(code):
+    rng = np.random.default_rng(18)
+    plane = codecs.plane_for(code)
+    # mixed scales across blocks, an outlier, and a zero block
+    x = (rng.standard_normal(5 * plane.param + 37)
+         .astype(np.float32))
+    x[: plane.param] *= 1e3
+    x[plane.param: 2 * plane.param] = 0.0
+    x[7] = 512.0
+    cid, body = codecs.encode_body(code, x, CODEC_INT8)
+    assert cid == CODEC_INT8
+    x_hat = codecs.decode_body(code, cid, body, x.size)
+    allow = codecs.int8_bound(x, plane.param)
+    assert (np.abs(x - x_hat) <= allow).all(), (
+        np.max(np.abs(x - x_hat) - allow))
+
+
+def test_int8_wire_is_at_least_3x_smaller():
+    n = 4 * codecs.ACT_BLOCK
+    coded = codecs.wire_floats(MessageCode.ActivationShip, n, CODEC_INT8)
+    assert coded * 3 <= n
+
+
+def test_tok16_roundtrip_is_bit_exact_over_the_full_range():
+    tok = codecs.Tok16Codec()
+    for ids in ([0], [65535], [0, 1, 2], list(range(1000)),
+                [65535, 0, 32768, 17]):
+        x = np.asarray(ids, np.float32)
+        body = tok.encode(x)
+        assert body.size == tok.wire_floats(x.size) == (x.size + 1) // 2
+        back = tok.decode(body, x.size, 0)
+        assert back.dtype == np.float32
+        assert np.array_equal(back, x)
+
+
+def test_tok16_refuses_non_ids():
+    tok = codecs.Tok16Codec()
+    with pytest.raises(ValueError):
+        tok.encode(np.asarray([1.5], np.float32))
+    with pytest.raises(ValueError):
+        tok.encode(np.asarray([-1.0], np.float32))
+    with pytest.raises(ValueError):
+        tok.encode(np.asarray([65536.0], np.float32))
+    with pytest.raises(CompressionError):
+        tok.decode(np.zeros(3, np.float32), 4, 0)
+
+
+def test_dense_rung_is_the_identity():
+    x = np.arange(9, dtype=np.float32)
+    cid, body = codecs.encode_body(MessageCode.DeltaParams, x,
+                                   CODEC_DENSE)
+    assert cid == CODEC_DENSE and np.array_equal(body, x)
+    assert np.array_equal(
+        codecs.decode_body(MessageCode.DeltaParams, cid, body, 9), x)
+
+
+def test_delta_reply_identity_is_bitwise_on_the_real_server(tmp_path):
+    """``base + decoded_delta == central - residual`` EXACTLY: the
+    server updates its tracked base by replaying its own encode→decode,
+    so the tracked mirror and the worker's installed view are the same
+    float32 bytes after every reply — full or lossy delta alike."""
+    from distributed_ml_pytorch_tpu.parallel.async_ps import (
+        Listener,
+        ParameterServer,
+    )
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        InProcessTransport,
+    )
+
+    world = InProcessTransport.create_world(2)
+    try:
+        ps = ParameterServer(params=np.zeros(64, np.float32),
+                             transport=world[0], ckpt_dir=str(tmp_path),
+                             ckpt_every=0, wal=True)
+        lst = Listener(transport=world[1])
+
+        def exchange():
+            ps.handle(1, MessageCode.ParameterRequest, lst.held_stamp())
+            msg = world[1].recv(timeout=0.5)
+            assert msg is not None
+            lst.receive(msg[0], msg[1], msg[2])
+
+        rng = np.random.default_rng(7)
+        exchange()  # full install
+        for _ in range(3):
+            ps.handle(1, MessageCode.GradientUpdate,
+                      rng.standard_normal(64).astype(np.float32))
+            ps.commit()
+            exchange()  # top-k delta installs
+        assert ps.delta_replies >= 3 and lst.delta_installs >= 3
+        base = ps._pull_bases[1][2]
+        # the identity, rearranged: view == central - residual where
+        # residual = central - view is exactly what the NEXT delta ships
+        assert np.array_equal(base, lst._view)
+        residual = ps.central - base
+        assert np.array_equal(base + (ps.central - base) - residual, base)
+        # one more pull drains the residual's representable part and the
+        # mirror still matches bitwise
+        exchange()
+        assert np.array_equal(ps._pull_bases[1][2], lst._view)
+    finally:
+        for t in world.values():
+            t.close()
+
+
+# ------------------------------------------------------------- refusals
+
+def test_lossy_rung_refused_on_inadmissible_wire():
+    x = np.ones(8, np.float32)
+    with pytest.raises(CompressionError, match="not admissible"):
+        codecs.encode_body(MessageCode.ActivationShip, x, CODEC_TOPK)
+    with pytest.raises(CompressionError, match="not admissible"):
+        codecs.decode_body(MessageCode.KvMigrate, CODEC_TOPK, x, 8)
+
+
+def test_dense_size_mismatch_is_malformed():
+    with pytest.raises(CompressionError, match="dense body"):
+        codecs.decode_body(MessageCode.ActivationShip, CODEC_DENSE,
+                           np.ones(4, np.float32), 5)
+
+
+def test_unregistered_wire_is_refused():
+    x = np.ones(4, np.float32)
+    with pytest.raises(CompressionError, match="not a registered"):
+        codecs.encode_body(MessageCode.GradientUpdate, x)
+    with pytest.raises(CompressionError, match="not a registered"):
+        codecs.wire_floats(MessageCode.CumAck, 4)

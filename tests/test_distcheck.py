@@ -1064,6 +1064,7 @@ _PROTO_MESSAGING = """
         Push = 0
         Join = 1
         Fleet = 2
+        Act = 3
 
     class PayloadSchema:
         def __init__(self, fields=(), rest=None, rest_min=0, handled_by=(),
@@ -1085,6 +1086,9 @@ _PROTO_MESSAGING = """
             fields=("v",), rest="tail", handled_by=("coord",),
             dedup_key="version",
             rest_sections=("ranks", "metrics"), rest_separator=-1.0),
+        MessageCode.Act: PayloadSchema(
+            fields=("codec",), rest="body", rest_min=1,
+            handled_by=("ps",), dedup_key="idempotent"),
     }
 """
 
@@ -1096,6 +1100,8 @@ _PROTO_SERVER = """
             if code == MessageCode.Push:
                 self.wal.append(self.seq, delta)
                 self.central += delta
+            if code == MessageCode.Act and payload.size >= 2:
+                self.acts = self.codec.decode(payload[1:])
 
         def commit(self):
             self.wal.sync()
@@ -1134,6 +1140,12 @@ _PROTO_SENDERS = """
         transport.send(MessageCode.Join,
                        np.asarray([float(inc)], np.float32))
         transport.send(MessageCode.Fleet, frame)
+
+    def ship_acts(transport, codec, acts):
+        cid, body = codec.encode_body(acts)
+        transport.send(MessageCode.Act,
+                       np.concatenate([np.asarray([float(cid)],
+                                                  np.float32), body]))
 """
 
 
@@ -1278,6 +1290,37 @@ def test_dc406_expiry_pop_and_park_ledger_above_durable_log(tmp_path):
     assert _codes(active) == ["DC406", "DC406"]
     attrs = sorted(f.message.split()[3] for f in active)
     assert attrs == ["self._parked_durable", "self.members"]
+
+
+def test_dc407_codec_frame_sent_around_the_registry(tmp_path):
+    """A send site that stamps a codec id on the frame head without any
+    registry encoder call in the enclosing function is flagged — the
+    body bypassed the codec plane."""
+    broken = _proto_files(**{"parallel/worker.py": _PROTO_SENDERS.replace(
+        """    def ship_acts(transport, codec, acts):
+        cid, body = codec.encode_body(acts)
+        transport.send(MessageCode.Act,
+                       np.concatenate([np.asarray([float(cid)],
+                                                  np.float32), body]))""",
+        """    def ship_acts(transport, codec, acts):
+        transport.send(MessageCode.Act,
+                       np.concatenate([np.asarray([1.0],
+                                                  np.float32), acts]))""")})
+    active, _ = _run(tmp_path, broken)
+    assert _codes(active) == ["DC407"]
+    assert "bypassed the codec plane" in active[0].message
+
+
+def test_dc407_exempts_the_messaging_layer(tmp_path):
+    """The layer that IS the plumbing may forward codec-bearing frames
+    without re-encoding (retransmits, envelope relays)."""
+    broken = _proto_files(**{"utils/messaging.py": _PROTO_MESSAGING + """
+
+    def relay(transport, frame):
+        transport.send(MessageCode.Act, frame)
+"""})
+    active, _ = _run(tmp_path, broken)
+    assert "DC407" not in _codes(active), [f.render() for f in active]
 
 
 def test_dc4xx_silent_without_protocol_annotations(tmp_path):

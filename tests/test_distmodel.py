@@ -146,6 +146,7 @@ def test_dropped_ack_rules_are_windowed_not_blackholes():
 @pytest.mark.parametrize("mutation", [
     "ack_before_fsync", "no_dedup", "no_seed_on_restore",
     "no_error_feedback", "decode_before_admission",
+    "stale_delta_base", "no_full_fallback_on_restore",
     "park_without_manifest", "double_grant_slot",
     "no_epoch_fence", "expire_on_restart", "forget_parked"])
 def test_counterexample_replays_on_real_stack(mutation, tmp_path):

@@ -212,15 +212,17 @@ def _unit_stage(tmp_path, stage=1, n_stages=4, M=2, mb=2, seq=8):
 
 
 def _ship_frame(step, mbi, kind, body):
+    # head: step(2) mb kind ver(2) codec — codec 0 = dense (ISSUE 18)
     return np.concatenate([
-        np.asarray([*_split16(step), float(mbi), float(kind), 0.0, 0.0],
+        np.asarray([*_split16(step), float(mbi), float(kind), 0.0, 0.0,
+                    0.0],
                    np.float32),
         np.asarray(body, np.float32).ravel()])
 
 
 def _grad_frame(step, mbi, body):
     return np.concatenate([
-        np.asarray([*_split16(step), float(mbi), 0.0, 0.0], np.float32),
+        np.asarray([*_split16(step), float(mbi), 0.0, 0.0, 0.0], np.float32),
         np.asarray(body, np.float32).ravel()])
 
 
@@ -437,3 +439,50 @@ def test_mpmd_speculation_standby_takeover():
     assert out["standby"].stats["updates"] > 0
     assert out["placement"].entries[1].rank == out["standby"].rank
     assert out["applied_ok"]
+
+
+def test_mpmd_acceptance_int8_activation_corridor(lock_witness):
+    """THE ISSUE 18 acceptance for the activation plane: the same 4-stage
+    chaos + stage-death scenario as above, but with activations and
+    activation-grads riding the registry's int8 rung — the loss
+    trajectory stays inside a tight corridor around the dense run, every
+    lossy frame ships >= 3x fewer floats (the analyzer's own wire-stats
+    counters, not an estimate), the 3 chaos logs are byte-identical, and
+    the 3 quantized trajectories are bitwise EQUAL to each other (the
+    codec is deterministic, so replay-after-death reconstructs the same
+    updates it would have applied fault-free)."""
+    steps = 8
+    corridor = mpmd_scenario(
+        base_dir=tempfile.mkdtemp(prefix="mpmd_qc_"), seed=0, steps=steps)
+    assert corridor["ok"], (corridor["errors"], corridor["events"])
+
+    logs, trajectories = [], []
+    for _rep in range(3):
+        out = mpmd_scenario(
+            base_dir=tempfile.mkdtemp(prefix="mpmd_q_"), seed=0,
+            steps=steps, kill_stage=1, kill_at_step=3, snapshot_at_step=1,
+            plan=default_mpmd_plan(0), act_codec="int8")
+        assert out["ok"], (out["errors"], out["events"])
+        assert out["stage_restarts"] == 1
+        assert out["applied_ok"]
+        assert out["chaos_counts"].get("drop", 0) > 0
+        # int8 is bounded-lossy, so the trajectory is NEAR the dense
+        # corridor rather than equal to it; the probe deviation is
+        # ~4e-4 relative, so 5e-3 catches a broken codec without
+        # flaking on quantization noise
+        np.testing.assert_allclose(out["losses"], corridor["losses"],
+                                   rtol=5e-3)
+        # the bytes actually dropped: every stage that shipped lossy
+        # frames shipped them >= 3x smaller than the dense bodies
+        lossy = {k: s for k, s in out["stats"].items()
+                 if isinstance(s, dict) and s.get("act_dense_floats")}
+        assert lossy, out["stats"]
+        for name, s in lossy.items():
+            assert s["act_wire_floats"] * 3 <= s["act_dense_floats"], (
+                name, s["act_wire_floats"], s["act_dense_floats"])
+        logs.append(out["chaos_lines"])
+        trajectories.append(list(out["losses"]))
+    assert logs[0] and logs[0] == logs[1] == logs[2], (
+        "mpmd chaos log not byte-identical across int8 runs")
+    assert trajectories[0] == trajectories[1] == trajectories[2], (
+        "int8 activation codec is not deterministic across replays")
