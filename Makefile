@@ -231,6 +231,18 @@ bench-wire-bytes:
 lint:
 	$(PY) -m distributed_ml_pytorch_tpu.analysis --baseline tests/distcheck_baseline.txt
 
+# interprocedural dataflow corpus (ISSUE 19, analysis/distflow.py): the
+# DC501-504 seeded-bug/clean-twin tests plus the bounded-state runtime
+# witness tests — the checks themselves run inside `make lint`
+distflow:
+	$(PY) -m pytest tests/ -q -m distflow
+
+# lint wall-clock phase: times the full distcheck pass (all checker
+# families, distflow included) and gates it against the ceiling in
+# bench_floors.json — static analysis must stay cheap enough for tier-1
+bench-lint:
+	$(PY) bench_all.py --only lint
+
 # bounded protocol model checker (ISSUE 13, analysis/distmodel.py):
 # exhaustively explores small configurations of the extracted wire
 # protocol (2 workers x 2 updates PS; 2-life lease plane; 2x2 MPMD
@@ -271,4 +283,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-wire-bytes bench-health bench-gate bench-compute bench-mpmd bench-sched bench-coordfail timeline chaos codec coord coordfail drill drill-demo fleet health health-demo mpmd mpmd-demo netweather sched sched-demo soak lint distmodel test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-wire-bytes bench-health bench-gate bench-compute bench-mpmd bench-sched bench-coordfail bench-lint timeline chaos codec coord coordfail distflow drill drill-demo fleet health health-demo mpmd mpmd-demo netweather sched sched-demo soak lint distmodel test test-all verify-real-data graph install dist

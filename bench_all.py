@@ -2363,6 +2363,37 @@ def wire_bytes_phase() -> None:
             tr.close()
 
 
+def lint_phase() -> None:
+    """Price the static-analysis pass itself (ISSUE 19): one full
+    distcheck run — parse plus every checker family, the interprocedural
+    distflow pass included — raw (pre-suppression) findings counted.
+    `make test` fronts tier-1 with `make lint`, so the pass staying cheap
+    IS a product property; gated against ``lint_wall_clock_ceiling_s``
+    in bench_floors.json (a ceiling, not a floor: slower regresses)."""
+    from distributed_ml_pytorch_tpu.analysis import cli
+    from distributed_ml_pytorch_tpu.analysis.core import load_package
+
+    t0 = time.perf_counter()
+    pkg = load_package(cli.default_root())
+    parse_s = time.perf_counter() - t0
+    raw = []
+    for check in cli.CHECKERS:
+        raw.extend(check(pkg))
+    total_s = time.perf_counter() - t0
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_floors.json")) as fh:
+        ceiling = json.load(fh)["lint_wall_clock_ceiling_s"]
+    emit(8, "lint_full_pass_wall_clock", total_s, "s", "1-core host",
+         f"full distcheck: parse {parse_s:.2f}s + {len(cli.CHECKERS)} "
+         f"checker families over {len(pkg.files)} modules, {len(raw)} "
+         f"raw findings pre-suppression; ceiling {ceiling}s")
+    if total_s > ceiling:
+        raise RuntimeError(
+            f"lint wall clock {total_s:.2f}s exceeds the "
+            f"{ceiling}s ceiling in bench_floors.json — a checker "
+            "got expensive enough to tax every `make test` run")
+
+
 #: phases addressable via ``--only`` (``make bench-wire`` runs the wire
 #: legs without paying for the full table)
 PHASES = {
@@ -2381,6 +2412,7 @@ PHASES = {
     "transport_microbench": lambda: transport_microbench_phase(),
     "wire_bytes": lambda: wire_bytes_phase(),
     "compute_microbench": lambda: compute_microbench_phase(),
+    "lint": lambda: lint_phase(),
     "cpu_mesh": lambda: cpu_mesh_phase(),
     "multiprocess_psum": lambda: multiprocess_psum_phase(),
 }

@@ -33,6 +33,7 @@ from typing import List, Optional, Tuple
 
 from distributed_ml_pytorch_tpu.analysis import (
     concurrency,
+    distflow,
     protomodel,
     tracing_hygiene,
     wire,
@@ -47,7 +48,7 @@ from distributed_ml_pytorch_tpu.analysis.core import (
 )
 
 CHECKERS = (wire.check, protomodel.check, concurrency.check,
-            tracing_hygiene.check)
+            tracing_hygiene.check, distflow.check)
 
 
 def analyze(pkg: Package) -> Tuple[List[Finding], List[Finding]]:

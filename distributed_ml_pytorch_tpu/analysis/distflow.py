@@ -1,0 +1,893 @@
+"""distcheck DC5xx — interprocedural dataflow checks over receive paths.
+
+The DC4xx family checks protocol *points* (a WAL append exists, an
+incarnation compare exists somewhere). This family checks *flow*: what
+actually reaches state, and in what order, following the payload one
+call level deep (the DC404 follow discipline) and the lock graph the
+DC2xx pass already builds.
+
+- **DC501** — receive-path ordering. For every handler of a
+  ``WIRE_SCHEMAS`` code that declares a codec or CRC contract (a
+  ``codec``/``crc_lo`` field in the schema head), the payload value is
+  tainted and tracked through local assignments and one level of
+  ``self.m(...)`` delegation. Raw (undecoded) bytes reaching a WAL
+  append or ``self`` state mutation is the bug: the schema says the
+  decode/CRC/admission gate comes first. Constant-index head reads
+  (``payload[0]`` — the codec id, sizes, CRC words ride the head in
+  clear) and values produced *by* a gate call (``decode*``, ``*crc*``,
+  ``admit*``, ``validate*``, ``check*``, ``verify*``) are clean.
+- **DC502** — fenced-mutation gating. A handler of a ``fenced=True``
+  schema that mutates ``self`` state with no epoch evidence dominating
+  it — neither a ``strip_epoch`` call nor an epoch/fence comparison in
+  the enclosing dispatch function or the one-level followed body. Pure
+  counters (``+= <const>``) are exempt: dropping a stale frame *into a
+  stat* is the fence working, not the fence missing.
+- **DC503** — unbounded-state growth. A container attribute of a
+  Thread-target / serve-loop / handler class that grows under per-key
+  indexing (``d[k] = …``, ``.append``, ``.add``, ``.setdefault``) with
+  no prune anywhere in the class. Exempt: bounded constructors
+  (``deque(maxlen=…)``, ``Bounded*``/``Ring*``), attrs that are pruned
+  (``pop``/``del``/``clear``/rebuild-assignment outside ``__init__`` or
+  a ``prune``/``trim``/``evict`` helper call), WAL attrs (durable logs
+  are truncated by the checkpoint protocol, not the handler), keyed
+  upserts whose RHS reads the same container (rewrite-in-place
+  accumulators), presence-gated memos (``k in self.m`` / ``.get`` before
+  the insert — bounded by the key domain), and containers admission-
+  capped by an explicit ``len(self.m) < cap`` check. All exemptions
+  except the bounded constructor are *fallible* — they are exported via
+  :func:`bounded_exemptions` so the runtime witness can sample the real
+  containers at scenario teardown (the same static/runtime pairing the
+  lock witness does for DC202). Growth sites are a class's own; the
+  clearing evidence is searched over the package-internal inheritance
+  lineage.
+- **DC504** — blocking while holding a lock. ``sleep``/``fsync``/
+  ``wal.sync``/indefinite ``join()``/``wait()``/bare ``recv()``
+  reached while a ``with self._lock:`` scope is open, transitively
+  through same-class calls (the DC2xx ``calls``/``held_calls`` graph).
+  A ``wait()`` on a lock that is itself held is a condition-variable
+  wait (it releases) and is exempt.
+
+All four follow the opt-in discipline: DC501 needs a codec/CRC schema,
+DC502 needs a ``fenced=True`` schema, DC503/DC504 need thread or
+handler classes and locks — a tree without those shapes sees nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from distributed_ml_pytorch_tpu.analysis import concurrency, wire
+from distributed_ml_pytorch_tpu.analysis.core import (
+    Finding,
+    Package,
+    SourceFile,
+    call_name,
+    dotted_name,
+    message_code_names,
+    self_attr,
+    walk_list,
+)
+
+#: call names that count as the schema's decode/admission/integrity gate
+_GATE_RE = re.compile(r"decode|crc|admit|validate|verify|check", re.I)
+
+#: growth mutators for DC503 (per-key adds; AugAssign ``d[k] += 1`` needs
+#: an existing key and is a counter, not growth)
+_GROWERS = frozenset({"append", "appendleft", "add", "setdefault"})
+
+_PRUNERS = frozenset({
+    "pop", "popleft", "popitem", "clear", "remove", "discard",
+})
+
+_PRUNE_HELPER_RE = re.compile(r"prune|trim|evict|drop_after|truncat", re.I)
+
+_BOUNDED_CTOR_RE = re.compile(r"bounded|ring", re.I)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExemptContainer:
+    """A container DC503 saw growing but cleared via a fallible
+    exemption — the runtime witness samples these at teardown."""
+
+    path: str
+    cls: str
+    attr: str
+    line: int
+    reason: str
+
+
+# ------------------------------------------------------------ shared helpers
+
+def _enclosing_function(tree: ast.AST, line: int) -> Optional[ast.AST]:
+    best = None
+    for node in walk_list(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        end = getattr(node, "end_lineno", node.lineno)
+        if node.lineno <= line <= end and \
+                (best is None or node.lineno > best.lineno):
+            best = node
+    return best
+
+
+def _last_param(fn: Optional[ast.AST]) -> Optional[str]:
+    """The payload is the last parameter by convention
+    (``handle(self, sender, code, payload)``) — the fallback when the
+    dispatch test carries no ``payload.size`` guard to name it."""
+    if fn is None or not getattr(fn, "args", None):
+        return None
+    args = fn.args.args
+    if not args:
+        return None
+    name = args[-1].arg
+    return None if name == "self" else name
+
+
+def _file_functions(src: SourceFile) -> Dict[str, ast.FunctionDef]:
+    out: Dict[str, ast.FunctionDef] = {}
+    for node in walk_list(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+def _is_const_index(sl: ast.AST) -> bool:
+    return isinstance(sl, ast.Constant) and \
+        isinstance(sl.value, (int, str)) and not isinstance(sl.value, bool)
+
+
+def _collect_classes(pkg: Package) -> Dict[str, concurrency.ClassInfo]:
+    """The DC2xx class model (methods, locks, calls, thread entries) —
+    rebuilt here so DC503/DC504 see the same graph DC202/DC205 do.
+
+    Deliberately NOT merged (``_merge_inherited``): merging attributes a
+    base class's growth sites to every subclass (duplicate findings with
+    the wrong path) and loses bounded-ctor evidence whenever a subclass
+    shadows the base ``__init__``. DC503/DC504 instead analyze each
+    class's OWN methods and union the *evidence* over the lineage via
+    :func:`_lineage`."""
+    classes: Dict[str, concurrency.ClassInfo] = {}
+    for src in pkg:
+        for node in src.tree.body:
+            if isinstance(node, ast.ClassDef):
+                classes[node.name] = concurrency._collect_class(src, node)
+    # registers thread_entries as a side effect; DC203 findings are the
+    # concurrency pass's to report, not ours
+    concurrency._find_thread_targets(pkg, classes)
+    return classes
+
+
+def _lineage(classes: Dict[str, concurrency.ClassInfo],
+             info: concurrency.ClassInfo) -> List[concurrency.ClassInfo]:
+    """``info`` plus its transitive package-internal base classes."""
+    out: List[concurrency.ClassInfo] = []
+    seen: Set[str] = set()
+    queue = [info.name]
+    while queue:
+        name = queue.pop()
+        if name in seen or name not in classes:
+            continue
+        seen.add(name)
+        out.append(classes[name])
+        queue.extend(classes[name].bases)
+    return out
+
+
+def _class_spans(pkg: Package) -> Dict[Tuple[str, str], Tuple[int, int]]:
+    spans: Dict[Tuple[str, str], Tuple[int, int]] = {}
+    for src in pkg:
+        for node in src.tree.body:
+            if isinstance(node, ast.ClassDef):
+                spans[(src.path, node.name)] = (
+                    node.lineno, node.end_lineno or node.lineno)
+    return spans
+
+
+# ------------------------------------------------- DC501: receive ordering
+
+def _is_gate_call(node: ast.Call) -> bool:
+    return bool(_GATE_RE.search(call_name(node)))
+
+
+def _raw(expr: Optional[ast.AST], tainted: Set[str]) -> bool:
+    """Whether the VALUE of ``expr`` still carries raw payload bytes.
+    Gate-call results, comparisons and constant-index head reads are
+    clean; everything derived from a tainted name otherwise is raw."""
+    if expr is None:
+        return False
+    if isinstance(expr, ast.Name):
+        return expr.id in tainted
+    if isinstance(expr, ast.Subscript):
+        if _is_const_index(expr.slice):
+            return False  # head-field read: codec id / sizes / crc words
+        return _raw(expr.value, tainted)
+    if isinstance(expr, ast.Attribute):
+        return False  # metadata (.size, .shape); method calls via Call
+    if isinstance(expr, ast.Call):
+        if _is_gate_call(expr):
+            return False
+        if any(_raw(a, tainted) for a in expr.args):
+            return True
+        if any(_raw(kw.value, tainted) for kw in expr.keywords):
+            return True
+        if isinstance(expr.func, ast.Attribute):
+            return _raw(expr.func.value, tainted)
+        return False
+    if isinstance(expr, ast.BinOp):
+        return _raw(expr.left, tainted) or _raw(expr.right, tainted)
+    if isinstance(expr, ast.BoolOp):
+        return any(_raw(v, tainted) for v in expr.values)
+    if isinstance(expr, ast.UnaryOp):
+        return _raw(expr.operand, tainted)
+    if isinstance(expr, ast.IfExp):
+        return _raw(expr.body, tainted) or _raw(expr.orelse, tainted)
+    if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        return any(_raw(e, tainted) for e in expr.elts)
+    if isinstance(expr, ast.Dict):
+        return any(_raw(v, tainted) for v in expr.values if v is not None)
+    if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+        return _raw(expr.elt, tainted) or \
+            any(_raw(g.iter, tainted) for g in expr.generators)
+    if isinstance(expr, ast.DictComp):
+        return _raw(expr.value, tainted) or \
+            any(_raw(g.iter, tainted) for g in expr.generators)
+    if isinstance(expr, ast.Starred):
+        return _raw(expr.value, tainted)
+    if isinstance(expr, ast.NamedExpr):
+        return _raw(expr.value, tainted)
+    return False  # Compare, Constant, JoinedStr, Lambda, ...
+
+
+def _target_names(target: ast.AST) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in target.elts:
+            out.extend(_target_names(e))
+        return out
+    if isinstance(target, ast.Starred):
+        return _target_names(target.value)
+    return []
+
+
+def _self_target(target: ast.AST) -> Optional[str]:
+    """``self.X``, ``self.X[...]`` or ``self.X.Y`` as a mutation of X."""
+    attr = self_attr(target)
+    if attr is not None:
+        return attr
+    if isinstance(target, ast.Subscript):
+        return _self_target(target.value)
+    if isinstance(target, ast.Attribute):
+        return self_attr(target.value)
+    return None
+
+
+class _TaintWalker:
+    """Order-sensitive taint propagation over one handler body, with one
+    level of same-file ``self.m(raw_arg)`` follow (the DC404 budget)."""
+
+    def __init__(self, site: wire.HandlerSite, src: SourceFile,
+                 functions: Dict[str, ast.FunctionDef]):
+        self.site = site
+        self.src = src
+        self.functions = functions
+        self.sinks: List[Tuple[int, str]] = []  # (line, description)
+        self.followed: Set[str] = set()
+
+    def run(self, payload: str) -> List[Tuple[int, str]]:
+        self._stmts(self.site.body or [], {payload}, depth=0)
+        return self.sinks
+
+    # ------------------------------------------------------------ statements
+    def _stmts(self, stmts: Sequence[ast.stmt], tainted: Set[str],
+               depth: int) -> None:
+        for stmt in stmts:
+            self._stmt(stmt, tainted, depth)
+
+    def _stmt(self, stmt: ast.stmt, tainted: Set[str], depth: int) -> None:
+        for call in [n for n in ast.walk(stmt) if isinstance(n, ast.Call)]:
+            self._call(call, tainted, depth)
+        if isinstance(stmt, ast.Assign):
+            raw = _raw(stmt.value, tainted)
+            for target in stmt.targets:
+                self._assign_target(target, raw, tainted, stmt.lineno)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._assign_target(
+                stmt.target, _raw(stmt.value, tainted), tainted, stmt.lineno)
+        elif isinstance(stmt, ast.AugAssign):
+            raw = _raw(stmt.value, tainted)
+            attr = _self_target(stmt.target)
+            if raw and attr is not None:
+                self.sinks.append((stmt.lineno, f"self.{attr}"))
+            if raw:
+                for name in _target_names(stmt.target):
+                    tainted.add(name)
+        elif isinstance(stmt, (ast.If,)):
+            self._stmts(stmt.body, tainted, depth)
+            self._stmts(stmt.orelse, tainted, depth)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            if _raw(stmt.iter, tainted):
+                for name in _target_names(stmt.target):
+                    tainted.add(name)
+            self._stmts(stmt.body, tainted, depth)
+            self._stmts(stmt.orelse, tainted, depth)
+        elif isinstance(stmt, ast.While):
+            self._stmts(stmt.body, tainted, depth)
+            self._stmts(stmt.orelse, tainted, depth)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._stmts(stmt.body, tainted, depth)
+        elif isinstance(stmt, ast.Try):
+            self._stmts(stmt.body, tainted, depth)
+            for handler in stmt.handlers:
+                self._stmts(handler.body, tainted, depth)
+            self._stmts(stmt.orelse, tainted, depth)
+            self._stmts(stmt.finalbody, tainted, depth)
+
+    def _assign_target(self, target: ast.AST, raw: bool,
+                       tainted: Set[str], line: int) -> None:
+        attr = _self_target(target)
+        if raw and attr is not None:
+            self.sinks.append((line, f"self.{attr}"))
+        for name in _target_names(target):
+            if raw:
+                tainted.add(name)
+            else:
+                tainted.discard(name)  # reassigned from a gated value
+
+    # ----------------------------------------------------------------- calls
+    def _call(self, node: ast.Call, tainted: Set[str], depth: int) -> None:
+        if _is_gate_call(node):
+            return
+        args_raw = [_raw(a, tainted) for a in node.args]
+        kw_raw = {kw.arg: _raw(kw.value, tainted)
+                  for kw in node.keywords if kw.arg}
+        if not (any(args_raw) or any(kw_raw.values())):
+            return
+        if isinstance(node.func, ast.Attribute):
+            # mutator on self state (or a WAL receiver): raw bytes land
+            if node.func.attr in concurrency.MUTATORS:
+                base = _self_target(node.func.value)
+                recv = dotted_name(node.func.value) or ""
+                if base is not None or "wal" in recv:
+                    self.sinks.append(
+                        (node.lineno,
+                         f"self.{base}" if base is not None else recv))
+                    return
+            # one-level follow: self.m(raw, ...) delegates the gate
+            target = self_attr(node.func)
+            if target is not None and depth == 0 and \
+                    target not in self.followed and target in self.functions:
+                self.followed.add(target)
+                fn = self.functions[target]
+                params = [a.arg for a in fn.args.args if a.arg != "self"]
+                inner: Set[str] = set()
+                for i, is_raw in enumerate(args_raw):
+                    if is_raw and i < len(params):
+                        inner.add(params[i])
+                for name, is_raw in kw_raw.items():
+                    if is_raw and name in params:
+                        inner.add(name)
+                if inner:
+                    self._stmts(fn.body, inner, depth=1)
+
+
+def _check_receive_order(pkg: Package) -> List[Finding]:
+    schemas = wire.extract_schemas(pkg)
+    codec_codes = {c for c, s in schemas.items()
+                   if "codec" in s.fields or "crc_lo" in s.fields}
+    if not codec_codes:
+        return []
+    by_path = {src.path: src for src in pkg}
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for site in wire.extract_handlers(pkg):
+        if site.code not in codec_codes or site.body is None:
+            continue
+        src = by_path[site.path]
+        payload = site.payload_name or _last_param(
+            _enclosing_function(src.tree, site.line))
+        if payload is None:
+            continue
+        walker = _TaintWalker(site, src, _file_functions(src))
+        for line, desc in walker.run(payload):
+            key = (site.path, line, desc)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                site.path, line, "DC501",
+                f"MessageCode.{site.code} declares a codec/CRC contract "
+                f"but raw (undecoded) payload bytes reach {desc} here — "
+                "the decode/CRC/admission gate must come first"))
+    return findings
+
+
+# ----------------------------------------------- DC502: fenced-mutation gate
+
+def _fenced_codes(pkg: Package) -> Set[str]:
+    fenced: Set[str] = set()
+    for src in pkg:
+        for node in walk_list(src.tree):
+            if not (wire._is_schema_table(node)
+                    and isinstance(node.value, ast.Dict)):
+                continue
+            for key, val in zip(node.value.keys, node.value.values):
+                names = message_code_names(key) if key is not None else []
+                if len(names) != 1 or not isinstance(val, ast.Call):
+                    continue
+                for kw in val.keywords:
+                    if kw.arg == "fenced" and \
+                            isinstance(kw.value, ast.Constant) and \
+                            kw.value.value is True:
+                        fenced.add(names[0][0])
+    return fenced
+
+
+def _followed_nodes(site: wire.HandlerSite, src: SourceFile) -> List[ast.AST]:
+    """Handler body plus one level of same-file self-method delegation
+    (protomodel's DC404 follow)."""
+    nodes: List[ast.AST] = []
+    called: Set[str] = set()
+    for stmt in site.body or []:
+        for node in ast.walk(stmt):
+            nodes.append(node)
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self":
+                called.add(node.func.attr)
+    if called:
+        for node in walk_list(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in called:
+                nodes.extend(walk_list(node))
+    return nodes
+
+
+def _has_epoch_evidence(nodes: Sequence[ast.AST]) -> bool:
+    for node in nodes:
+        if isinstance(node, ast.Call) and \
+                "epoch" in call_name(node).lower():
+            return True  # strip_epoch / check_epoch — the fence plumbing
+        if isinstance(node, ast.Compare):
+            for side in (node.left, *node.comparators):
+                name = dotted_name(side)
+                if name and ("epoch" in name.lower()
+                             or "fence" in name.lower()):
+                    return True
+    return False
+
+
+def _counter_augassign(node: ast.AST) -> bool:
+    return isinstance(node, ast.AugAssign) and \
+        isinstance(node.value, ast.Constant) and \
+        isinstance(node.value.value, (int, float))
+
+
+def _check_fenced_gate(pkg: Package) -> List[Finding]:
+    fenced = _fenced_codes(pkg)
+    if not fenced:
+        return []
+    by_path = {src.path: src for src in pkg}
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    for site in wire.extract_handlers(pkg):
+        if site.code not in fenced or site.body is None:
+            continue
+        src = by_path[site.path]
+        fn = _enclosing_function(src.tree, site.line)
+        scope: List[ast.AST] = list(walk_list(fn)) if fn is not None else []
+        scope += _followed_nodes(site, src)
+        if _has_epoch_evidence(scope):
+            continue
+        for stmt in site.body:
+            for node in ast.walk(stmt):
+                attr = None
+                if isinstance(node, (ast.Assign,)):
+                    for target in node.targets:
+                        attr = attr or _self_target(target)
+                elif isinstance(node, ast.AugAssign) and \
+                        not _counter_augassign(node):
+                    attr = _self_target(node.target)
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in concurrency.MUTATORS:
+                    attr = _self_target(node.func.value)
+                if attr is None:
+                    continue
+                key = (site.path, node.lineno)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    site.path, node.lineno, "DC502",
+                    f"MessageCode.{site.code} is a fenced frame but "
+                    f"self.{attr} is mutated with no epoch comparison "
+                    "dominating it — a zombie coordinator's stale command "
+                    "can rewrite live state"))
+    return findings
+
+
+# --------------------------------------------- DC503: unbounded state growth
+
+def _grow_sites(info: concurrency.ClassInfo) -> Dict[str, List[Tuple[int, bool]]]:
+    """attr → [(line, is_upsert)] growth sites outside construction."""
+    sites: Dict[str, List[Tuple[int, bool]]] = {}
+    for name, fn in info.methods.items():
+        if name in ("__init__", "__post_init__"):
+            continue
+        for node in walk_list(fn):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if not (isinstance(target, ast.Subscript)
+                            and not _is_const_index(target.slice)):
+                        continue
+                    attr = self_attr(target.value)
+                    if attr is None or "wal" in attr:
+                        continue
+                    upsert = any(
+                        isinstance(sub, ast.Attribute)
+                        and self_attr(sub) == attr
+                        or isinstance(sub, ast.Attribute)
+                        and self_attr(sub.value) == attr
+                        for sub in ast.walk(node.value))
+                    sites.setdefault(attr, []).append((node.lineno, upsert))
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _GROWERS:
+                attr = self_attr(node.func.value)
+                if attr is None or "wal" in attr:
+                    continue
+                sites.setdefault(attr, []).append((node.lineno, False))
+    return sites
+
+
+def _bounded_ctor_attrs(info: concurrency.ClassInfo) -> Set[str]:
+    out: Set[str] = set()
+    for fn in info.methods.values():
+        for node in walk_list(fn):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            ctor = call_name(node.value)
+            bounded = _BOUNDED_CTOR_RE.search(ctor) or any(
+                kw.arg == "maxlen" for kw in node.value.keywords)
+            if not bounded:
+                continue
+            for target in node.targets:
+                attr = self_attr(target)
+                if attr is not None:
+                    out.add(attr)
+    return out
+
+
+def _method_aliases(fn: ast.AST) -> Dict[str, Set[str]]:
+    """local name → the ``self`` attrs it may alias: ``d = self.m`` and
+    the batch-cleanup idiom ``for d in (self.a, self.b): d.pop(k)``."""
+    out: Dict[str, Set[str]] = {}
+    for node in walk_list(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            attr = self_attr(node.value)
+            if attr is not None:
+                out.setdefault(node.targets[0].id, set()).add(attr)
+        elif isinstance(node, (ast.For, ast.AsyncFor)) and \
+                isinstance(node.target, ast.Name) and \
+                isinstance(node.iter, (ast.Tuple, ast.List)):
+            attrs = {self_attr(e) for e in node.iter.elts} - {None}
+            if attrs:
+                out.setdefault(node.target.id, set()).update(attrs)
+    return out
+
+
+def _recv_attrs(expr: ast.AST, aliases: Dict[str, Set[str]]) -> Set[str]:
+    """The self attrs a receiver expression denotes (directly or via a
+    local alias)."""
+    attr = self_attr(expr)
+    if attr is not None:
+        return {attr}
+    if isinstance(expr, ast.Name):
+        return aliases.get(expr.id, set())
+    return set()
+
+
+def _pruned_attrs(info: concurrency.ClassInfo) -> Dict[str, int]:
+    """attr → line of the prune evidence (pop/del/clear/rebuild/helper),
+    seen through one level of local aliasing."""
+    out: Dict[str, int] = {}
+    for name, fn in info.methods.items():
+        in_init = name in ("__init__", "__post_init__")
+        aliases = _method_aliases(fn)
+        for node in walk_list(fn):
+            attrs: Set[str] = set()
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _PRUNERS:
+                    attrs = _recv_attrs(node.func.value, aliases)
+                elif _PRUNE_HELPER_RE.search(call_name(node)):
+                    for arg in node.args:
+                        attrs |= _recv_attrs(arg, aliases)
+            elif isinstance(node, ast.Delete):
+                for target in node.targets:
+                    if isinstance(target, ast.Subscript):
+                        attrs |= _recv_attrs(target.value, aliases)
+            elif isinstance(node, ast.Assign) and not in_init:
+                # a rebuild (`self.m = {k: v for ... if fresh}`) IS the
+                # frontier prune idiom — but only outside construction
+                for target in node.targets:
+                    a = self_attr(target)
+                    if a is not None:
+                        attrs.add(a)
+            for a in attrs:
+                out.setdefault(a, node.lineno)
+    return out
+
+
+def _handler_classes(pkg: Package,
+                     spans: Dict[Tuple[str, str], Tuple[int, int]]
+                     ) -> Set[Tuple[str, str]]:
+    out: Set[Tuple[str, str]] = set()
+    for site in wire.extract_handlers(pkg):
+        for (path, cls), (lo, hi) in spans.items():
+            if path == site.path and lo <= site.line <= hi:
+                out.add((path, cls))
+    return out
+
+
+def _memo_gated_attrs(info: concurrency.ClassInfo) -> Set[str]:
+    """Attrs whose inserts are presence-gated (``self.m.get(k)`` /
+    ``k in self.m`` before the write): a memo keyed by a finite domain
+    (peer rank, message code), not an open-ended log."""
+    out: Set[str] = set()
+    for fn in info.methods.values():
+        for node in walk_list(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("get", "__contains__"):
+                attr = self_attr(node.func.value)
+                if attr is not None:
+                    out.add(attr)
+            elif isinstance(node, ast.Compare) and any(
+                    isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+                for side in node.comparators:
+                    attr = self_attr(side)
+                    if attr is not None:
+                        out.add(attr)
+    return out
+
+
+def _len_gated_attrs(info: concurrency.ClassInfo) -> Set[str]:
+    """Attrs compared through ``len(self.m) < cap`` somewhere in the
+    class — an explicit admission cap on the container's size."""
+    out: Set[str] = set()
+    for fn in info.methods.values():
+        for node in walk_list(fn):
+            if not isinstance(node, ast.Compare):
+                continue
+            for side in (node.left, *node.comparators):
+                if isinstance(side, ast.Call) and \
+                        call_name(side) == "len" and side.args:
+                    attr = self_attr(side.args[0])
+                    if attr is not None:
+                        out.add(attr)
+    return out
+
+
+def _bounded_analysis(
+    pkg: Package, classes: Dict[str, concurrency.ClassInfo]
+) -> Tuple[List[Finding], List[ExemptContainer]]:
+    spans = _class_spans(pkg)
+    handler_cls = _handler_classes(pkg, spans)
+    findings: List[Finding] = []
+    exemptions: List[ExemptContainer] = []
+    for info in classes.values():
+        lineage = _lineage(classes, info)
+        long_running = any(c.thread_entries for c in lineage) or any(
+            (c.path, c.name) in handler_cls for c in lineage)
+        if not long_running:
+            continue
+        # growth sites come from this class's OWN methods; the evidence
+        # that clears them (bounded ctor, prune, gate) may live anywhere
+        # in the lineage — a base __init__ bounding what a subclass fills
+        grow = _grow_sites(info)
+        if not grow:
+            continue
+        bounded: Set[str] = set()
+        pruned: Dict[str, int] = {}
+        memo_gated: Set[str] = set()
+        len_gated: Set[str] = set()
+        for c in lineage:
+            bounded |= _bounded_ctor_attrs(c)
+            for a, ln in _pruned_attrs(c).items():
+                pruned.setdefault(a, ln)
+            memo_gated |= _memo_gated_attrs(c)
+            len_gated |= _len_gated_attrs(c)
+        for attr in sorted(grow):
+            line = grow[attr][0][0]
+            if attr in bounded:
+                continue  # deque(maxlen)/Bounded*: structurally bounded
+            if attr in pruned:
+                exemptions.append(ExemptContainer(
+                    info.path, info.name, attr, line,
+                    "pruned elsewhere in the class"))
+                continue
+            if all(upsert for _, upsert in grow[attr]):
+                exemptions.append(ExemptContainer(
+                    info.path, info.name, attr, line,
+                    "keyed upsert rewrites in place"))
+                continue
+            if attr in memo_gated:
+                exemptions.append(ExemptContainer(
+                    info.path, info.name, attr, line,
+                    "presence-gated memo (bounded by its key domain)"))
+                continue
+            if attr in len_gated:
+                exemptions.append(ExemptContainer(
+                    info.path, info.name, attr, line,
+                    "admission-capped by an explicit length check"))
+                continue
+            findings.append(Finding(
+                info.path, line, "DC503",
+                f"{info.name}.{attr} grows under per-key indexing/append "
+                f"with no prune, pop, maxlen or ring anywhere in "
+                f"{info.name} — long-running handler state leaks"))
+    return findings, exemptions
+
+
+def bounded_exemptions(pkg: Package) -> List[ExemptContainer]:
+    """The fallible DC503 exemptions — what the runtime bounded-state
+    witness watches at scenario teardown."""
+    return _bounded_analysis(pkg, _collect_classes(pkg))[1]
+
+
+# ------------------------------------------- DC504: blocking while locked
+
+def _blocking_desc(node: ast.Call, held: Tuple[str, ...]) -> Optional[str]:
+    name = call_name(node)
+    if name == "sleep":
+        return "sleep()"
+    if name == "fsync":
+        return "fsync()"
+    if name == "sync" and isinstance(node.func, ast.Attribute) and \
+            "wal" in (dotted_name(node.func.value) or ""):
+        return "wal.sync() (group fsync)"
+    if name == "join" and concurrency._is_thread_join(node) and \
+            not node.args and not node.keywords:
+        return "join() with no timeout"
+    timeout_kw = next(
+        (kw.value for kw in node.keywords if kw.arg == "timeout"), None)
+    none_timeout = isinstance(timeout_kw, ast.Constant) and \
+        timeout_kw.value is None
+    if name == "wait" and isinstance(node.func, ast.Attribute):
+        recv = self_attr(node.func.value)
+        if recv is not None and recv in held:
+            return None  # condition wait on the held lock: it releases
+        first_none = bool(node.args) and \
+            isinstance(node.args[0], ast.Constant) and \
+            node.args[0].value is None
+        if (not node.args and timeout_kw is None) or none_timeout \
+                or first_none:
+            return "wait() with no timeout"
+        return None
+    if name == "recv":
+        if (not node.args and not node.keywords) or none_timeout:
+            return "recv() with no timeout"
+        return None
+    if name == "sendall":
+        return "sendall()"
+    return None
+
+
+class _BlockFinder(ast.NodeVisitor):
+    """Track held ``with self.<lock>:`` scopes through one method and
+    record blocking calls (mirrors the DC2xx walker's lock scoping)."""
+
+    def __init__(self, lock_attrs: Dict[str, int]):
+        self.lock_attrs = lock_attrs
+        self.held: Tuple[str, ...] = ()
+        self.blocking: List[Tuple[Tuple[str, ...], str, int]] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            lock = concurrency._with_lock_attr(item, self.lock_attrs)
+            if lock is not None:
+                acquired.append(lock)
+            else:
+                self.visit(item.context_expr)
+        self.held = self.held + tuple(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        if acquired:
+            self.held = self.held[: len(self.held) - len(acquired)]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        desc = _blocking_desc(node, self.held)
+        if desc is not None:
+            self.blocking.append((self.held, desc, node.lineno))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        for stmt in node.body:  # nested defs share the creating scope
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _check_blocking_locked(
+    classes: Dict[str, concurrency.ClassInfo]
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for info in classes.values():
+        lineage = _lineage(classes, info)
+        lock_attrs: Dict[str, int] = {}
+        for c in lineage:
+            lock_attrs.update(c.lock_attrs)
+        if not lock_attrs:
+            continue
+        # direct findings come from this class's OWN methods only (a base
+        # class reports its own sites in its own pass), but the held
+        # scope recognizes inherited locks
+        direct: Dict[str, List[Tuple[str, int]]] = {}
+        for c in lineage:
+            for name, fn in c.methods.items():
+                if name in ("__init__", "__post_init__") or \
+                        (c is not info and name in info.methods):
+                    continue
+                finder = _BlockFinder(lock_attrs)
+                for stmt in fn.body:
+                    finder.visit(stmt)
+                for held, desc, line in finder.blocking:
+                    direct.setdefault(name, []).append((desc, line))
+                    if c is not info:
+                        continue
+                    for lock in held:
+                        findings.append(Finding(
+                            info.path, line, "DC504",
+                            f"{info.name}.{name}() does {desc} while "
+                            f"holding {info.name}.{lock} — every thread "
+                            "contending on that lock stalls behind the "
+                            "block"))
+        # transitive: a held call into a (chain of) blocking method(s),
+        # the call graph unioned over the lineage
+        blocks: Dict[str, Set[str]] = {
+            m: {d for d, _ in recs} for m, recs in direct.items()}
+        calls: Dict[str, Set[str]] = {}
+        for c in lineage:
+            for m, callees in c.calls.items():
+                calls.setdefault(m, set()).update(callees)
+        changed = True
+        while changed:
+            changed = False
+            for m, callees in calls.items():
+                for callee in callees:
+                    extra = blocks.get(callee, set()) - blocks.get(m, set())
+                    if extra:
+                        blocks.setdefault(m, set()).update(extra)
+                        changed = True
+        for held, callee, line in info.held_calls:
+            if not held or not blocks.get(callee):
+                continue
+            desc = sorted(blocks[callee])[0]
+            for lock in held:
+                findings.append(Finding(
+                    info.path, line, "DC504",
+                    f"{info.name} calls {callee}() while holding "
+                    f"{info.name}.{lock}, and {callee} (transitively) "
+                    f"does {desc} — the lock is held across the block"))
+    return findings
+
+
+# ------------------------------------------------------------------- entry
+
+def check(pkg: Package) -> List[Finding]:
+    findings = _check_receive_order(pkg)
+    findings += _check_fenced_gate(pkg)
+    classes = _collect_classes(pkg)
+    findings += _bounded_analysis(pkg, classes)[0]
+    findings += _check_blocking_locked(classes)
+    return findings

@@ -1,4 +1,5 @@
-"""Runtime lock-order witness — the dynamic half of DC202.
+"""Runtime witnesses — the dynamic half of DC202 (lock order) and of
+DC503's fallible exemptions (bounded state; ``BoundedStateWitness``).
 
 The static lock graph (``analysis/concurrency.py``) is an over-
 approximation built from lexical nesting; this witness observes the REAL
@@ -208,3 +209,104 @@ def maybe_install(package_root: Optional[str] = None) -> Optional[LockOrderWitne
     if not os.environ.get("DISTCHECK_WITNESS"):
         return None
     return LockOrderWitness(package_root).install()
+
+
+# ----------------------------------------------------- bounded-state witness
+
+class BoundedStateWitness:
+    """Runtime half of DC503's *fallible* exemptions.
+
+    The static pass clears a growing container when it sees prune/upsert/
+    memo evidence — but "there is a ``pop`` in the class" does not prove
+    the pop ever RUNS. This witness watches real containers and fails a
+    scenario whose watched container grew monotonically past its budget:
+    exactly the case where the static exemption was wrong.
+
+    Sampling is read-only (``len``) and happens between scenario rounds /
+    at teardown, never inside the traffic path — so the chaos suites'
+    byte-identical log guarantees are untouched.
+    """
+
+    def __init__(self, budget: int = 4096):
+        self.budget = int(budget)
+        self._watched: List[Tuple[str, object, int]] = []
+        self.series: Dict[str, List[int]] = {}
+
+    def watch(self, name: str, container: object,
+              budget: Optional[int] = None) -> None:
+        self._watched.append(
+            (name, container, self.budget if budget is None else int(budget)))
+        self.series.setdefault(name, [])
+
+    def sample(self) -> None:
+        for name, container, _ in self._watched:
+            try:
+                self.series[name].append(len(container))  # type: ignore[arg-type]
+            except TypeError:
+                pass  # not sized (witness config error) — nothing to say
+
+    def violations(self) -> List[str]:
+        """Watched containers whose sampled sizes only ever went up AND
+        ended past budget — growth with a plateau or a dip is a working
+        prune; growth that never once receded is the leak."""
+        budgets = {name: b for name, _, b in self._watched}
+        out = []
+        for name, sizes in sorted(self.series.items()):
+            if len(sizes) < 2 or sizes[-1] <= budgets.get(name, self.budget):
+                continue
+            if sizes[-1] > sizes[0] and \
+                    all(b >= a for a, b in zip(sizes, sizes[1:])):
+                out.append(
+                    f"{name}: grew {sizes[0]} -> {sizes[-1]} monotonically "
+                    f"over {len(sizes)} samples (budget "
+                    f"{budgets.get(name, self.budget)}) — the static DC503 "
+                    "exemption did not hold at runtime")
+        return out
+
+
+_EXEMPT_INDEX: Optional[Dict[Tuple[str, str], Set[str]]] = None
+
+
+def _exempt_index() -> Dict[Tuple[str, str], Set[str]]:
+    """(module, class) -> exempt attrs, from the static pass — memoized:
+    one package parse per process, only ever under DISTCHECK_WITNESS."""
+    global _EXEMPT_INDEX
+    if _EXEMPT_INDEX is None:
+        from distributed_ml_pytorch_tpu.analysis import cli, distflow
+        from distributed_ml_pytorch_tpu.analysis.core import load_package
+        idx: Dict[Tuple[str, str], Set[str]] = {}
+        for e in distflow.bounded_exemptions(load_package(cli.default_root())):
+            mod = "distributed_ml_pytorch_tpu." + \
+                e.path[:-len(".py")].replace("/", ".").split(
+                    "distributed_ml_pytorch_tpu.", 1)[-1]
+            idx.setdefault((mod, e.cls), set()).add(e.attr)
+        _EXEMPT_INDEX = idx
+    return _EXEMPT_INDEX
+
+
+def scan_exempt_sizes() -> List[Tuple[str, str, int]]:
+    """One gc pass: the current size of every DC503-exempt container on a
+    live package instance — ``(class, attr, len)`` rows."""
+    import gc
+
+    idx = _exempt_index()
+    out: List[Tuple[str, str, int]] = []
+    for obj in gc.get_objects():
+        t = type(obj)
+        attrs = idx.get((getattr(t, "__module__", ""), t.__name__))
+        if not attrs:
+            continue
+        for attr in attrs:
+            container = getattr(obj, attr, None)
+            try:
+                out.append((t.__name__, attr, len(container)))  # type: ignore[arg-type]
+            except TypeError:
+                pass
+    return out
+
+
+def check_exempt_budget(budget: int = 4096) -> List[Tuple[str, str, int]]:
+    """Teardown gate for the acceptance scenarios: any statically-exempt
+    container still holding more than ``budget`` entries when the scenario
+    is over means its prune/memo story didn't hold — fail loudly."""
+    return [row for row in scan_exempt_sizes() if row[2] > budget]

@@ -41,6 +41,7 @@ loop just feeds it a transport and wall time.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import logging
@@ -378,7 +379,7 @@ class Coordinator:
         self.scale_cooldown = float(scale_cooldown)
         self.on_scale = on_scale
         self._next_scale_at = 0.0
-        self.scale_advice: List[Tuple[str, dict]] = []
+        self.scale_advice = collections.deque(maxlen=256)  # advisory ring
         # --- numerical health plane (ISSUE 8) ---------------------------
         # Worker REPUTATION: with ``reputation_nacks > 0``, a worker whose
         # lease renewals report that many admission nacks since (re)joining
@@ -406,7 +407,9 @@ class Coordinator:
         self._next_rollback_at = 0.0
         self.rollbacks_done = 0
         self.rollbacks_abandoned = 0
-        self.rollback_mttrs: List[float] = []
+        # ring, not list: the coordinator outlives every rollback and a
+        # per-event list is exactly the DC503 leak class
+        self.rollback_mttrs = collections.deque(maxlen=256)
         self._fleet_best_loss: Optional[float] = None
         self._bad_loss_seen: Dict[int, int] = {}
         self._reputation_block: Dict[int, float] = {}  # rank -> until

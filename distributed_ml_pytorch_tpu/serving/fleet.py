@@ -41,6 +41,7 @@ sheds sooner, which is the point.
 
 from __future__ import annotations
 
+import collections
 import sys
 import threading
 import time
@@ -209,7 +210,9 @@ class FleetRouter(ServingFrontend):
         #: engines' queue/prefill/decode spans. Observational only.
         self.recorder = None
         self.parked = 0              # submits parked awaiting ANY engine
-        self._mttr: List[float] = []  # per-death seconds: detect -> resumed
+        #: per-death seconds (detect -> resumed); ring — a router that
+        #: survives many deaths must not keep every sample forever
+        self._mttr = collections.deque(maxlen=256)
         # --- codec plane (ISSUE 18): KvMigrate handoffs ------------------
         #: decoded handoffs parked by the loopback receiver, keyed by the
         #: dying stream's old route key: (token ids, kv lane or None)
@@ -619,7 +622,7 @@ class FleetAutoscaler:
         self.scaled_up = 0
         self.scaled_down = 0
         self.refused = 0
-        self.scale_up_mttr_s: List[float] = []
+        self.scale_up_mttr_s = collections.deque(maxlen=256)  # per-spawn ring
         self._pending_up: List[Tuple[float, int, float]] = []  # (t0, eid, beat0)
         self._spawning = 0  # in-flight scale-ups, counted toward max
         self._retiring = 0

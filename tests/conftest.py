@@ -55,6 +55,18 @@ def lock_witness():
     if w is not None:
         w.uninstall()
         assert not w.cycles(), w.report()
+        # bounded-state witness (ISSUE 19): at teardown, every container
+        # the static DC503 pass cleared via a fallible exemption must
+        # actually be within budget — read-only len() sampling, so the
+        # chaos suites' byte-identical log guarantees are untouched
+        from distributed_ml_pytorch_tpu.analysis.witness import (
+            check_exempt_budget,
+        )
+
+        over = check_exempt_budget()
+        assert not over, (
+            "DC503-exempt containers over budget at scenario teardown "
+            f"(cls, attr, len): {over}")
 
 
 def pytest_configure(config):
@@ -156,6 +168,14 @@ def pytest_configure(config):
         "— ISSUE 17); `make coordfail` selects exactly these — fast units "
         "run in tier-1, the 3x drill acceptance is additionally in "
         "slow_tests.txt",
+    )
+    config.addinivalue_line(
+        "markers",
+        "distflow: interprocedural dataflow lint tests (analysis/"
+        "distflow.py — DC501 receive ordering, DC502 fenced-mutation "
+        "gating, DC503 bounded state + the runtime bounded-state "
+        "witness, DC504 blocking-under-lock — ISSUE 19); `make "
+        "distflow` selects exactly these — all fast, all in tier-1",
     )
     config.addinivalue_line(
         "markers",

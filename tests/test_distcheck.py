@@ -1584,3 +1584,500 @@ def test_coord_client_progress_guarded():
             assert client._progress == (1, 2, 3.0, 0, 0, 0, 0.0, 0.0)
     finally:
         client.stop()
+
+
+# ------------------------------------------------- DC5xx: dataflow (ISSUE 19)
+#
+# One clean fixture package (codec-bearing Grad, fenced Cmd, a thread-pump
+# class, a lock-holding flusher) that the whole analyzer is SILENT on; each
+# seeded twin is a targeted mutation of one file, so every test pins both
+# the fire and the silence.
+
+_FLOW_MESSAGING = """
+    import enum
+
+    class MessageCode(enum.IntEnum):
+        Grad = 0
+        Cmd = 1
+
+    class PayloadSchema:
+        def __init__(self, fields=(), rest=None, rest_min=0, handled_by=(),
+                     dedup_key=None, fenced=False):
+            self.fields = fields
+            self.rest = rest
+            self.handled_by = handled_by
+            self.dedup_key = dedup_key
+            self.fenced = fenced
+
+    WIRE_SCHEMAS = {
+        MessageCode.Grad: PayloadSchema(
+            fields=("codec", "crc_lo"), rest="body", rest_min=1,
+            handled_by=("ps",), dedup_key="idempotent"),
+        MessageCode.Cmd: PayloadSchema(
+            fields=("epoch", "version"), rest="map",
+            handled_by=("coord",), dedup_key="version", fenced=True),
+    }
+"""
+
+_FLOW_SERVER = """
+    from fixturepkg.utils.messaging import MessageCode
+
+    class GradServer:
+        def handle(self, sender, code, payload):
+            if code == MessageCode.Grad and payload.size >= 3:
+                if not self.check_crc(payload):
+                    return
+                body = self.codec.decode(payload[2:])
+                self._apply(body)
+
+        def _apply(self, body):
+            self.acc += body
+"""
+
+_FLOW_HUB = """
+    from fixturepkg.utils.messaging import MessageCode
+
+    class CmdHub:
+        def handle(self, sender, code, payload):
+            if code == MessageCode.Cmd and payload.size >= 2:
+                if payload[0] < self.cmd_epoch:
+                    return
+                self.version = payload[1]
+                self.live_map = self.decode_map(payload[2:])
+"""
+
+_FLOW_PUMP = """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self.inbox = {}
+            self.seen = []
+            self._t = threading.Thread(target=self.run, daemon=True)
+
+        def run(self):
+            while True:
+                key, body = self.poll()
+                self.inbox[key] = body
+                self.seen.append(key)
+                self.compact()
+
+        def compact(self):
+            while len(self.seen) > 64:
+                old = self.seen.pop(0)
+                self.inbox.pop(old, None)
+"""
+
+_FLOW_FLUSHER = """
+    import threading
+    import time
+
+    class Flusher:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self._t = threading.Thread(target=self.run, daemon=True)
+
+        def run(self):
+            while True:
+                with self._mu:
+                    batch = self.drain()
+                self.wal.sync()
+                time.sleep(0.01)
+"""
+
+_FLOW_SENDERS = """
+    import numpy as np
+    from fixturepkg.utils.messaging import MessageCode
+
+    def push_grad(transport, codec, grad):
+        transport.send(MessageCode.Grad, codec.encode(grad))
+
+    def push_cmd(transport, frame):
+        transport.send(MessageCode.Cmd, frame)
+"""
+
+
+def _flow_files(**overrides):
+    files = {
+        "utils/messaging.py": _FLOW_MESSAGING,
+        "parallel/server.py": _FLOW_SERVER,
+        "coord/hub.py": _FLOW_HUB,
+        "utils/pump.py": _FLOW_PUMP,
+        "utils/flusher.py": _FLOW_FLUSHER,
+        "parallel/worker.py": _FLOW_SENDERS,
+    }
+    files.update(overrides)
+    return files
+
+
+@pytest.mark.distflow
+def test_flow_clean_twin_is_silent(tmp_path):
+    active, _ = _run(tmp_path, _flow_files())
+    assert not active, [f.render() for f in active]
+
+
+@pytest.mark.distflow
+def test_dc501_raw_bytes_applied_before_decode(tmp_path):
+    # the apply consumes the raw slice instead of the decoded body
+    broken = _flow_files(**{"parallel/server.py": _FLOW_SERVER.replace(
+        "self._apply(body)", "self.acc += payload[2:]")})
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC501"]
+    assert "raw (undecoded) payload bytes reach self.acc" in \
+        active[0].message
+
+
+@pytest.mark.distflow
+def test_dc501_interprocedural_raw_delegate(tmp_path):
+    # the handler delegates the RAW slice one call deep; the sink is in
+    # the callee — the one-level follow must carry the taint through
+    broken = _flow_files(**{"parallel/server.py": _FLOW_SERVER.replace(
+        "self._apply(body)", "self._apply(payload[2:])")})
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC501"]
+
+
+@pytest.mark.distflow
+def test_dc501_gate_wrapped_consumption_is_clean(tmp_path):
+    # consuming THROUGH the gate call in one expression is the contract
+    ok = _flow_files(**{"parallel/server.py": _FLOW_SERVER.replace(
+        "body = self.codec.decode(payload[2:])\n                self._apply(body)",
+        "self._apply(self.codec.decode(payload[2:]))")})
+    active, _ = _run(tmp_path, ok)
+    assert not active, [f.render() for f in active]
+
+
+@pytest.mark.distflow
+def test_dc502_fenced_mutation_without_epoch_gate(tmp_path):
+    broken = _flow_files(**{"coord/hub.py": _FLOW_HUB.replace(
+        "                if payload[0] < self.cmd_epoch:\n"
+        "                    return\n", "")})
+    active, _ = _run(tmp_path, broken)
+    assert set(_codes(active)) == {"DC502"}
+    assert all("fenced frame" in f.message for f in active)
+
+
+@pytest.mark.distflow
+def test_dc503_unbounded_handler_state(tmp_path):
+    leaky = _FLOW_PUMP.replace("                self.compact()\n", "") \
+        .replace("""
+        def compact(self):
+            while len(self.seen) > 64:
+                old = self.seen.pop(0)
+                self.inbox.pop(old, None)
+""", "")
+    assert "compact" not in leaky  # the seed really removed the prune
+    active, _ = _run(tmp_path, _flow_files(**{"utils/pump.py": leaky}))
+    assert _codes(active) == ["DC503", "DC503"]
+    assert {m.split(" grows")[0].split()[-1] for m in
+            (f.message for f in active)} == {"Pump.inbox", "Pump.seen"}
+
+
+@pytest.mark.distflow
+def test_dc503_pruned_containers_become_witness_exemptions(tmp_path):
+    """The clean pump's containers are cleared by FALLIBLE evidence, so
+    they must surface in bounded_exemptions() for the runtime witness."""
+    from distributed_ml_pytorch_tpu.analysis import distflow
+    from distributed_ml_pytorch_tpu.analysis.core import load_package
+
+    root = tmp_path / "fixturepkg"
+    for rel, text in _flow_files().items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(text))
+    exempt = distflow.bounded_exemptions(
+        load_package(str(root), rel_base=str(tmp_path)))
+    assert {(e.cls, e.attr) for e in exempt} >= {
+        ("Pump", "inbox"), ("Pump", "seen")}
+    assert all(e.reason for e in exempt)
+
+
+@pytest.mark.distflow
+def test_dc503_bounded_ctor_is_structural_not_watched(tmp_path):
+    """deque(maxlen=...) is structurally bounded: no finding AND no
+    witness watch entry."""
+    from distributed_ml_pytorch_tpu.analysis import distflow
+    from distributed_ml_pytorch_tpu.analysis.core import load_package
+
+    bounded = _FLOW_PUMP.replace(
+        "self.seen = []", "self.seen = collections.deque(maxlen=64)") \
+        .replace("import threading", "import collections\n    import threading")
+    root = tmp_path / "fixturepkg"
+    files = _flow_files(**{"utils/pump.py": bounded})
+    for rel, text in files.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(text))
+    pkg = load_package(str(root), rel_base=str(tmp_path))
+    assert not [f for f in distflow.check(pkg) if f.code == "DC503"]
+    assert ("Pump", "seen") not in {
+        (e.cls, e.attr) for e in distflow.bounded_exemptions(pkg)}
+
+
+@pytest.mark.distflow
+def test_dc503_memo_idiom_is_exempt_but_watched(tmp_path):
+    """A presence-gated insert (`if k in self.m: return` before `m[k]=`)
+    is a memo keyed by a finite domain — exempt, but witness-watched."""
+    from distributed_ml_pytorch_tpu.analysis import distflow
+    from distributed_ml_pytorch_tpu.analysis.core import load_package
+
+    memo = _FLOW_PUMP.replace("""
+                self.inbox[key] = body
+                self.seen.append(key)
+                self.compact()
+""", """
+                if key in self.inbox:
+                    continue
+                self.inbox[key] = body
+""").replace("""
+        def compact(self):
+            while len(self.seen) > 64:
+                old = self.seen.pop(0)
+                self.inbox.pop(old, None)
+""", "").replace("            self.seen = []\n", "")
+    root = tmp_path / "fixturepkg"
+    for rel, text in _flow_files(**{"utils/pump.py": memo}).items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(text))
+    pkg = load_package(str(root), rel_base=str(tmp_path))
+    assert not [f for f in distflow.check(pkg) if f.code == "DC503"]
+    assert ("Pump", "inbox") in {
+        (e.cls, e.attr) for e in distflow.bounded_exemptions(pkg)}
+
+
+@pytest.mark.distflow
+def test_dc504_direct_block_while_holding_lock(tmp_path):
+    broken = _flow_files(**{"utils/flusher.py": _FLOW_FLUSHER.replace(
+        """                with self._mu:
+                    batch = self.drain()
+                self.wal.sync()""",
+        """                with self._mu:
+                    batch = self.drain()
+                    self.wal.sync()""")})
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC504"]
+    assert "wal.sync() (group fsync) while holding Flusher._mu" in \
+        active[0].message
+
+
+@pytest.mark.distflow
+def test_dc504_transitive_block_through_same_class_call(tmp_path):
+    broken = _flow_files(**{"utils/flusher.py": _FLOW_FLUSHER.replace(
+        """                with self._mu:
+                    batch = self.drain()
+                self.wal.sync()
+                time.sleep(0.01)""",
+        """                with self._mu:
+                    self.flush()
+
+        def flush(self):
+            self.wal.sync()""")})
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC504"]
+    assert "transitively" in active[0].message
+
+
+@pytest.mark.distflow
+def test_dc504_condition_wait_on_held_lock_is_exempt(tmp_path):
+    # cv.wait() releases the lock it waits on — the held-lock wait is the
+    # condition-variable idiom, not a stall; waiting on a DIFFERENT
+    # object while holding the lock IS the stall
+    ok = _flow_files(**{"utils/flusher.py": _FLOW_FLUSHER.replace(
+        "                self.wal.sync()\n",
+        "                with self._mu:\n"
+        "                    self._mu.wait()\n")})
+    active, _ = _run(tmp_path, ok)
+    assert not active, [f.render() for f in active]
+    broken = _flow_files(**{"utils/flusher.py": _FLOW_FLUSHER.replace(
+        "                self.wal.sync()\n",
+        "                with self._mu:\n"
+        "                    self.done_evt.wait()\n")})
+    active, _ = _run(tmp_path, broken)
+    assert [f.code for f in active] == ["DC504"]
+
+
+@pytest.mark.distflow
+def test_dc5xx_suppression_with_reason(tmp_path):
+    leaky = _FLOW_PUMP.replace("                self.compact()\n", "") \
+        .replace("""
+        def compact(self):
+            while len(self.seen) > 64:
+                old = self.seen.pop(0)
+                self.inbox.pop(old, None)
+""", "").replace(
+        "self.seen.append(key)",
+        "self.seen.append(key)  # distcheck: ignore[DC503] audit capped by scenario length")
+    active, suppressed = _run(
+        tmp_path, _flow_files(**{"utils/pump.py": leaky}))
+    assert _codes(active) == ["DC503"]  # inbox still fires
+    assert _codes(suppressed) == ["DC503"]  # seen silenced with a reason
+
+
+@pytest.mark.distflow
+def test_bounded_witness_catches_wrongly_cleared_container(tmp_path):
+    """The DC503 prune exemption is textual — 'a pop exists in the
+    class' — so a prune that never RUNS still clears statically. The
+    runtime witness is the backstop: a watched container that only ever
+    grew across samples and ended past budget fails the scenario."""
+    from distributed_ml_pytorch_tpu.analysis import distflow
+    from distributed_ml_pytorch_tpu.analysis.core import load_package
+    from distributed_ml_pytorch_tpu.analysis.witness import (
+        BoundedStateWitness,
+    )
+
+    # static: the pump's containers are cleared by fallible prune
+    # evidence, so they are exactly what the witness watches
+    root = tmp_path / "fixturepkg"
+    for rel, text in _flow_files().items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(text))
+    exempt = {(e.cls, e.attr) for e in distflow.bounded_exemptions(
+        load_package(str(root), rel_base=str(tmp_path)))}
+    assert ("Pump", "inbox") in exempt
+
+    # runtime: this pump's compact() guard is dead — it never prunes
+    class Pump:
+        def __init__(self):
+            self.inbox = {}
+
+        def on_msg(self, key, body):
+            self.inbox[key] = body
+            self.compact()
+
+        def compact(self):
+            while len(self.inbox) > 10 ** 9:  # wrong threshold: dead
+                self.inbox.pop(next(iter(self.inbox)))
+
+    w = BoundedStateWitness(budget=100)
+    pump = Pump()
+    w.watch("Pump.inbox", pump.inbox, budget=100)
+    for i in range(200):
+        pump.on_msg(i, i)
+        if i % 20 == 0:
+            w.sample()
+    w.sample()
+    violations = w.violations()
+    assert violations and "Pump.inbox" in violations[0], violations
+
+    # a prune that actually runs produces a dip — no violation
+    w2 = BoundedStateWitness(budget=100)
+    working = Pump()
+    w2.watch("Pump.inbox", working.inbox, budget=100)
+    for i in range(200):
+        working.inbox[i] = i
+        if len(working.inbox) > 150:
+            working.inbox.clear()
+        if i % 20 == 0:
+            w2.sample()
+    w2.sample()
+    assert not w2.violations(), w2.violations()
+
+
+@pytest.mark.distflow
+def test_witness_gc_scan_flags_exempt_container_over_budget(monkeypatch):
+    """The teardown hook's auto-discovery: one gc pass finds live
+    instances of statically-exempt (class, attr) pairs and reports any
+    container over budget."""
+    from distributed_ml_pytorch_tpu.analysis import witness
+
+    class Scanned:
+        pass
+
+    monkeypatch.setattr(
+        witness, "_EXEMPT_INDEX",
+        {(Scanned.__module__, "Scanned"): {"box"}})
+    obj = Scanned()
+    obj.box = dict.fromkeys(range(5000))
+    assert ("Scanned", "box", 5000) in witness.check_exempt_budget(4096)
+    obj.box = {}
+    assert not witness.check_exempt_budget(4096)
+
+
+# -------------------------------------- ISSUE 19 real-tree DC503 regressions
+
+@pytest.mark.distflow
+def test_mpmd_driver_retires_ship_state():
+    """The DC503 fix in MpmdDriver: token/target bodies, ce reports and
+    corr ids for steps past the restart-replay window are dropped — the
+    driver no longer holds every (step, mb) it ever shipped."""
+    from distributed_ml_pytorch_tpu.parallel.mpmd import MpmdDriver
+
+    coord = types.SimpleNamespace(on_stage_assign=None)
+    d = MpmdDriver(None, coord, n_stages=2, n_microbatches=2)
+    for t in range(10):
+        for mbi in range(2):
+            d._tokens[(t, mbi)] = np.zeros(1, np.float32)
+            d._targets[(t, mbi)] = np.zeros(1, np.float32)
+            d._ce[(t, mbi)] = 0.0
+            d._mb_corr[(t, mbi)] = 7
+    d._retire_below(6)
+    for store in (d._tokens, d._targets, d._ce, d._mb_corr):
+        assert {k[0] for k in store} == {6, 7, 8, 9}
+    d._retire_below(0)  # no-op floor
+    assert {k[0] for k in d._tokens} == {6, 7, 8, 9}
+
+
+@pytest.mark.distflow
+def test_coordinator_metric_accumulators_are_rings():
+    """The DC503 fixes: per-event metric lists on long-running control
+    classes became rings — a long fleet lifetime cannot grow them
+    without bound."""
+    import collections
+
+    from distributed_ml_pytorch_tpu.coord.coordinator import Coordinator
+
+    c = Coordinator(None, 100, lease=10.0, speculation=False)
+    for ring in (c.rollback_mttrs, c.scale_advice):
+        assert isinstance(ring, collections.deque) and ring.maxlen
+        ring.extend([0.0] * (ring.maxlen + 10))
+        assert len(ring) == ring.maxlen
+
+
+# --------------------------------------------- analyzer totality (ISSUE 19)
+
+def test_every_emittable_code_is_documented_and_tested():
+    """Reflection over the findings engine: every DC code any checker can
+    emit must appear in DESIGN.md's checker documentation AND in at least
+    one corpus test — a future DC508 cannot ship undocumented/untested."""
+    import ast as _ast
+
+    repo = os.path.dirname(HERE)
+    adir = os.path.join(repo, "distributed_ml_pytorch_tpu", "analysis")
+    emittable = set()
+    for name in sorted(os.listdir(adir)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(adir, name)) as fh:
+            tree = _ast.parse(fh.read())
+        for node in _ast.walk(tree):
+            if not (isinstance(node, _ast.Call) and (
+                    getattr(node.func, "id", None) == "Finding"
+                    or getattr(node.func, "attr", None) == "Finding")):
+                continue
+            for arg in node.args:
+                if isinstance(arg, _ast.Constant) and \
+                        isinstance(arg.value, str) and \
+                        arg.value.startswith("DC") and \
+                        arg.value[2:].isdigit():
+                    emittable.add(arg.value)
+    # sanity: reflection actually saw the engine, including this PR's
+    assert {"DC001", "DC002", "DC501", "DC502", "DC503", "DC504"} <= \
+        emittable, sorted(emittable)
+
+    with open(os.path.join(repo, "DESIGN.md")) as fh:
+        design = fh.read()
+    undocumented = {c for c in emittable if c not in design}
+    assert not undocumented, (
+        f"DC codes emitted but absent from DESIGN.md: "
+        f"{sorted(undocumented)}")
+
+    corpus = ""
+    for tname in ("test_distcheck.py", "test_distmodel.py"):
+        with open(os.path.join(HERE, tname)) as fh:
+            corpus += fh.read()
+    untested = {c for c in emittable if c not in corpus}
+    assert not untested, (
+        f"DC codes emitted but never exercised by a corpus test: "
+        f"{sorted(untested)}")

@@ -322,10 +322,10 @@ def test_autoscaler_spawns_retires_and_refuses_at_bounds():
     assert auto.quiesce() and auto.refused == 1 and len(router.members) == 2
     # MTTR closes at the first poll after the replica beats
     auto.poll()
-    assert auto.scale_up_mttr_s == []
+    assert list(auto.scale_up_mttr_s) == []
     router.members[1].last_beat = 12.5
     auto.poll()
-    assert auto.scale_up_mttr_s == [pytest.approx(2.5)]
+    assert list(auto.scale_up_mttr_s) == [pytest.approx(2.5)]
     # down retires the EMPTIEST replica
     m0.busy = 3
     auto.on_scale("down", {})
@@ -398,3 +398,34 @@ def test_sched_drill_preempt_resume_bit_identical_3x(tmp_path):
         assert out["chaos_counts"].get("drop", 0) > 0  # chaos really ran
         chaos_logs.append(out["chaos_lines"])
     assert chaos_logs[0] == chaos_logs[1] == chaos_logs[2]
+
+
+def test_autoscaler_summary_reads_under_the_counter_lock():
+    """DC204 closure (ISSUE 19 satellite): ``summary()`` must read the
+    scale counters under ``_mu`` — the actuator thread mutates them in
+    ``quiesce``. Pin the behavior: a held ``_mu`` blocks ``summary()``
+    until release, so the read really is inside the critical section."""
+    import threading
+
+    router = _FakeRouter([_FakeMember(0)])
+    auto = FleetAutoscaler(router, lambda: _FakeMember(1),
+                           min_engines=1, max_engines=2,
+                           clock=lambda: 0.0)
+    done = threading.Event()
+    out = {}
+
+    def read():
+        out["summary"] = auto.summary()
+        done.set()
+
+    auto._mu.acquire()
+    try:
+        t = threading.Thread(target=read, daemon=True)
+        t.start()
+        assert not done.wait(0.25), \
+            "summary() returned while the counter lock was held"
+    finally:
+        auto._mu.release()
+    assert done.wait(2.0)
+    t.join(2.0)
+    assert out["summary"]["scaled_up"] == 0
