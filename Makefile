@@ -211,6 +211,20 @@ bench-coordfail:
 netweather:
 	$(PY) -m pytest tests/ -q -m netweather
 
+# gray-failure plane (ISSUE 20, coord/grayhealth.py + utils/chaos.GrayRule):
+# adaptive per-member/per-link suspicion on the LeaseRenew evidence tail,
+# scheduled one-way partitions / lossy links / injected stalls, and the
+# probation -> quarantine -> evict containment ladder; the drill acceptance
+# runs a mid-training gray episode 3x with byte-identical chaos logs
+gray:
+	$(PY) -m pytest tests/ -q -m gray
+
+# gray-failure bench phase: goodput through a 10s gray-link episode with
+# containment on vs off, plus measured detection latency (floor-gated) and
+# containment MTTR
+bench-gray:
+	$(PY) bench_all.py --only gray
+
 # wire cost ladder + reliability before/after (bench_all phases): every
 # transport layer priced raw -> reliable -> batched-ack -> WAL-deferred ->
 # chaos-wrapped, plus the ack-tax recovery measurement
@@ -283,4 +297,4 @@ install:
 dist:
 	$(PY) setup.py sdist bdist_wheel
 
-.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-wire-bytes bench-health bench-gate bench-compute bench-mpmd bench-sched bench-coordfail bench-lint timeline chaos codec coord coordfail distflow drill drill-demo fleet health health-demo mpmd mpmd-demo netweather sched sched-demo soak lint distmodel test test-all verify-real-data graph install dist
+.PHONY: first second server launch sharded single tpu gpu sync local-sgd p2p serve serve-demo serve-fleet serve-fleet-demo bench bench-serving bench-all bench-wire bench-wire-bytes bench-health bench-gate bench-compute bench-mpmd bench-sched bench-coordfail bench-gray bench-lint timeline chaos codec coord coordfail distflow drill drill-demo fleet gray health health-demo mpmd mpmd-demo netweather sched sched-demo soak lint distmodel test test-all verify-real-data graph install dist

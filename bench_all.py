@@ -1187,6 +1187,93 @@ def coordfail_phase() -> None:
         f"chaos {out['chaos_counts']}")
 
 
+def gray_phase() -> None:
+    """Config 3, gray-failure leg (ISSUE 20): the SAME windowed one-way
+    partition (workers' pull requests toward shard 0 vanish, its renewals
+    keep flowing) run twice — containment ON (GrayHealth detects on the
+    workers' renew-tail link evidence, parks the victim, resumes it
+    bit-identically) vs OFF (nobody acts; the episode drains only through
+    retransmit back-off). Priced as goodput over the identical fixed
+    script, detection latency (gray onset -> PROBATION), and containment
+    MTTR (PROBATION -> parked). Detection latency is gated against
+    ``gray_detection_latency_ceiling_s`` in bench_floors.json — a slower
+    detector widens the window in which a gray node poisons the fleet."""
+    import tempfile
+
+    from distributed_ml_pytorch_tpu.coord.drill import gray_drill
+
+    steps, n_workers = 170, 2
+    on = gray_drill(
+        base_dir=tempfile.mkdtemp(prefix="bench_gray_on_"), seed=0,
+        steps=steps, n_workers=n_workers)
+    if (not on["ok"] or on["detect_latency_s"] is None
+            or on["containment_mttr_s"] is None
+            or on["fixed_wall_s"] is None):
+        log(f"gray_phase incomplete (containment leg): ok={on['ok']} "
+            f"errors={on['errors']} violations={on['violations']}")
+        return
+    off = gray_drill(
+        base_dir=tempfile.mkdtemp(prefix="bench_gray_off_"), seed=0,
+        steps=steps, n_workers=n_workers, contain=False)
+    if not off["ok"] or off["fixed_wall_s"] is None:
+        log(f"gray_phase incomplete (unmanaged leg): ok={off['ok']} "
+            f"errors={off['errors']} violations={off['violations']}")
+        return
+    fixed = steps * n_workers
+    goodput_on = fixed / on["fixed_wall_s"]
+    goodput_off = fixed / off["fixed_wall_s"]
+    # raw steps/s barely moves either way — the workers fail OPEN to
+    # purely-local SGD on a downed slice and keep stepping. What
+    # containment protects is CENTRAL aggregation on the gray slice:
+    # worker deltas the victim shard actually applied, per second.
+    central_on = sum(on["applied"][0].values()) / on["wall_s"]
+    central_off = sum(off["applied"][0].values()) / off["wall_s"]
+    emit(3, "gray_victim_slice_goodput_contained", central_on,
+         "applied updates/s", "in-process fleet, 1 core",
+         "central aggregation rate on the GRAY slice with the ladder "
+         "live — the PRICE of containment: the park window trades some "
+         "episode throughput for a BOUNDED recovery (victim on "
+         f"PROBATION in {on['detect_latency_s'] * 1e3:.0f} ms, parked, "
+         f"resumed bit_identical={on['bit_identical']}, ladder cleared, "
+         f"evictions={on['gray']['evictions']}) vs {central_off:.1f} "
+         "applied/s unmanaged, where the grind is open-ended and the "
+         "slice's pull freshness is gone for the whole episode; raw "
+         f"worker steps/s {goodput_on:.1f} vs {goodput_off:.1f} over "
+         f"the identical {fixed}-step fixed script (fail-open local SGD "
+         "keeps raw stepping alive either way) — coord/drill.gray_drill")
+    emit(3, "gray_victim_slice_goodput_unmanaged", central_off,
+         "applied updates/s", "in-process fleet, 1 core",
+         "the comparison leg: identical gray episode, suspicion pinned "
+         "off — the victim slice grinds on retransmit back-off + open "
+         "circuits for the whole episode while its deltas drift "
+         "local-only")
+    emit(3, "gray_detect_latency", on["detect_latency_s"] * 1e3, "ms",
+         "in-process fleet, 1 core",
+         "gray onset (first chaos-matched pull) -> victim on PROBATION, "
+         "confirmed over 2 suspicious ticks of renew-tail link evidence "
+         "from both workers")
+    emit(3, "gray_containment_mttr", on["containment_mttr_s"] * 1e3, "ms",
+         "in-process fleet, 1 core",
+         "PROBATION -> checkpoint-parked via the gray-granted preempt "
+         "path (snapshot barrier + WAL'd park ticket); the victim never "
+         "lease-expires and is never revoked")
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_floors.json")) as fh:
+        ceiling = json.load(fh)["gray_detection_latency_ceiling_s"]
+    log(f"gray_phase: victim-slice {central_on:.1f} vs {central_off:.1f} "
+        f"applied/s, raw {goodput_on:.1f} vs {goodput_off:.1f} steps/s "
+        f"(contained vs unmanaged), detect "
+        f"{on['detect_latency_s'] * 1e3:.0f} ms (ceiling {ceiling}s), "
+        f"mttr {on['containment_mttr_s'] * 1e3:.0f} ms, chaos "
+        f"{on['chaos_counts']}")
+    if on["detect_latency_s"] > ceiling:
+        raise RuntimeError(
+            f"gray detection latency {on['detect_latency_s']:.2f}s "
+            f"exceeds the {ceiling}s ceiling in bench_floors.json — the "
+            "suspicion plane got slow enough to let a gray node poison "
+            "the fleet for whole episodes")
+
+
 def _serving_slot_rate() -> tuple:
     """Tokens/s one engine slot serves (a real ``ServingEngine`` burst,
     compile outside the timed window) plus its p50 TTFT — the measured
@@ -2403,6 +2490,7 @@ PHASES = {
     "elastic": lambda: elastic_phase(),
     "recovery": lambda: recovery_phase(),
     "coordfail": lambda: coordfail_phase(),
+    "gray": lambda: gray_phase(),
     "sched": lambda: sched_phase(),
     "health": lambda: health_phase(),
     "mpmd": lambda: mpmd_phase(),
@@ -2437,6 +2525,7 @@ def main(argv=None) -> None:
     elastic_phase()
     recovery_phase()
     coordfail_phase()
+    gray_phase()
     sched_phase()
     health_phase()
     mpmd_phase()

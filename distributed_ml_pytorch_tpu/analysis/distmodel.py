@@ -44,6 +44,15 @@ invariants the seeded acceptance scenarios only sample:
   coordinator lives* (a stale-epoch command never actuates), *no member
   evicted during the re-attach grace window*, *no parked member
   stranded and no slot double-granted across restart*.
+- **gray** — the gray-failure suspicion ladder (ISSUE 20): one member
+  renews its lease on time throughout while transient bursts, isolated
+  marginal spikes, and a persistent one-way gray link schedule against
+  the detector. Invariants: *no live renewing member evicted on
+  transient weather* (confirmed suspicion enters probation, never the
+  evict rung), *a persistent one-way gray link is contained within the
+  deadline* (third-party link evidence indicts what the victim's own
+  clean report launders), *no flap cycles* (isolated marginal spikes
+  never meet the confirm/clear hysteresis).
 
 Exploration is exhaustive breadth-first over SMALL configurations (2
 workers x 2 updates; 2 lives; 3-stage pipeline slice with 2 steps x 2
@@ -58,7 +67,8 @@ soundness corpus: ``ack_before_fsync``, ``no_dedup``,
 ``no_mb_dedup``, ``no_error_feedback``, ``decode_before_admission``,
 ``stale_delta_base``, ``no_full_fallback_on_restore``,
 ``park_without_manifest``, ``double_grant_slot``, ``no_epoch_fence``,
-``expire_on_restart``, ``forget_parked``); the
+``expire_on_restart``, ``forget_parked``, ``no_hysteresis``,
+``symmetric_probe_only``, ``evict_on_first_suspicion``); the
 checker must find a counterexample for each. Every
 counterexample is emitted as a JSON artifact carrying the event trace, a
 concrete :class:`~.chaos.ChaosPlan` (deterministic windowed fault rules
@@ -1090,13 +1100,189 @@ class CoordFailModel(Model):
 
 
 # =====================================================================
+# gray — adaptive suspicion ladder, asymmetric partitions, hysteresis
+# =====================================================================
+
+class GrayModel(Model):
+    """The gray-failure plane's suspicion ladder (ISSUE 20,
+    ``coord/grayhealth.py``) over ONE suspect member that renews its lease
+    on time throughout — gray, never dead. The adversary schedules three
+    weather shapes and the detector ticks:
+
+    - ``blip`` — one transient SYMMETRIC burst: exactly two consecutive
+      anomalous evidence samples, then the weather ends (one-shot). Long
+      enough to confirm, too short to be persistent.
+    - ``spike`` — a marginal isolated anomaly: one anomalous sample that
+      by definition arrives with at least one clean sample on either side
+      (arming requires the raise streak to be empty). The slow-but-honest
+      member's weather.
+    - ``grayline`` — a persistent ONE-WAY gray link: the suspect's own
+      evidence stays clean forever (its inbound works; it cannot see the
+      loss) and only third-party per-link reports carry the signal. It
+      may later ``heal`` (one-shot), after which a quarantined member
+      ``resume``s — re-entering the ladder at PROBATION, never straight
+      to trusted.
+
+    State ::
+
+        (wi,       # transient burst: anomalous ticks remaining (0..2)
+         wg,       # persistent one-way gray link active
+         blipped,  # one-shot latch for the burst
+         grayed,   # one-shot latch for the gray link
+         healed,   # one-shot latch for its heal
+         sp,       # a marginal spike is armed for the next tick
+         st,       # ladder: 0 OK | 1 PROBATION | 2 QUARANTINED | 3 EVICTED
+         rs, cs,   # raise / clear streaks (hysteresis counters)
+         pt,       # anomalous ticks spent in probation
+         flaps,    # OK -> PROBATION entries (capped)
+         gt,       # ticks the persistent gray link ran UNCONTAINED
+         viol)     # sticky violation latch
+
+    The three guards under test, each dropped by one seeded mutation:
+
+    - *hysteresis* — raising takes ``confirm=2`` consecutive anomalous
+      ticks, clearing takes ``clear=2`` clean ones, so isolated marginal
+      spikes never enter the ladder at all. ``no_hysteresis`` collapses
+      both to one tick: every spike flaps OK->PROBATION->OK — the flap
+      bound (3) latches.
+    - *asymmetric detection* — per-link third-party evidence indicts a
+      one-way partition its victim's own report launders.
+      ``symmetric_probe_only`` ignores link evidence: the persistent gray
+      link runs uncontained past the deadline (4 ticks) while the member
+      renews cleanly — the blind spot.
+    - *the ladder itself* — a confirmed suspicion enters PROBATION
+      (route-around), never eviction. ``evict_on_first_suspicion``
+      collapses the ladder onto the evict rung: a live renewing member is
+      evicted on weather that ends one tick later.
+
+    Containment for a persistent gray link in the CLEAN model is
+    probation within ``confirm`` ticks and quarantine after ``pt >= 4``
+    sustained-anomalous probation ticks — ``gt`` can never reach the
+    deadline. All violations latch sticky, like the sched/coordfail
+    models.
+    """
+
+    name = "gray"
+
+    _CONFIRM, _CLEAR, _QUAR_AFTER, _DEADLINE, _FLAP_BOUND = 2, 2, 4, 4, 3
+
+    _OK_V, _EVICT_LIVE, _NOT_CONTAINED, _FLAP = 0, 1, 2, 3
+
+    def __init__(self, mutation: Optional[str] = None):
+        self.mutation = mutation
+
+    def initial(self):
+        return (0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, self._OK_V)
+
+    def successors(self, st_tuple):
+        (wi, wg, blipped, grayed, healed, sp, st, rs, cs, pt, flaps, gt,
+         viol) = st_tuple
+        mut = self.mutation
+        out = []
+        # one transient two-sample burst (one-shot; exclusive weather)
+        if not blipped and wi == 0 and wg == 0:
+            out.append((("blip",), (
+                2, wg, 1, grayed, healed, sp, st, rs, cs, pt, flaps, gt,
+                viol)))
+        # a marginal isolated spike: by definition separated from other
+        # anomalies by a clean sample (the raise streak must be empty)
+        if sp == 0 and wi == 0 and wg == 0 and rs == 0:
+            out.append((("spike",), (
+                wi, wg, blipped, grayed, healed, 1, st, rs, cs, pt, flaps,
+                gt, viol)))
+        # the persistent one-way gray link begins (one-shot)
+        if wg == 0 and wi == 0 and not grayed:
+            out.append((("grayline",), (
+                wi, 1, blipped, 1, healed, sp, st, rs, cs, pt, flaps, gt,
+                viol)))
+        # ... and may heal (one-shot)
+        if wg == 1 and not healed:
+            out.append((("heal",), (
+                wi, 0, blipped, grayed, 1, sp, st, rs, cs, pt, flaps, gt,
+                viol)))
+        # a quarantined member whose weather healed resumes — re-entering
+        # at PROBATION, the earns-its-way-back rung
+        if st == 2 and wg == 0:
+            out.append((("resume",), (
+                wi, wg, blipped, grayed, healed, sp, 1, 0, 0, 0, flaps,
+                gt, viol)))
+        out.append((("tick",), self._tick(st_tuple, mut)))
+        return out
+
+    def _tick(self, st_tuple, mut):
+        (wi, wg, blipped, grayed, healed, sp, st, rs, cs, pt, flaps, gt,
+         viol) = st_tuple
+        # what this evaluation sees: the member's own evidence carries
+        # symmetric weather; the one-way link is visible ONLY through
+        # third-party link reports — which symmetric_probe_only ignores
+        own_anom = wi > 0 or sp == 1
+        link_anom = wg == 1 and mut != "symmetric_probe_only"
+        anomalous = own_anom or link_anom
+        confirm = 1 if mut == "no_hysteresis" else self._CONFIRM
+        clear = 1 if mut == "no_hysteresis" else self._CLEAR
+        wi2, sp2 = max(0, wi - 1), 0
+        st2, rs2, cs2, pt2, flaps2, viol2 = st, rs, cs, pt, flaps, viol
+        if st == 0:
+            if anomalous:
+                rs2, cs2 = min(rs + 1, confirm), 0
+                if rs2 >= confirm:
+                    if mut == "evict_on_first_suspicion":
+                        st2 = 3
+                        if wg == 0:
+                            # the member renewed its lease throughout and
+                            # the weather was transient — it dies anyway
+                            viol2 = self._EVICT_LIVE
+                    else:
+                        st2, rs2, pt2 = 1, 0, 0
+                        flaps2 = min(flaps + 1, self._FLAP_BOUND)
+                        if flaps2 >= self._FLAP_BOUND:
+                            viol2 = self._FLAP
+            else:
+                rs2, cs2 = 0, min(cs + 1, clear)
+        elif st == 1:
+            if anomalous:
+                cs2, pt2 = 0, min(pt + 1, self._QUAR_AFTER)
+                if pt2 >= self._QUAR_AFTER:
+                    st2 = 2  # quarantined: contained (park, not kill)
+            else:
+                cs2 = min(cs + 1, clear)
+                if cs2 >= clear:
+                    st2, rs2, cs2, pt2 = 0, 0, 0, 0
+        # st 2 (quarantined) and 3 (evicted) are absorbing here: resume /
+        # rejoin are the drill's territory, not the detection model's
+        gt2 = min(gt + 1, self._DEADLINE) if (wg == 1 and st2 == 0) else gt
+        if gt2 >= self._DEADLINE:
+            viol2 = self._NOT_CONTAINED
+        return (wi2, wg, blipped, grayed, healed, sp2, st2, rs2, cs2, pt2,
+                flaps2, gt2, viol2)
+
+    def invariant(self, st_tuple):
+        viol = st_tuple[-1]
+        if viol == self._EVICT_LIVE:
+            return ("live renewing member evicted on transient weather: "
+                    "the first confirmed suspicion went straight to "
+                    "eviction instead of the probation ladder")
+        if viol == self._NOT_CONTAINED:
+            return ("persistent one-way gray link never contained: the "
+                    "victim's own report is clean on an asymmetric "
+                    "partition — only third-party link evidence can "
+                    "indict it, and the detector ignored it")
+        if viol == self._FLAP:
+            return ("suspicion flapped OK->probation 3 times on isolated "
+                    "marginal spikes: without confirm/clear hysteresis a "
+                    "slow-but-honest member oscillates in and out of "
+                    "containment")
+        return None
+
+
+# =====================================================================
 # registry + counterexample emission
 # =====================================================================
 
 MODELS: Dict[str, Callable[..., Model]] = {
     "ps": PSModel, "lease": LeaseModel, "mpmd": MpmdModel,
     "copt": CompressModel, "dpull": DeltaPullModel, "sched": SchedModel,
-    "coordfail": CoordFailModel}
+    "coordfail": CoordFailModel, "gray": GrayModel}
 
 #: mutation name -> the model it breaks (the soundness corpus)
 MUTATIONS: Dict[str, str] = {
@@ -1115,12 +1301,15 @@ MUTATIONS: Dict[str, str] = {
     "no_epoch_fence": "coordfail",
     "expire_on_restart": "coordfail",
     "forget_parked": "coordfail",
+    "no_hysteresis": "gray",
+    "symmetric_probe_only": "gray",
+    "evict_on_first_suspicion": "gray",
 }
 
 #: per-model depth the `make distmodel` gate explores to (deep enough to
 #: cover every mutation's counterexample; small enough to stay seconds)
 DEFAULT_DEPTH = {"ps": 12, "lease": 10, "mpmd": 12, "copt": 12,
-                 "dpull": 12, "sched": 12, "coordfail": 10}
+                 "dpull": 12, "sched": 12, "coordfail": 10, "gray": 9}
 
 
 def _chaos_plan_for(result: Result) -> dict:
@@ -1180,6 +1369,30 @@ def _chaos_plan_for(result: Result) -> dict:
             rules.append(FaultRule(
                 src=0, dst=1, code=int(MessageCode.ActivationShip),
                 dup=1.0, after=int(ev[1]), until=int(ev[1]) + 1))
+    gray_rules = []
+    if result.model == "gray":
+        from distributed_ml_pytorch_tpu.utils.chaos import GrayRule
+
+        # the trace's weather events become scheduled GrayRules windowed
+        # on the tick ordinal they struck at (suspect rank 1's outbound
+        # channel toward reporter rank 2 — the drill topology convention):
+        # a grayline is an unbounded one-way partition, a blip a two-tick
+        # full-loss window, a spike a one-tick window
+        ticks = 0
+        for ev in result.trace or []:
+            if ev[0] == "tick":
+                ticks += 1
+            elif ev[0] == "grayline":
+                gray_rules.append(GrayRule(
+                    kind="partition", src=1, dst=2, after=ticks))
+            elif ev[0] == "blip":
+                gray_rules.append(GrayRule(
+                    kind="lossy", src=1, dst=2, p=1.0, after=ticks,
+                    until=ticks + 2))
+            elif ev[0] == "spike":
+                gray_rules.append(GrayRule(
+                    kind="lossy", src=1, dst=2, p=1.0, after=ticks,
+                    until=ticks + 1))
     sdc_rules = []
     if result.model == "copt":
         from distributed_ml_pytorch_tpu.utils.chaos import SDCRule
@@ -1198,7 +1411,8 @@ def _chaos_plan_for(result: Result) -> dict:
                     src=1, dst=0, code=int(MessageCode.CompressedUpdate),
                     p=1.0, kind="scale", factor=1e30, skip=HEAD_LEN,
                     after=i, until=i + 1))
-    return plan_to_json(ChaosPlan(rules=rules, seed=0, sdc=sdc_rules))
+    return plan_to_json(ChaosPlan(rules=rules, seed=0, sdc=sdc_rules,
+                                  gray=gray_rules))
 
 
 _STUB_REAL = '''\
@@ -1264,6 +1478,8 @@ def counterexample_artifact(result: Result) -> dict:
         ops = ("preempt", "grant", "crash", "partition", "zombie_bump",
                "rejoin", "resume", "regrant", "expire_blipped",
                "expire_parked")
+    elif result.model == "gray":
+        ops = ("blip", "spike", "grayline")
     else:
         ops = ("crash", "restart")
     script = [
@@ -2113,6 +2329,193 @@ def _replay_no_full_fallback_on_restore(ce: dict, workdir: str,
     return violations
 
 
+def _gray_rig(workdir, mutated_knobs, ranks=(1, 2)):
+    """A real Coordinator + GrayHealth under a fake clock: the gray
+    replay harnesses drive LeaseRenew frames (with gray-health tails)
+    through ``Coordinator.handle`` and the suspicion ladder through
+    ``Coordinator.tick`` — the same dispatch the live serve thread runs.
+    ``raise_threshold=2.5`` (not the 3.0 default) keeps the harnesses off
+    a knife edge: the FIRST anomalous sample after a calm-trained
+    baseline lands at z = sqrt((1-alpha)/alpha) = 3.0 exactly (the
+    EW-update identity), so discriminating at 3.0 would hang the verdict
+    on float rounding."""
+    from distributed_ml_pytorch_tpu.coord.coordinator import (
+        KIND_SHARD,
+        Coordinator,
+        encode_join,
+    )
+    from distributed_ml_pytorch_tpu.coord.grayhealth import GrayHealth
+    from distributed_ml_pytorch_tpu.utils.messaging import (
+        InProcessTransport,
+        MessageCode,
+    )
+
+    fake_now = [0.0]
+    world = InProcessTransport.create_world(5)
+    coord = Coordinator(world[0], 8, lease=8.0, speculation=False,
+                        clock=lambda: fake_now[0], durable_dir=workdir)
+    gray = GrayHealth(coord, raise_threshold=2.5, confirm_ticks=2,
+                      clear_ticks=2, **mutated_knobs)
+    for rank in ranks:
+        coord.handle(rank, MessageCode.CoordJoin,
+                     encode_join(KIND_SHARD, 0))
+    return world, coord, gray, fake_now
+
+
+def _gray_renew(coord, rank, retrans=0.01, links=()):
+    from distributed_ml_pytorch_tpu.coord.coordinator import encode_renew
+    from distributed_ml_pytorch_tpu.utils.messaging import MessageCode
+
+    coord.handle(rank, MessageCode.LeaseRenew,
+                 encode_renew(0, retrans_rate=retrans, links=links))
+
+
+def _replay_evict_on_first_suspicion(ce: dict, workdir: str,
+                                     mutated: bool) -> List[str]:
+    """The transient-burst schedule against the real coordinator + gray
+    plane: a shard member renews every 0.25s throughout; after the
+    baseline warm-up its reported retransmit rate spikes for exactly two
+    windows (the model's ``blip``), then calms. Clean: two confirmed
+    anomalous ticks put it on PROBATION, the hysteresis clears it back to
+    OK when the weather passes — nobody dies. Mutated
+    (``evict_on_first_suspicion=True``): the first confirmed suspicion
+    revokes its lease while it is still renewing — a live member killed
+    by weather that ended one window later."""
+    from distributed_ml_pytorch_tpu.coord.grayhealth import OK, PROBATION
+
+    world, coord, gray, fake_now = _gray_rig(
+        workdir, {"evict_on_first_suspicion": mutated})
+    violations = []
+    try:
+        def rnd(retrans):
+            fake_now[0] += 0.25
+            _gray_renew(coord, 1, retrans=retrans)
+            _gray_renew(coord, 2)
+            coord.tick()
+
+        for _ in range(10):
+            rnd(0.01)       # calm: trains the adaptive baseline
+        for _ in range(2):
+            rnd(2.0)        # the two-window transient burst
+        if 1 not in coord.members:
+            violations.append(
+                "live renewing member evicted on transient weather: rank "
+                "1 renewed every 0.25s yet its lease was revoked at the "
+                "first confirmed suspicion")
+        if not mutated:
+            if gray.state_of(1) != PROBATION:
+                violations.append(
+                    "clean config did not reach probation on the "
+                    "confirmed burst — detection is not wired")
+            for _ in range(6):
+                rnd(0.01)   # weather passed: the ladder must unwind
+            if gray.state_of(1) != OK or 1 not in coord.members:
+                violations.append(
+                    "clean config did not clear back to OK after the "
+                    "transient weather passed")
+    finally:
+        for t in world.values():
+            t.close()
+    return violations
+
+
+def _replay_symmetric_probe_only(ce: dict, workdir: str,
+                                 mutated: bool) -> List[str]:
+    """The one-way-partition schedule against the real coordinator + gray
+    plane: the suspect's OWN renewals stay clean the whole time (an
+    asymmetric partition's victim cannot see its outbound loss) while two
+    reporters' renew tails carry per-link evidence naming it. Clean
+    (asymmetric detection on): the third-party indictments put the
+    suspect on PROBATION — contained, still a member. Mutated
+    (``asymmetric=False``): link evidence is ignored and the gray link
+    runs forever undetected."""
+    from distributed_ml_pytorch_tpu.coord.grayhealth import OK, PROBATION
+
+    world, coord, gray, fake_now = _gray_rig(
+        workdir, {"asymmetric": not mutated}, ranks=(1, 2, 3))
+    violations = []
+    try:
+        def rnd(link_rate):
+            fake_now[0] += 0.25
+            _gray_renew(coord, 1)   # the victim reports clean, always
+            for rank in (2, 3):
+                _gray_renew(coord, rank, links=((1, link_rate, 0.0),))
+            coord.tick()
+
+        for _ in range(10):
+            rnd(0.01)       # link baselines warm on calm reports
+        for _ in range(4):
+            rnd(1.0)        # the persistent one-way loss, both reporters
+        if gray.state_of(1) == OK:
+            violations.append(
+                "persistent one-way gray link never contained: two "
+                "reporters named rank 1 for four windows and the "
+                "detector never left OK")
+        if not mutated:
+            if gray.state_of(1) != PROBATION:
+                violations.append(
+                    "clean config did not put the one-way partition's "
+                    "victim on probation")
+            if 1 not in coord.members:
+                violations.append(
+                    "clean config killed the suspect instead of "
+                    "containing it — probation must degrade, not evict")
+    finally:
+        for t in world.values():
+            t.close()
+    return violations
+
+
+def _replay_no_hysteresis(ce: dict, workdir: str,
+                          mutated: bool) -> List[str]:
+    """The marginal-weather schedule against the real coordinator + gray
+    plane: a slow-but-honest member usually renews every 0.25s but is
+    occasionally LATE (isolated 2s gaps — the model's ``spike``), each
+    late window followed by prompt renewals. The phi-accrual gap score
+    spikes for exactly one evaluation per episode. Clean: one marginal
+    tick never meets ``confirm_ticks=2``, so the member never flaps.
+    Mutated (``hysteresis=False``): every episode flaps it
+    OK->probation->OK — containment churn on a member that was never
+    gray."""
+    from distributed_ml_pytorch_tpu.coord.grayhealth import OK
+
+    world, coord, gray, fake_now = _gray_rig(
+        workdir, {"hysteresis": not mutated})
+    violations = []
+    try:
+        def prompt():
+            fake_now[0] += 0.25
+            _gray_renew(coord, 1)
+            _gray_renew(coord, 2)
+            coord.tick()
+
+        for _ in range(24):
+            prompt()        # a deep on-time arrival history
+        for _ in range(4):  # four isolated late-renewal episodes
+            fake_now[0] += 2.0
+            coord.tick()    # the one marginal evaluation mid-gap
+            _gray_renew(coord, 1)   # the renewal lands — late, but lands
+            _gray_renew(coord, 2)
+            prompt()        # and the next window is clean again
+        if gray.flaps_of(1) >= 3:
+            violations.append(
+                f"suspicion flapped OK->probation {gray.flaps_of(1)} "
+                "times on isolated late renewals: no confirm/clear "
+                "hysteresis, so every marginal evaluation churns the "
+                "containment ladder")
+        if not mutated:
+            if gray.flaps_of(1) != 0 or gray.state_of(1) != OK:
+                violations.append(
+                    "clean config flapped on marginal weather — the "
+                    "hysteresis streaks are not wired")
+            if 1 not in coord.members:
+                violations.append("clean config lost the member entirely")
+    finally:
+        for t in world.values():
+            t.close()
+    return violations
+
+
 _REPLAYS = {
     ("ps", "ack_before_fsync"): _replay_ack_before_fsync,
     ("ps", "no_dedup"): _replay_no_dedup,
@@ -2127,6 +2530,10 @@ _REPLAYS = {
     ("coordfail", "no_epoch_fence"): _replay_no_epoch_fence,
     ("coordfail", "expire_on_restart"): _replay_expire_on_restart,
     ("coordfail", "forget_parked"): _replay_forget_parked,
+    ("gray", "no_hysteresis"): _replay_no_hysteresis,
+    ("gray", "symmetric_probe_only"): _replay_symmetric_probe_only,
+    ("gray", "evict_on_first_suspicion"):
+        _replay_evict_on_first_suspicion,
 }
 
 

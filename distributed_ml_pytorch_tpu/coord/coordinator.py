@@ -111,20 +111,39 @@ def encode_leave(incarnation: int) -> np.ndarray:
 def encode_renew(incarnation: int, push_count: int = 0, step: int = 0,
                  ewma_ms: float = 0.0, wire_open: int = 0, nacks: int = 0,
                  bad_loss: int = 0, loss_ewma: float = 0.0,
-                 gnorm_ewma: float = 0.0) -> np.ndarray:
+                 gnorm_ewma: float = 0.0, retrans_rate: float = 0.0,
+                 nack_rate: float = 0.0, blocked_s: float = 0.0,
+                 fsync_p95_ms: float = 0.0, busy_ratio: float = 0.0,
+                 links=()) -> np.ndarray:
     """``wire_open`` (ISSUE 7) counts the member's open circuit breakers —
     peers whose sends are timing out — so the lease view carries wire
     health, not just liveness. The tail (ISSUE 8) is the numerical-health
     telemetry: cumulative admission ``nacks`` received, ``bad_loss``
     nonfinite-loss observations, and the loss / grad-norm EWMAs — the
-    reputation and rollback-watchdog inputs. All values must be finite
-    (receivers drop nonfinite renewals); the senders clamp."""
+    reputation and rollback-watchdog inputs. The GRAY-health tail
+    (ISSUE 20) carries the member's own data-plane weather: retransmit
+    rate, nack rate, blocked-send seconds, fsync p95 and busy-vs-wall
+    ratio — the adaptive-suspicion inputs a renewing-but-rotting member
+    cannot hide. ``links`` appends per-DIRECTED-LINK evidence triples
+    ``(peer_rank, link_retrans_rate, link_blocked_s)`` so the coordinator
+    can suspect a one-way partition on ONE link while both endpoints stay
+    healthy members. All values must be finite (receivers drop nonfinite
+    renewals); the senders clamp. Pre-ISSUE-20 receivers simply ignore the
+    extra floats; pre-ISSUE-20 senders omit them and the receiver keeps
+    neutral (0.0) gray evidence — "didn't say" is not "gray"."""
     from distributed_ml_pytorch_tpu.utils.health import clamp_finite32
 
+    tail = []
+    for peer, l_retrans, l_blocked in links:
+        tail += [float(peer), clamp_finite32(l_retrans),
+                 clamp_finite32(l_blocked)]
     return np.asarray(
         [*_split16(incarnation), float(push_count), float(step),
          float(ewma_ms), float(wire_open), float(nacks), float(bad_loss),
-         clamp_finite32(loss_ewma), clamp_finite32(gnorm_ewma)],
+         clamp_finite32(loss_ewma), clamp_finite32(gnorm_ewma),
+         clamp_finite32(retrans_rate), clamp_finite32(nack_rate),
+         clamp_finite32(blocked_s), clamp_finite32(fsync_p95_ms),
+         clamp_finite32(busy_ratio), *tail],
         np.float32)
 
 
@@ -206,6 +225,10 @@ FLEET_METRICS_FIELDS = (
     "mean_ewma_ms",    # fleet-mean member step/busy latency EWMA
     "wire_open",       # summed open circuit breakers across members
     "nacks",           # summed admission nacks across members
+    # appended fields decode gracefully on old receivers: decode_fleet
+    # zips names to whatever floats arrived, so a short (pre-ISSUE-20)
+    # tail simply omits the newer keys
+    "gray_suspects",   # members at probation or worse (ISSUE 20)
 )
 
 
@@ -280,6 +303,14 @@ class MemberInfo:
     bad_loss: int = 0
     loss_ewma: float = 0.0
     gnorm_ewma: float = 0.0
+    # --- gray-health telemetry (ISSUE 20): the member's own data-plane
+    # weather, neutral (0.0) until a post-ISSUE-20 renew reports it — a
+    # short pre-ISSUE-20 frame leaves these at their defaults
+    retrans_rate: float = 0.0
+    nack_rate: float = 0.0
+    blocked_s: float = 0.0
+    fsync_p95_ms: float = 0.0
+    busy_ratio: float = 0.0
 
     @property
     def kind_name(self) -> str:
@@ -349,6 +380,12 @@ class Coordinator:
         #: its placement pass and handle() routes PreemptDone to it. A
         #: parked member's silence is then a PARK, not a death.
         self.sched = None
+        #: optional gray-failure plane (ISSUE 20, ``coord/grayhealth.py``):
+        #: ``GrayHealth(coord)`` attaches itself here; handle() feeds it
+        #: renew arrivals + health tails, tick() drives the suspicion
+        #: ladder, and PreemptDone frames whose grant ids live in the gray
+        #: plane's reserved space route to it instead of the scheduler.
+        self.gray = None
         # --- snapshot barrier (ISSUE 5): coordinator-aligned fleet ckpts ---
         self.manifest_dir = manifest_dir
         self.snapshot_interval = float(snapshot_interval)
@@ -720,6 +757,12 @@ class Coordinator:
         for rank, durable in sorted(self._parked_durable.items()):
             if rank in known:
                 continue
+            if durable.get("gray"):
+                # a gray-plane quarantine ticket (ISSUE 20) has no slot:
+                # the gray plane parked the member for containment, not
+                # for capacity — resynthesizing a scheduler slot for it
+                # would hand its "capacity" to a tenant it never borrowed
+                continue
             from distributed_ml_pytorch_tpu.coord.sched import PARKED, Slot
 
             sid = int(durable.get("slot_id", sched.ledger._next_slot))
@@ -753,6 +796,8 @@ class Coordinator:
              if reported else 0.0),
             float(sum(m.wire_open for m in live)),
             float(sum(m.nacks for m in live)),
+            float(self.gray.suspect_count()) if self.gray is not None
+            else 0.0,
         ]
         return {
             # registry-style fleet telemetry tail (ISSUE 12), wire order =
@@ -962,10 +1007,23 @@ class Coordinator:
             if not np.isfinite(payload[:10]).all():
                 return
             member.last_seen = now
+            grant_id = _join16(payload[0], payload[1])
+            # gray-plane quarantine parks (ISSUE 20) use a reserved grant-id
+            # space so their PreemptDone acks never collide with — or get
+            # swallowed by — the scheduler's grant bookkeeping
+            if self.gray is not None and self.gray.owns_grant(grant_id):
+                self.gray.on_preempt_done(
+                    sender, grant_id=grant_id,
+                    snap_id=_join16(payload[2], payload[3]),
+                    lo=_join16(payload[4], payload[5]),
+                    hi=_join16(payload[6], payload[7]),
+                    apply_seq=_join16(payload[8], payload[9]),
+                    now=now)
+                return
             if self.sched is not None:
                 self.sched.on_preempt_done(
                     sender,
-                    grant_id=_join16(payload[0], payload[1]),
+                    grant_id=grant_id,
                     snap_id=_join16(payload[2], payload[3]),
                     lo=_join16(payload[4], payload[5]),
                     hi=_join16(payload[6], payload[7]),
@@ -973,12 +1031,13 @@ class Coordinator:
                     now=now)
             return
         # distcheck: ignore[DC104] deliberate wire tolerance (WIRE_SCHEMAS
-        # doc): the 5-field pre-ISSUE-7 and 6-field pre-ISSUE-8 renews stay
-        # FULL renews — the wire-health and numerical-health tails are
-        # optional, and an absent field leaves the last report standing
-        # ("didn't say" is not "healthy")
+        # doc): the 5-field pre-ISSUE-7, 6-field pre-ISSUE-8 and 10-field
+        # pre-ISSUE-20 renews stay FULL renews — the wire-health,
+        # numerical-health and gray-health tails are optional, and an
+        # absent field leaves the last report standing ("didn't say" is
+        # not "healthy")
         if code == MessageCode.LeaseRenew and payload.size >= 5:
-            n = min(int(payload.size), 10)
+            n = min(int(payload.size), 15)
             if not np.isfinite(payload[:n]).all():
                 return
             inc = _join16(payload[0], payload[1])
@@ -1016,6 +1075,23 @@ class Coordinator:
                 member.loss_ewma = float(payload[8])
                 member.gnorm_ewma = float(payload[9])
                 self._check_reputation(member, now)
+            links = ()
+            if n >= 15:
+                # gray-health tail (ISSUE 20): the adaptive-suspicion
+                # evidence; per-link triples (peer, retrans, blocked_s)
+                # ride behind the fixed fields
+                member.retrans_rate = float(payload[10])
+                member.nack_rate = float(payload[11])
+                member.blocked_s = float(payload[12])
+                member.fsync_p95_ms = float(payload[13])
+                member.busy_ratio = float(payload[14])
+                rest = payload[15:]
+                rest = rest[np.isfinite(rest)]
+                links = tuple(
+                    (int(rest[k]), float(rest[k + 1]), float(rest[k + 2]))
+                    for k in range(0, (rest.size // 3) * 3, 3))
+            if self.gray is not None:
+                self.gray.on_renew(member, now, links)
             return
         # any other frame from a known member is evidence of life
         member.last_seen = now
@@ -1068,6 +1144,9 @@ class Coordinator:
         # --- multi-tenant scheduler pass (ISSUE 16; serve-thread only) ---
         if self.sched is not None:
             self.sched.tick(now)
+        # --- gray-failure suspicion ladder (ISSUE 20; serve-thread only) ---
+        if self.gray is not None:
+            self.gray.tick(now)
         # --- snapshot barrier driving (serve-thread only, like the rest) ---
         due = (self._next_snap_at is not None and now >= self._next_snap_at)
         if self._snap_requested or due:
@@ -1244,6 +1323,31 @@ class Coordinator:
             + (f" -> {path}" if path else " (in-memory only)"))
 
     # -------------------------------------------- numerical health (ISSUE 8)
+    def revoke_member(self, rank: int, why: str,
+                      cooldown: Optional[float] = None) -> None:
+        """The eviction actuator (serve thread): revoke a member's lease
+        with a reputation cooldown — shared by the nack-count reputation
+        check (ISSUE 8) and the gray plane's confirmed-gray escalation
+        (ISSUE 20). A revoked shard's range rebalances away; join retries
+        are refused until the cooldown expires, then the member rejoins
+        with fresh params through the normal incarnation machinery."""
+        member = self.members.get(rank)
+        if member is None:
+            return
+        cd = self.reputation_cooldown if cooldown is None else float(cooldown)
+        self._wal_record(op="revoke", rank=rank)
+        del self.members[rank]
+        self.speculated.pop(rank, None)
+        self._reputation_block[rank] = self._clock() + cd
+        self.revoked_workers += 1
+        self._log(
+            f"{member.kind_name} {rank} lease REVOKED: {why} — cooldown "
+            f"{cd:.1f}s, then it rejoins and pulls fresh params")
+        if member.kind == KIND_SHARD:
+            self._rebalance(f"revocation of shard server {rank}")
+        else:
+            self._announce()
+
     def _check_reputation(self, member: MemberInfo, now: float) -> None:
         """Revoke a worker whose admission-nack count since (re)join
         crossed the limit. Called from the renew handler, serve thread."""
@@ -1253,17 +1357,9 @@ class Coordinator:
         offenses = member.nacks - member.nack_base
         if offenses < self.reputation_nacks:
             return
-        self._wal_record(op="revoke", rank=member.rank)
-        del self.members[member.rank]
-        self.speculated.pop(member.rank, None)
-        self._reputation_block[member.rank] = now + self.reputation_cooldown
-        self.revoked_workers += 1
-        self._log(
-            f"reputation: worker {member.rank} lease REVOKED after "
-            f"{offenses} quarantined update(s) this life — cooldown "
-            f"{self.reputation_cooldown:.1f}s, then it rejoins and pulls "
-            "fresh params")
-        self._announce()
+        self.revoke_member(
+            member.rank,
+            f"reputation: {offenses} quarantined update(s) this life")
 
     def trigger_rollback(self) -> None:
         """Request a fleet rollback to the last good manifest; the serve
@@ -1496,6 +1592,37 @@ class Coordinator:
         # dedup (first task result wins) is what makes the duplication safe
         self._send(backup.rank, MessageCode.SpeculateTask, frame)
         self._send(victim.rank, MessageCode.SpeculateTask, frame)
+        return task_id
+
+    # distcheck: ignore[DC205] serve-thread only: the sole caller is
+    # GrayHealth._enter_probation, reached from gray.tick() inside this
+    # coordinator's own run loop — same thread as check_stragglers
+    def speculate_victim(self, victim_rank: int) -> Optional[int]:
+        """Route-around actuator for the gray plane (ISSUE 20): replicate
+        a PROBATION worker's remaining work onto the fastest healthy
+        worker, reusing the Sandblaster backup-task machinery verbatim —
+        probation bends traffic away from the suspect instead of waiting
+        for the straggler detector's latency threshold to notice it."""
+        victim = self.members.get(victim_rank)
+        if (victim is None or victim.kind != KIND_WORKER
+                or victim_rank in self.speculated):
+            return None
+        candidates = [m for m in self._live(KIND_WORKER)
+                      if m.rank != victim_rank]
+        if not candidates:
+            return None
+        backup = min(candidates, key=lambda m: (m.ewma_ms, m.rank))
+        task_id = self._next_task
+        self._next_task += 1
+        self.speculated[victim_rank] = task_id
+        self._log(
+            f"gray probation: speculating worker {victim_rank}'s tail on "
+            f"worker {backup.rank} as task {task_id}")
+        frame = np.asarray(
+            [float(task_id), float(victim_rank), float(victim.step)],
+            np.float32)
+        self._send(backup.rank, MessageCode.SpeculateTask, frame)
+        self._send(victim_rank, MessageCode.SpeculateTask, frame)
         return task_id
 
     # ----------------------------------------------------------------- run

@@ -57,6 +57,7 @@ from distributed_ml_pytorch_tpu.utils.chaos import (
     ChaosPlan,
     FaultRule,
     FaultyTransport,
+    GrayRule,
 )
 from distributed_ml_pytorch_tpu.utils.messaging import (
     InProcessTransport,
@@ -821,6 +822,389 @@ def sched_drill(
         "peak_window_s": (timings["offpeak"] - timings["peak"]
                           if "peak" in timings and "offpeak" in timings
                           else None),
+        "servers": servers,
+    }
+
+
+def default_gray_plan(seed: int = 0, n_workers: int = 2,
+                      gray_from: int = 30, gray_until: int = 58) -> ChaosPlan:
+    """A windowed ONE-WAY partition on every worker's pull channel toward
+    shard server 0 (the gray victim): requests with per-channel send
+    indices in ``[gray_from, gray_until)`` vanish; replies were never
+    provoked, renewals never touched. Because every rule is INDEX-windowed
+    and pulls are cadence-driven, the chaos log is a pure function of the
+    window — byte-identical across repeats no matter how detection and
+    containment timing float."""
+    rules = [GrayRule(kind="partition", src=j, dst=0,
+                      code=int(MessageCode.ParameterRequest),
+                      after=gray_from, until=gray_until)
+             for j in range(1, 1 + n_workers)]
+    return ChaosPlan(seed=seed, gray=tuple(rules))
+
+
+def gray_drill(
+    *,
+    base_dir: str,
+    seed: int = 0,
+    steps: int = 170,
+    gray_from: int = 30,
+    gray_until: int = 58,
+    n_workers: int = 2,
+    n_shards: int = 2,
+    plan: Optional[ChaosPlan] = None,
+    lease: float = 1.0,
+    lr: float = 0.05,
+    n_push: int = 2,
+    n_pull: int = 2,
+    batch: int = 16,
+    wal_group_n: int = 4,
+    fixture=None,
+    step_sleep: float = 0.05,
+    extra_steps: int = 400,
+    gray_knobs: Optional[dict] = None,
+    contain: bool = True,
+) -> Dict:
+    """One gray-failure containment drill (ISSUE 20).
+
+    Mid-training, shard server 0 goes GRAY, not dead: a scheduled one-way
+    partition eats the workers' pull requests toward it while its own
+    lease renewals (separate star) keep flowing. The coordinator must
+    tell "slow/cut-off" from "dead" and contain WITHOUT killing:
+
+    1. both workers' renew tails carry per-link evidence (windowed pull
+       requests-vs-replies) naming the victim — the asymmetric-partition
+       witness its own clean tail can never be;
+    2. :class:`GrayHealth` confirms suspicion over ``confirm_ticks`` and
+       puts the victim on PROBATION (detection latency measured);
+    3. still suspect after ``quarantine_after`` ticks, it checkpoint-parks
+       the victim through the scheduler's park machinery — snapshot
+       barrier, gray-granted ``PreemptRequest``, WAL'd park ticket, lease
+       exempt (containment MTTR measured). The victim NEVER lease-expires
+       and is NEVER revoked;
+    4. the partition heals, the cooldown expires, the node agent restores
+       the parked range from manifest + exact WAL replay (bit-identical
+       proof, same as :func:`sched_drill`), and the resumed life re-enters
+       the ladder at PROBATION, clearing to OK as clean windows accumulate.
+
+    Workers run at least ``steps`` steps and then keep stepping (bounded
+    by ``extra_steps``) until the ladder clears — chaos rules are all
+    index-windowed, so the flexible tail cannot perturb the log.
+    ``gray_knobs`` forwards extra :class:`GrayHealth` kwargs (the distmodel
+    mutations' real-stack surface: ``hysteresis=False``,
+    ``asymmetric=False``, ``evict_on_first_suspicion=True``).
+
+    ``contain=False`` is the bench comparison leg: suspicion is disabled
+    (``raise_threshold`` pinned unreachably high), the workers run the
+    fixed script only, and the ladder contract is not asserted — the run
+    measures what the SAME gray episode costs when nobody contains it.
+    The gray rules are index-windowed, so the episode eventually drains
+    through retransmits either way; only the goodput differs."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ml_pytorch_tpu.coord.grayhealth import GrayHealth
+    from distributed_ml_pytorch_tpu.parallel.sharded_ps import (
+        ShardedAsynchronous,
+    )
+    from distributed_ml_pytorch_tpu.utils.serialization import (
+        ravel_model_params,
+    )
+
+    assert n_shards >= 2, "gray_drill needs a healthy shard (n_shards >= 2)"
+    if fixture is not None:
+        x, y, grad_fn, params0 = fixture
+    else:
+        x, y, grad_fn, params0 = _default_fixture(seed)
+    flat0 = np.asarray(ravel_model_params(params0), np.float32)
+    n_params = int(flat0.shape[0])
+    # the victim is shard server 0 — the one star that carries the chaos
+    # plan, so the windowed gray rules land on ITS pull channels
+    victim_rank = 1
+
+    log = ChaosLog()
+    the_plan = plan if plan is not None else default_gray_plan(
+        seed, n_workers=n_workers, gray_from=gray_from,
+        gray_until=gray_until)
+    agent_rank = 1 + n_shards + n_workers
+    coord_world = InProcessTransport.create_world(2 + n_shards + n_workers)
+    star_chaos: List[Dict[int, FaultyTransport]] = []
+    for i in range(n_shards):
+        world = InProcessTransport.create_world(1 + n_workers)
+        hub = FaultyTransport(
+            world[0], the_plan if i == 0 else ChaosPlan(seed=seed), log=log)
+        star = {0: hub}
+        for r in range(1, 1 + n_workers):
+            star[r] = hub.sibling(world[r])
+        star_chaos.append(star)
+
+    def make_server_transport(i: int) -> ReliableTransport:
+        return ReliableTransport(
+            star_chaos[i][0], ack_timeout=0.05, max_backoff=0.25,
+            max_retries=120, unreliable_codes=DRILL_UNRELIABLE,
+            ack_on_delivery=False)
+
+    rel_workers: List[Dict[int, ReliableTransport]] = []
+    for i in range(n_shards):
+        rel_workers.append({
+            j: ReliableTransport(
+                star_chaos[i][j], ack_timeout=0.05, max_backoff=0.25,
+                max_retries=120, unreliable_codes=DRILL_UNRELIABLE)
+            for j in range(1, 1 + n_workers)})
+
+    manifest_path = os.path.join(base_dir, MANIFEST_NAME)
+    coord = Coordinator(
+        coord_world[0], n_params, lease=lease, speculation=False,
+        manifest_dir=base_dir)
+    knobs = dict(gray_knobs or {})
+    if not contain:
+        # the comparison leg: evidence still flows on the renew tails,
+        # but the detector can never fire — the episode runs unmanaged
+        knobs["raise_threshold"] = 1e9
+    gray = GrayHealth(
+        coord, actuator_rank=agent_rank,
+        confirm_ticks=2, clear_ticks=2, quarantine_after=8,
+        quarantine_cooldown=3.0, evict_after_quarantines=2,
+        **knobs)
+    coord_thread = threading.Thread(
+        target=coord.run, kwargs={"timeout": 600}, daemon=True)
+    coord_thread.start()
+
+    def start_server(i: int) -> ElasticShardServer:
+        client = CoordClient(coord_world[1 + i], "shard",
+                             renew_interval=lease / 4)
+        srv = ElasticShardServer(
+            server_id=1 + i, n_params=n_params,
+            transport=make_server_transport(i), coord=client,
+            init_params=flat0, ckpt_dir=os.path.join(base_dir, f"shard{i}"),
+            ckpt_every=0, wal=True, wal_group_n=wal_group_n)
+        t = threading.Thread(target=srv.run, kwargs={"timeout": 600},
+                             daemon=True)
+        t.start()
+        return srv
+
+    servers: List[ElasticShardServer] = [start_server(i)
+                                         for i in range(n_shards)]
+    retired_servers: List[ElasticShardServer] = []
+    _wait_for(lambda: len(coord.shard_map.entries) == n_shards, 60,
+              "all shard servers to join the map")
+
+    # --- the node agent: gray quarantine resumes land here --------------
+    violations: List[str] = []
+    resumed_info = {"replayed": 0, "bit_identical": None}
+    resume_failed = threading.Event()
+    resume_jobs: List[tuple] = []
+    resume_ready = threading.Event()
+    agent = CoordClient(coord_world[agent_rank], "agent",
+                        renew_interval=lease / 4)
+
+    def on_resume(grant_id, rank, snapshot_id):
+        resume_jobs.append((grant_id, rank, snapshot_id))
+        resume_ready.set()
+
+    agent.on_resume = on_resume
+    agent.join(timeout=30)
+
+    def do_resume(grant_id: int, rank: int, snapshot_id: int) -> None:
+        i = rank - 1
+        old = servers[i]
+        try:
+            if snapshot_id <= 0 or not os.path.exists(manifest_path):
+                raise FileNotFoundError(
+                    f"no manifest for snapshot {snapshot_id}")
+            manifest = FleetManifest.load(manifest_path)
+            detach = getattr(old.transport, "detach", None)
+            if detach is not None:
+                detach()
+            client = CoordClient(coord_world[1 + i], "shard",
+                                 renew_interval=lease / 4)
+            srv = ElasticShardServer(
+                server_id=1 + i, n_params=n_params,
+                transport=make_server_transport(i), coord=client,
+                init_params=flat0,
+                ckpt_dir=os.path.join(base_dir, f"shard{i}"),
+                ckpt_every=0, wal=True, wal_group_n=wal_group_n)
+            srv.restore_from_manifest(manifest)
+            resumed_info["replayed"] += srv.ps.replayed_updates
+            lo, hi = old.lo, old.hi
+            identical = (
+                np.array_equal(np.asarray(old.ps.central[lo:hi]),
+                               np.asarray(srv.ps.central[lo:hi]))
+                and srv.ps._apply_seq == old.ps._apply_seq
+                and dict(srv.ps.applied_by_sender)
+                == dict(old.ps.applied_by_sender))
+            resumed_info["bit_identical"] = identical
+            if not identical:
+                violations.append(
+                    f"gray resume of rank {rank} not bit-identical: parked "
+                    f"apply_seq {old.ps._apply_seq} vs restored "
+                    f"{srv.ps._apply_seq}")
+            retired_servers.append(old)
+            servers[i] = srv
+            threading.Thread(target=srv.run, kwargs={"timeout": 600},
+                             daemon=True).start()
+        except Exception as e:  # noqa: BLE001 — the violation IS the result
+            violations.append(
+                f"gray resume lost acked state: rank {rank} parked without "
+                f"a usable manifest ({e!r})")
+            resume_failed.set()
+
+    def agent_loop() -> None:
+        while not agent_stop.is_set():
+            if not resume_ready.wait(0.05):
+                continue
+            resume_ready.clear()
+            while resume_jobs:
+                do_resume(*resume_jobs.pop(0))
+
+    agent_stop = threading.Event()
+    agent_thread = threading.Thread(target=agent_loop, daemon=True)
+    agent_thread.start()
+
+    timings: Dict[str, float] = {}
+    losses: Dict[int, list] = {}
+    errors: list = []
+
+    def recovered() -> bool:
+        from distributed_ml_pytorch_tpu.coord.grayhealth import OK as G_OK
+
+        return ((gray.recoveries >= 1
+                 and gray.state_of(victim_rank) == G_OK)
+                or gray.evictions >= 1 or resume_failed.is_set())
+
+    def run_worker(j: int) -> None:
+        try:
+            _run_worker(j)
+        except Exception as e:  # noqa: BLE001 — surfaced to the caller
+            errors.append((j, repr(e)))
+
+    def _run_worker(j: int) -> None:
+        client = CoordClient(coord_world[n_shards + j], "worker",
+                             renew_interval=lease / 4)
+        m = client.join(timeout=30)
+        assert m is not None and m.entries, "worker never got a shard map"
+        factory = lambda entry: rel_workers[entry.server_id - 1][j]
+        params = jax.tree.map(jnp.asarray, params0)
+        opt = ShardedAsynchronous(
+            params, lr=lr, n_push=n_push, n_pull=n_pull,
+            transports=[factory(e) for e in m.entries],
+            coord=client, transport_factory=factory, shard_map=m)
+        rng = jax.random.key(100 + j)
+        my_losses = losses.setdefault(j, [])
+        step = 0
+        # fixed script, then a bounded flexible tail: keep the renew /
+        # pull / evidence cadence alive until the ladder clears (every
+        # chaos rule is index-windowed, so the tail cannot touch the log)
+        while step < steps or (contain and step < steps + extra_steps
+                               and not recovered()):
+            sel = np.random.default_rng(j * 1000 + step).integers(
+                0, len(x), batch)
+            loss, grads = grad_fn(params, x[sel], y[sel],
+                                  jax.random.fold_in(rng, step))
+            params = opt.step(params, grads)
+            my_losses.append(float(loss))
+            time.sleep(step_sleep)
+            step += 1
+            if step == steps:
+                # the fixed script is the same work on every leg; its
+                # completion time is the goodput denominator the bench
+                # compares containment-on vs -off with (the flexible
+                # recovery tail would otherwise pad the ratio)
+                timings[f"fixed_done_w{j}"] = time.monotonic()
+        opt.finish()
+        client.close()
+
+    worker_threads = [threading.Thread(target=run_worker, args=(j,),
+                                       daemon=True)
+                      for j in range(1, n_workers + 1)]
+    timings["day_start"] = time.monotonic()
+    for t in worker_threads:
+        t.start()
+    for t in worker_threads:
+        t.join(timeout=600)
+    timings["day_end"] = time.monotonic()
+    stuck = [t for t in worker_threads if t.is_alive()]
+    agent_stop.set()
+    agent_thread.join(timeout=10)
+    for srv in servers:
+        srv.stop()
+    time.sleep(0.05)
+    agent.close()
+    coord.stop()
+    coord_thread.join(timeout=30)
+
+    # ---- the gray contract: contained, never killed --------------------
+    if contain:
+        if gray.probations < 1:
+            violations.append(
+                "gray victim was never detected (no probation)")
+        if gray.quarantines < 1:
+            violations.append(
+                "gray victim was never contained (no quarantine)")
+        if gray.evictions > 0:
+            violations.append(
+                f"gray plane EVICTED {gray.evictions} member(s) — "
+                "containment must degrade, not kill")
+        if gray.recoveries < 1 and not resume_failed.is_set():
+            violations.append(
+                "quarantined victim never earned its way back")
+    expiry = [e for e in coord.events
+              if "lease expired" in e and f" {victim_rank} " in e]
+    if expiry:
+        violations.append(
+            f"renewing-but-gray victim lease-expired: {expiry[0]!r}")
+
+    # ---- per-(worker, shard) accounting: every acked push applied ------
+    acked: Dict[int, Dict[int, int]] = {}
+    applied: Dict[int, Dict[int, int]] = {}
+    for i in range(n_shards):
+        acked[i] = {j: (rel_workers[i][j].acked_count(
+            0, MessageCode.ShardPush) + rel_workers[i][j].acked_count(
+            0, MessageCode.GradientUpdate) + rel_workers[i][j].acked_count(
+            0, MessageCode.CompressedUpdate))
+            for j in range(1, 1 + n_workers)}
+        applied[i] = {j: servers[i].ps.applied_by_sender.get(j, 0)
+                      for j in range(1, 1 + n_workers)}
+        for j in range(1, 1 + n_workers):
+            if acked[i][j] > applied[i][j]:
+                violations.append(
+                    f"acked delta lost: shard {i} worker {j}: acked "
+                    f"{acked[i][j]} > applied {applied[i][j]}")
+
+    for star in rel_workers:
+        for t in star.values():
+            t.close()
+    for srv in servers:
+        close = getattr(srv.transport, "close", None)
+        if close is not None:
+            close()
+    for t in coord_world.values():
+        t.close()
+
+    gstats = gray.stats()
+    return {
+        "ok": not stuck and not errors and not violations,
+        "violations": violations,
+        "errors": errors,
+        "stuck_workers": len(stuck),
+        "losses": losses,
+        "acked": acked,
+        "applied": applied,
+        "replayed_updates": resumed_info["replayed"],
+        "bit_identical": resumed_info["bit_identical"],
+        "gray": gstats,
+        "detect_latency_s": (gstats["detection_latencies"][0]
+                             if gstats["detection_latencies"] else None),
+        "containment_mttr_s": (gstats["containment_mttrs"][0]
+                               if gstats["containment_mttrs"] else None),
+        "events": list(coord.events),
+        "chaos_lines": log.lines(),
+        "chaos_counts": log.counts(),
+        "wall_s": timings["day_end"] - timings["day_start"],
+        "fixed_wall_s": (max(timings[k] for k in timings
+                             if k.startswith("fixed_done_w"))
+                         - timings["day_start"]
+                         if any(k.startswith("fixed_done_w")
+                                for k in timings) else None),
         "servers": servers,
     }
 

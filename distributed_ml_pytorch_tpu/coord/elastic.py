@@ -46,6 +46,7 @@ import numpy as np
 
 from distributed_ml_pytorch_tpu.coord.member import CoordClient
 from distributed_ml_pytorch_tpu.coord.shardmap import ShardMap
+from distributed_ml_pytorch_tpu.utils.chaos import gray_injector
 from distributed_ml_pytorch_tpu.parallel.async_ps import ParameterServer
 from distributed_ml_pytorch_tpu.utils.messaging import (
     MessageCode,
@@ -148,6 +149,18 @@ class ElasticShardServer:
         self._mu = threading.Lock()
         self._stop = threading.Event()
         self._crashed = False
+        #: gray plane (ISSUE 20): if a FaultyTransport sits anywhere under
+        #: this transport, scheduled gray stall rules can slow the serve
+        #: loop and the WAL-commit path — and the SAME tail that renews the
+        #: lease ships the evidence (retransmit rate, blocked-send seconds,
+        #: fsync p95, busy-vs-wall ratio) so the coordinator can tell
+        #: "slow" from "dead" without a second probe channel
+        self._gray = gray_injector(transport)
+        self._fsync_spans: list = []
+        self._busy_s = 0.0
+        self._win_start = 0.0
+        self._gray_report_at = 0.0
+        self._wire_base = (0, 0, 0.0)
 
     def crash(self) -> None:
         """Chaos-script hook: die SILENTLY — the serve loop exits, lease
@@ -518,6 +531,55 @@ class ElasticShardServer:
             self.ps.handle(sender, MessageCode.GradientUpdate, values)
             self.stats["spec_applied"] += 1
 
+    # ----------------------------------------------------------------- gray
+    def _commit_timed(self) -> None:
+        """Close the open WAL group, absorbing any scheduled gray fsync
+        stall INTO the measured span — an injected slow disk must show up
+        in the fsync p95 the renew tail reports, exactly like a real
+        one."""
+        t0 = time.monotonic()
+        if self._gray is not None:
+            d = self._gray.gray_stall("fsync")
+            if d > 0.0:
+                time.sleep(d)
+        with self._mu:
+            self.ps.commit()
+        span = time.monotonic() - t0
+        self._fsync_spans.append(span)
+        if len(self._fsync_spans) > 64:
+            del self._fsync_spans[:-64]
+        self._busy_s += span
+
+    def _report_gray(self, now: float) -> None:
+        """Fold wire-stats deltas + fsync spans + serve-loop busy ratio
+        into the next lease renewal (:meth:`CoordClient.report_gray_health`).
+        Rates are per-report-window deltas, not lifetime totals, so the
+        coordinator's adaptive baseline sees CURRENT weather."""
+        if now < self._gray_report_at:
+            return
+        wall = now - self._win_start if self._win_start else 0.0
+        self._gray_report_at = now + 0.25
+        self._win_start = now
+        st = getattr(self.transport, "stats", None)
+        retrans = blocked = 0.0
+        if isinstance(st, dict):
+            sent = int(st.get("sent", 0))
+            retries = int(st.get("retries", 0))
+            blk = float(st.get("window_blocked_s", 0.0))
+            b_sent, b_retries, b_blk = self._wire_base
+            retrans = (retries - b_retries) / max(1, sent - b_sent)
+            blocked = max(0.0, blk - b_blk)
+            self._wire_base = (sent, retries, blk)
+        spans = sorted(self._fsync_spans)
+        p95_ms = (spans[int(0.95 * (len(spans) - 1))] * 1000.0
+                  if spans else 0.0)
+        busy = (min(1.0, self._busy_s / wall) if wall > 0.05 else 0.0)
+        self._busy_s = 0.0
+        report = getattr(self.coord, "report_gray_health", None)
+        if report is not None:
+            report(retrans_rate=retrans, blocked_s=blocked,
+                   fsync_p95_ms=p95_ms, busy_ratio=busy)
+
     # ------------------------------------------------------------------ run
     def stop(self) -> None:
         self._stop.set()
@@ -528,9 +590,15 @@ class ElasticShardServer:
         if m is not None:
             self._apply_map(m)
         deadline = None if timeout is None else time.monotonic() + timeout
+        self._win_start = time.monotonic()
         while not self._stop.is_set():
             if deadline is not None and time.monotonic() >= deadline:
                 break
+            if self._gray is not None:
+                d = self._gray.gray_stall("serve")
+                if d > 0.0:
+                    time.sleep(d)  # gray weather: slow, NOT dead
+            self._report_gray(time.monotonic())
             m = self.coord.take_shard_map()
             if m is not None:
                 self._apply_map(m)
@@ -558,28 +626,28 @@ class ElasticShardServer:
             if msg is None:
                 # idle: close the open WAL group so deferred delivery acks
                 # never wait longer than one recv timeout
-                with self._mu:
-                    self.ps.commit()
+                self._commit_timed()
                 continue
             sender, code, payload = msg
             envelope = getattr(self.transport, "last_delivery", None)
             if code in (MessageCode.Heartbeat, MessageCode.WorkerDone):
                 # worker lifecycle is the coordinator's job here, but an
                 # enveloped WorkerDone still owes its (deferred) ack
-                with self._mu:
-                    self.ps.commit()
+                self._commit_timed()
                 continue
+            t0 = time.monotonic()
             try:
                 self.handle(sender, code, payload, envelope)
             except (ValueError, IndexError, OverflowError):
+                self._busy_s += time.monotonic() - t0
                 continue  # malformed frame: drop, never die
+            self._busy_s += time.monotonic() - t0
             if (self.ps.wal is None
                     or code not in (MessageCode.GradientUpdate,
                                     MessageCode.ShardPush,
                                     MessageCode.CompressedUpdate)
                     or self.ps.wal.pending >= self.ps.wal_group_n):
-                with self._mu:
-                    self.ps.commit()
+                self._commit_timed()
         if self._crashed:
             return  # scripted silent death: no checkpoint, no leave
         if self._parked:

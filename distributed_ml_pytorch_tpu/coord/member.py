@@ -207,6 +207,11 @@ class CoordClient:
         #: breaker count (ISSUE 7); the last four are the numerical-health
         #: telemetry (ISSUE 8)
         self._progress = (0, 0, 0.0, 0, 0, 0, 0.0, 0.0)
+        #: gray-health tail (ISSUE 20): (retrans_rate, nack_rate,
+        #: blocked_s, fsync_p95_ms, busy_ratio) + per-link evidence
+        #: triples — shipped behind the numerical tail on every renew
+        self._gray_health = (0.0, 0.0, 0.0, 0.0, 0.0)
+        self._gray_links: tuple = ()
         self._stop = threading.Event()
         self._listener = threading.Thread(
             target=self._pump, name="coord-listener", daemon=True)
@@ -316,9 +321,12 @@ class CoordClient:
             with self._lock:
                 (push_count, step, ewma_ms, wire_open, nacks, bad_loss,
                  loss_ewma, gnorm_ewma) = self._progress
+                gray_health = self._gray_health
+                gray_links = self._gray_links
             self._send(MessageCode.LeaseRenew, encode_renew(
                 self.incarnation, push_count, step, ewma_ms, wire_open,
-                nacks, bad_loss, loss_ewma, gnorm_ewma))
+                nacks, bad_loss, loss_ewma, gnorm_ewma, *gray_health,
+                links=gray_links))
             tick += 1
             if tick % 4 == 0:
                 # periodic re-JOIN: the coordinator ignores frames from
@@ -357,6 +365,25 @@ class CoordClient:
             self._progress = (int(push_count), int(step), float(ewma_ms),
                               int(wire_open), int(nacks), int(bad_loss),
                               float(loss_ewma), float(gnorm_ewma))
+
+    def report_gray_health(self, retrans_rate: float = 0.0,
+                           nack_rate: float = 0.0, blocked_s: float = 0.0,
+                           fsync_p95_ms: float = 0.0,
+                           busy_ratio: float = 0.0, links=()) -> None:
+        """Stash this member's data-plane weather (ISSUE 20); the renew
+        thread ships it behind the numerical tail. ``links`` is a sequence
+        of ``(peer_rank, link_retrans_rate, link_blocked_s)`` triples —
+        per-DIRECTED-LINK evidence, so the coordinator can suspect a
+        one-way partition on one link while both endpoints stay live.
+        Typical sources: ``ReliableTransport.stats()`` retries/sent per
+        window, window_blocked_s deltas, WAL fsync spans, serve-loop
+        busy-vs-wall ratios."""
+        with self._lock:
+            self._gray_health = (
+                float(retrans_rate), float(nack_rate), float(blocked_s),
+                float(fsync_p95_ms), float(busy_ratio))
+            self._gray_links = tuple(
+                (int(p), float(r), float(b)) for p, r, b in links)
 
     def current_map(self) -> Optional[ShardMap]:
         with self._lock:

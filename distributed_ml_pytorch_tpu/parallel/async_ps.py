@@ -1175,6 +1175,12 @@ class Listener(MessageListener):
         #: clean listener refuses a delta whose base stamp is not exactly
         #: its held view; True applies it blindly onto whatever it has
         self.delta_trust = False
+        #: gray plane (ISSUE 20): pull replies of ANY kind delivered on
+        #: this link — even a malformed one proves the wire carried a
+        #: frame. The worker's requests-vs-replies window delta is the
+        #: third-party link evidence that catches a ONE-WAY partition the
+        #: server's own renew tail can never see.
+        self.replies = 0
 
     def held_stamp(self) -> np.ndarray:
         """This worker's pull-request tail: ``[held_epoch, held_ver_lo,
@@ -1232,6 +1238,11 @@ class Listener(MessageListener):
 
     def receive(self, sender: int, message_code: MessageCode, parameter: np.ndarray) -> None:
         _LOGGER.info("Processing message: %s", message_code.name)
+        if message_code in (MessageCode.DeltaParams,
+                            MessageCode.ParameterUpdate,
+                            MessageCode.ShardParams):
+            with self._lock:
+                self.replies += 1
         if message_code == MessageCode.DeltaParams:
             self._on_delta_params(parameter)
         elif message_code == MessageCode.ParameterUpdate:

@@ -291,6 +291,16 @@ class ShardedAsynchronous:
         #: drill accounting (acked <= applied) holds through the window.
         self.shard_held = [False] * len(self.transports)
         self.held_pushes = 0
+        #: gray plane (ISSUE 20): per-server pull requests actually sent
+        #: (held shards excluded — a deliberate park is not link weather)
+        #: and a short history of (reqs, replies, sent, retries, blocked)
+        #: totals per server. The windowed requests-vs-replies delta is
+        #: this worker's THIRD-PARTY evidence about each shard link — the
+        #: only witness a one-way partition has, since the shard's own
+        #: renew tail still flows. Rides the existing lease renewals via
+        #: ``coord.report_gray_health(links=...)``.
+        self._pull_reqs: dict = {}
+        self._link_hist: list = []
         self.heartbeats = list(heartbeats) if heartbeats else None
         if self.heartbeats is not None and len(self.heartbeats) != len(self.transports):
             raise ValueError("need one heartbeat sender per shard transport")
@@ -381,6 +391,9 @@ class ShardedAsynchronous:
             if code in (MessageCode.GradientUpdate, MessageCode.ShardPush):
                 self.held_pushes += 1
             return
+        if code == MessageCode.ParameterRequest:
+            sid = self.server_ids[shard]
+            self._pull_reqs[sid] = self._pull_reqs.get(sid, 0) + 1
         if self.shard_down[shard]:
             if code != MessageCode.ParameterRequest:
                 return
@@ -437,6 +450,49 @@ class ShardedAsynchronous:
             f"worker: shard {server_id} RELEASED — push/pull service "
             "resumes", file=sys.stderr,
         )
+
+    def _gray_links(self) -> tuple:
+        """Windowed per-shard link evidence for the renew tail (ISSUE 20).
+
+        Snapshots per-server totals once per step and measures against the
+        oldest snapshot in an 8-step window: pull requests sent vs replies
+        delivered (ONE outstanding reply is tolerated — an answer still in
+        flight is not weather), plus the reliable wire's retransmit and
+        blocked-send deltas over the same window. A one-way partition that
+        eats requests (or replies) on ONE direction shows here and nowhere
+        else — the shard's own renew tail still flows, so this worker is
+        the only witness."""
+        snap = {}
+        for s, sid in enumerate(self.server_ids):
+            st = getattr(self.transports[s], "stats", None)
+            blocked = 0.0
+            if isinstance(st, dict):
+                blocked = float(st.get("window_blocked_s", 0.0))
+            snap[sid] = (self._pull_reqs.get(sid, 0),
+                         int(getattr(self.listeners[s], "replies", 0)),
+                         blocked)
+        self._link_hist.append(snap)
+        if len(self._link_hist) > 9:
+            del self._link_hist[:-9]
+        base = self._link_hist[0]
+        links = []
+        for sid, (reqs, reps, blocked) in snap.items():
+            b = base.get(sid)
+            if b is None:
+                continue  # shard joined mid-window: no baseline yet
+            req_w = reqs - b[0]
+            rep_w = max(0, reps - b[1])  # listener rebuilt on resize: clamp
+            # two outstanding replies tolerated: a busy-but-honest server
+            # answering a window behind is latency, not weather. Raw
+            # retransmit counts are deliberately NOT folded in: deferred
+            # delivery acks make retransmits steady-state NORMAL on this
+            # wire — the reliable channel's gray signature is blocked-send
+            # seconds, which rides the second field.
+            miss = (max(0, req_w - rep_w - 2) / req_w) if req_w > 0 else 0.0
+            blk_w = max(0.0, blocked - b[2])
+            if req_w > 0:
+                links.append((sid, miss, blk_w))
+        return tuple(links)
 
     def _mark_down(self, shard: int) -> None:
         if self.shard_down[shard]:
@@ -694,6 +750,10 @@ class ShardedAsynchronous:
                               nacks=self.nacks, bad_loss=self._bad_loss,
                               loss_ewma=self._loss_ewma.value,
                               gnorm_ewma=self._gnorm_ewma.value)
+            # per-link gray evidence rides the SAME renewals (ISSUE 20)
+            grh = getattr(self.coord, "report_gray_health", None)
+            if grh is not None:
+                grh(links=self._gray_links())
         self._maybe_rollback()
         self._resync_on_nacks()
         self._maybe_cutover(params)

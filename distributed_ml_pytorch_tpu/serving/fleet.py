@@ -202,6 +202,12 @@ class FleetRouter(ServingFrontend):
         #: coord ranks ever seen live by the fleet view — lease expiry is
         #: "was there, now is not", never "has not joined yet"
         self._seen_ranks: set = set()
+        #: engines the gray plane (ISSUE 20) put on PROBATION: still
+        #: routable (the member is alive — degrading beats killing) but
+        #: scored with a capacity penalty so fresh streams bend away from
+        #: the suspect while it recovers. Wired by the harness from
+        #: ``GrayHealth(on_probation=...)`` / ``on_clear``.
+        self._gray_penalized: set = set()
         self._affinity: Dict[Tuple[int, int], int] = {}
         self.migrations = 0          # streams moved across an engine death
         self.migration_failures = 0  # a healthy survivor refused the stream
@@ -228,6 +234,15 @@ class FleetRouter(ServingFrontend):
         super().__init__(None, transport, **kw)
 
     # --------------------------------------------------------------- routing
+    def note_gray(self, engine_id: int) -> None:
+        """Gray-plane probation actuator (ISSUE 20): penalize this engine
+        in routing scores without marking it down. Idempotent; undone by
+        :meth:`clear_gray`."""
+        self._gray_penalized.add(int(engine_id))
+
+    def clear_gray(self, engine_id: int) -> None:
+        self._gray_penalized.discard(int(engine_id))
+
     def _healthy_members(self) -> List[EngineMember]:
         return [m for eid, m in sorted(self.members.items())
                 if self._member_up.get(eid, False)]
@@ -241,7 +256,14 @@ class FleetRouter(ServingFrontend):
         scored = []
         for m in healthy:
             busy, slots, queued = m.pressure()
-            scored.append(((slots - busy - queued), -m.engine_id, m))
+            free = slots - busy - queued
+            if m.engine_id in self._gray_penalized:
+                # gray probation (ISSUE 20): the suspect scores as if its
+                # free capacity were halved (floored at a strict loss so a
+                # tie always routes elsewhere) — route-around, not removal:
+                # with every other engine full it still takes the stream
+                free = min(free - 1, free // 2)
+            scored.append((free, -m.engine_id, m))
         scored.sort(reverse=True)
         best = scored[0][2]
         if self.session_affinity and route.session:

@@ -188,31 +188,93 @@ class SDCRule:
         return True
 
 
+@dataclasses.dataclass(frozen=True)
+class GrayRule:
+    """A scheduled GRAY failure (ISSUE 20): the member stays alive and keeps
+    renewing its lease while its data plane rots. Three kinds:
+
+    - ``"partition"`` — a windowed ONE-WAY partition: every matching frame
+      vanishes (the rule form of the imperative
+      :meth:`FaultyTransport.partition`, so asymmetric partitions are
+      schedulable in ChaosPlan JSON and replayable from counterexamples).
+    - ``"lossy"`` — a sustained drop-rate link: each matching frame is
+      dropped with probability ``p`` (a flaky NIC, not a dead one).
+    - ``"stall"`` — an injected serve-side stall (fsync, serve-loop):
+      matched not on a wire channel but on a per-``(rank, site)`` operation
+      counter via :meth:`FaultyTransport.gray_stall`; each matching op
+      sleeps ``stall_ms`` with probability ``p``. ``src`` is the stalled
+      rank (``None`` = any), ``dst``/``code`` are ignored.
+
+    Determinism: gray drop decisions come from their own per-channel seeded
+    stream (``SeedSequence([seed, src, dst, code, _GRAY_NS])``) and stall
+    draws from a per-``(rank, site)`` stream, so adding gray rules never
+    perturbs an existing plan's fault/weather/SDC decisions — pre-ISSUE-20
+    chaos logs stay byte-identical. ``after``/``until`` window on the
+    channel's send index (or the site's op index for stalls), like every
+    other rule kind.
+    """
+
+    kind: str = "partition"             # "partition" | "lossy" | "stall"
+    src: Optional[int] = None
+    dst: Optional[int] = None
+    code: Optional[int] = None          # MessageCode value, or None = any
+    p: float = 1.0                      # drop/stall probability
+    stall_ms: float = 0.0               # sleep per stalled op (kind="stall")
+    site: str = ""                      # stall site label, e.g. "fsync"
+    after: int = 0
+    until: Optional[int] = None
+
+    def __post_init__(self):
+        if self.kind not in ("partition", "lossy", "stall"):
+            raise ValueError(f"unknown gray kind: {self.kind!r}")
+
+    def matches(self, src: int, dst: int, code: int, index: int) -> bool:
+        if self.src is not None and src != self.src:
+            return False
+        if self.dst is not None and dst != self.dst:
+            return False
+        if self.code is not None and code != int(self.code):
+            return False
+        if index < self.after:
+            return False
+        if self.until is not None and index >= self.until:
+            return False
+        return True
+
+
 #: namespace tag separating the weather RNG stream from the fault stream
 _WEATHER_NS = 0x57454154  # "WEAT"
 
 #: namespace tag for the SDC draw stream (separate from faults AND weather)
 _SDC_NS = 0x53444331  # "SDC1"
 
+#: namespace tag for the gray-failure draw stream (separate from all three)
+_GRAY_NS = 0x47524159  # "GRAY"
+
 
 @dataclasses.dataclass(frozen=True)
 class ChaosPlan:
     """An ordered fault script plus the seed every channel RNG derives
-    from; ``weather`` adds link-level latency/jitter/bandwidth rules and
-    ``sdc`` adds payload-numeric silent-corruption rules (ISSUE 8)."""
+    from; ``weather`` adds link-level latency/jitter/bandwidth rules,
+    ``sdc`` adds payload-numeric silent-corruption rules (ISSUE 8), and
+    ``gray`` adds gray-failure rules — one-way partitions, sustained-loss
+    links, injected stalls (ISSUE 20)."""
 
     rules: Tuple[FaultRule, ...] = ()
     seed: int = 0
     weather: Tuple[WeatherRule, ...] = ()
     sdc: Tuple[SDCRule, ...] = ()
+    gray: Tuple[GrayRule, ...] = ()
 
     def __init__(self, rules: Sequence[FaultRule] = (), seed: int = 0,
                  weather: Sequence[WeatherRule] = (),
-                 sdc: Sequence[SDCRule] = ()):
+                 sdc: Sequence[SDCRule] = (),
+                 gray: Sequence[GrayRule] = ()):
         object.__setattr__(self, "rules", tuple(rules))
         object.__setattr__(self, "seed", int(seed))
         object.__setattr__(self, "weather", tuple(weather))
         object.__setattr__(self, "sdc", tuple(sdc))
+        object.__setattr__(self, "gray", tuple(gray))
 
     def rule_for(self, src: int, dst: int, code: int, index: int) -> Optional[FaultRule]:
         for rule in self.rules:
@@ -234,12 +296,32 @@ class ChaosPlan:
                 return rule
         return None
 
+    def gray_for(self, src: int, dst: int, code: int,
+                 index: int) -> Optional[GrayRule]:
+        """First matching WIRE gray rule (partition/lossy); stall rules
+        match op counters, not send channels — see :meth:`stall_for`."""
+        for rule in self.gray:
+            if rule.kind != "stall" and rule.matches(src, dst, code, index):
+                return rule
+        return None
+
+    def stall_for(self, rank: int, site: str,
+                  index: int) -> Optional[GrayRule]:
+        """First matching stall rule for op #``index`` at ``(rank, site)``."""
+        for rule in self.gray:
+            if (rule.kind == "stall" and rule.site == site
+                    and (rule.src is None or rule.src == rank)
+                    and index >= rule.after
+                    and (rule.until is None or index < rule.until)):
+                return rule
+        return None
+
 
 #: rule kinds of a serialized plan, in field order — the JSON round-trip
 #: (ISSUE 13) is what lets the bounded model checker (analysis/distmodel)
 #: emit every counterexample as a concrete, runnable chaos schedule
 _RULE_KINDS = (("rules", FaultRule), ("weather", WeatherRule),
-               ("sdc", SDCRule))
+               ("sdc", SDCRule), ("gray", GrayRule))
 
 
 def plan_to_json(plan: ChaosPlan) -> dict:
@@ -281,7 +363,8 @@ def plan_from_json(data: dict) -> ChaosPlan:
                     f"unknown {cls.__name__} fields: {sorted(bad)}")
             rules.append(cls(**row))
         kw[key] = tuple(rules)
-    return ChaosPlan(kw["rules"], kw["seed"], kw["weather"], kw["sdc"])
+    return ChaosPlan(kw["rules"], kw["seed"], kw["weather"], kw["sdc"],
+                     kw["gray"])
 
 
 class ChaosLog:
@@ -336,7 +419,7 @@ class _WorldState:
 
 
 class _Channel:
-    __slots__ = ("index", "rng", "weather_rng", "held")
+    __slots__ = ("index", "rng", "weather_rng", "gray_rng", "held")
 
     def __init__(self, seed: int, src: int, dst: int, code: int):
         self.index = 0
@@ -347,6 +430,10 @@ class _Channel:
         self.weather_rng = np.random.default_rng(
             np.random.SeedSequence(
                 [seed & 0xFFFFFFFF, src, dst, code, _WEATHER_NS]))
+        #: separate stream for gray drop draws (ISSUE 20) — same contract
+        self.gray_rng = np.random.default_rng(
+            np.random.SeedSequence(
+                [seed & 0xFFFFFFFF, src, dst, code, _GRAY_NS]))
         #: reorder buffer: (payload, weather_u, fault_index) of the held frame
         self.held: Optional[tuple] = None
 
@@ -378,6 +465,10 @@ class FaultyTransport(Transport):
         #: re-logging (the log must not depend on retry timing)
         self._sdc_counts: Dict[Tuple[int, int, int], int] = {}
         self._sdc_logged: set = set()
+        #: gray stall bookkeeping (ISSUE 20): per-site op counters + draw
+        #: streams, keyed by the stall site label ("fsync", "serve", ...)
+        self._stall_counts: Dict[str, int] = {}
+        self._stall_rngs: Dict[str, np.random.Generator] = {}
         self._lock = threading.Lock()
         self._partitioned: set = set()  # dsts this endpoint cannot reach
         self._link_busy: Dict[int, float] = {}  # bandwidth-cap serialization
@@ -450,6 +541,41 @@ class FaultyTransport(Transport):
         with self._world.lock:
             return rank in self._world.crashed
 
+    # ----------------------------------------------------------- gray stalls
+    def gray_stall(self, site: str) -> float:
+        """Gray stall injection point (ISSUE 20, kind="stall"): serve loops
+        and fsync paths call this once per operation; the op increments a
+        per-``(rank, site)`` counter, a matching stall rule fires with
+        probability ``p`` on its own seeded stream, and the caller sleeps
+        the returned seconds (0.0 = no stall). Fired stalls are logged as
+        ``gray-stall-<site>`` events with code ``-1`` (no wire channel),
+        quantized to the rule's scripted ``stall_ms`` — so for scripts
+        whose op sequences are deterministic the log replays exactly.
+
+        Determinism caveat: op indices are deterministic only where the op
+        SEQUENCE is (fixed step counts / cadences). Wall-clock-paced serve
+        loops should pin stall determinism in direct-call unit tests and
+        use partition/lossy rules for byte-identical drill acceptance."""
+        if not self.plan.gray:
+            return 0.0
+        with self._lock:
+            i = self._stall_counts.get(site, 0)
+            self._stall_counts[site] = i + 1
+            rng = self._stall_rngs.get(site)
+            if rng is None:
+                tag = int.from_bytes(
+                    site.encode()[:4].ljust(4, b"\0"), "big")
+                rng = self._stall_rngs[site] = np.random.default_rng(
+                    np.random.SeedSequence(
+                        [self.plan.seed & 0xFFFFFFFF, self.rank, tag,
+                         _GRAY_NS]))
+            su = float(rng.uniform())
+        rule = self.plan.stall_for(self.rank, site, i)
+        if rule is None or su >= rule.p or rule.stall_ms <= 0:
+            return 0.0
+        self.log.record(self.rank, self.rank, -1, i, f"gray-stall-{site}")
+        return rule.stall_ms / 1000.0
+
     # --------------------------------------------------------------- faults
     def _channel(self, dst: int, code: int) -> _Channel:
         key = (self.rank, dst, code)
@@ -493,9 +619,24 @@ class FaultyTransport(Transport):
             u = chan.rng.uniform(size=5)
             wu = (float(chan.weather_rng.uniform(-1.0, 1.0))
                   if self.plan.weather else 0.0)
+            # the gray draw is conditional on the plan carrying gray rules
+            # (like weather): a pre-ISSUE-20 plan's streams consume exactly
+            # the same uniforms as before, so its logs stay byte-identical
+            gu = (float(chan.gray_rng.uniform())
+                  if self.plan.gray else 1.0)
         if dst in self._partitioned:
             self.log.record(self.rank, dst, int(code), i, "partition-drop")
             return
+        gray = (self.plan.gray_for(self.rank, dst, int(code), i)
+                if self.plan.gray else None)
+        if gray is not None:
+            if gray.kind == "partition":
+                self.log.record(self.rank, dst, int(code), i,
+                                "gray-partition")
+                return
+            if gu < gray.p:  # kind == "lossy"
+                self.log.record(self.rank, dst, int(code), i, "gray-drop")
+                return
         rule = self.plan.rule_for(self.rank, dst, int(code), i)
         if rule is None:
             self._forward(code, payload, dst, chan, wu, i)
@@ -709,3 +850,18 @@ class FaultyTransport(Transport):
             except (OSError, ConnectionError, KeyError):
                 pass  # the peer is already gone; nothing left to reorder to
         self.inner.close()
+
+
+def gray_injector(transport) -> Optional[FaultyTransport]:
+    """Walk a transport's ``.inner`` wrapper chain (ReliableTransport →
+    FaultyTransport → ...) to the :class:`FaultyTransport`, if any — how
+    serve loops find their ``gray_stall`` injection point without the
+    harness having to thread the wrapper through every constructor."""
+    seen = 0
+    t = transport
+    while t is not None and seen < 8:
+        if isinstance(t, FaultyTransport):
+            return t
+        t = getattr(t, "inner", None)
+        seen += 1
+    return None

@@ -334,15 +334,22 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
         doc="explicit leave; stale incarnations cannot evict newer lives"),
     MessageCode.LeaseRenew: PayloadSchema(
         fields=("inc_lo", "inc_hi", "push_count", "step", "ewma_ms",
-                "wire_open", "nacks", "bad_loss", "loss_ewma", "gnorm_ewma"),
-        handled_by=("coord",),
+                "wire_open", "nacks", "bad_loss", "loss_ewma", "gnorm_ewma",
+                "retrans_rate", "nack_rate", "blocked_s", "fsync_p95_ms",
+                "busy_ratio"),
+        rest="gray_links", handled_by=("coord",),
         dedup_key="incarnation", delivery="best_effort",
         doc="lease refresh carrying the straggler-detector progress report, "
-            "the member's open-circuit-breaker count (wire health) and the "
+            "the member's open-circuit-breaker count (wire health), the "
             "numerical-health telemetry (ISSUE 8): cumulative admission "
             "nacks received, nonfinite-loss count, and loss / grad-norm "
-            "EWMAs — the reputation + rollback-watchdog inputs (receivers "
-            "tolerate the 5/6-field pre-ISSUE-7/8 forms)"),
+            "EWMAs — the reputation + rollback-watchdog inputs — and the "
+            "gray-health tail (ISSUE 20): retransmit rate, nack rate, "
+            "blocked-send seconds, fsync p95 and busy-vs-wall ratio, plus "
+            "per-directed-link (peer, retrans, blocked_s) evidence triples "
+            "in the rest — the adaptive-suspicion inputs (receivers "
+            "tolerate the 5/6/10-field pre-ISSUE-7/8/20 forms with "
+            "neutral gray defaults)"),
     MessageCode.ShardMapUpdate: PayloadSchema(
         fields=("n_entries", "version_lo", "version_hi", "n_params_lo",
                 "n_params_hi"),
@@ -360,7 +367,9 @@ WIRE_SCHEMAS: Dict[MessageCode, PayloadSchema] = {
             "and, behind a -1 separator (ranks are non-negative, so the "
             "split is unambiguous; a tail without one decodes as "
             "pre-ISSUE-12), the fleet_metrics registry summary in "
-            "coord/coordinator.FLEET_METRICS_FIELDS order"),
+            "coord/coordinator.FLEET_METRICS_FIELDS order (the decoder "
+            "zips names to the floats that arrived, so the ISSUE-20 "
+            "gray_suspects field is absent, not wrong, on short frames)"),
     MessageCode.SpeculateTask: PayloadSchema(
         fields=("task_id", "victim_rank", "from_step"),
         handled_by=("coord",),

@@ -185,6 +185,16 @@ def pytest_configure(config):
         "exactly these — fast units run in tier-1, the training "
         "acceptance is additionally measured into slow_tests.txt",
     )
+    config.addinivalue_line(
+        "markers",
+        "gray: gray-failure plane tests (coord/grayhealth.py adaptive "
+        "suspicion + containment ladder, utils/chaos.GrayRule scheduled "
+        "one-way partitions / lossy links / stalls, the renew-tail wire "
+        "compatibility, the gray distmodel plane — ISSUE 20); `make "
+        "gray` selects exactly these — fast units run in tier-1, the "
+        "mid-training gray drill acceptance is additionally measured "
+        "into slow_tests.txt",
+    )
 
 
 # Modules whose tests launch real subprocess worlds (interpreter start + jit

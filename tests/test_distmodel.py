@@ -148,7 +148,8 @@ def test_dropped_ack_rules_are_windowed_not_blackholes():
     "no_error_feedback", "decode_before_admission",
     "stale_delta_base", "no_full_fallback_on_restore",
     "park_without_manifest", "double_grant_slot",
-    "no_epoch_fence", "expire_on_restart", "forget_parked"])
+    "no_epoch_fence", "expire_on_restart", "forget_parked",
+    "no_hysteresis", "symmetric_probe_only", "evict_on_first_suspicion"])
 def test_counterexample_replays_on_real_stack(mutation, tmp_path):
     """The acceptance bar: the model-level violation reproduces on the
     real transport/server stack under the mutated configuration, and the

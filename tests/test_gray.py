@@ -1,0 +1,601 @@
+"""ISSUE 20 — the gray-failure plane.
+
+Four layers:
+
+- **chaos**: ``GrayRule`` (partition / lossy / stall) is schedulable in
+  ChaosPlan JSON, windowed on per-channel send indices, drawn from its
+  own seeded stream — adding gray rules (or flipping the imperative
+  ``partition``/``heal`` switch mid-plan) never perturbs an existing
+  plan's fault/weather/SDC decisions;
+- **wire**: the LeaseRenew gray tail is back-compatible in both
+  directions — pre-ISSUE-20 frames decode with neutral gray defaults,
+  the evolved frame is a pure extension of the pre-ISSUE-20 prefix, and
+  malformed tails are length-gated away;
+- **ladder**: ``GrayHealth`` confirms suspicion with hysteresis, degrades
+  probation -> quarantine (checkpoint-park, lease exempt) -> eviction
+  only for confirmed-gray, and a resumed member re-enters at PROBATION;
+- **acceptance** (slow): the mid-training gray drill contains a windowed
+  one-way partition without killing anyone, byte-identical chaos logs 3x.
+
+``make gray`` selects exactly these (plus the gray distmodel replays in
+tests/test_distmodel.py carrying their own markers).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from distributed_ml_pytorch_tpu.utils.chaos import (
+    ChaosLog,
+    ChaosPlan,
+    FaultRule,
+    FaultyTransport,
+    GrayRule,
+    plan_from_json,
+    plan_to_json,
+)
+from distributed_ml_pytorch_tpu.utils.messaging import (
+    InProcessTransport,
+    MessageCode,
+)
+
+pytestmark = pytest.mark.gray
+
+
+def _pump(t, n=1000):
+    out = []
+    while True:
+        m = t.recv(timeout=0.05)
+        if m is None or len(out) >= n:
+            return out
+        out.append(m)
+
+
+# ---------------------------------------------------------------------------
+# chaos: GrayRule scheduling + determinism
+# ---------------------------------------------------------------------------
+
+def test_gray_rules_roundtrip_chaos_plan_json():
+    """ISSUE 13 interchange: all three gray kinds survive the ChaosPlan
+    JSON round trip exactly — counterexamples from the gray distmodel
+    plane travel as runnable schedules, like every other rule family."""
+    plan = ChaosPlan(
+        seed=7,
+        rules=[FaultRule(drop=0.1)],
+        gray=(
+            GrayRule(kind="partition", src=1, dst=2,
+                     code=int(MessageCode.ParameterRequest),
+                     after=3, until=9),
+            GrayRule(kind="lossy", src=2, dst=0, p=0.4, after=1),
+            GrayRule(kind="stall", src=1, site="fsync", p=1.0,
+                     stall_ms=5.0, until=4),
+        ))
+    data = plan_to_json(plan)
+    assert plan_from_json(json.loads(json.dumps(data))) == plan
+    # defaults are omitted on the wire, and typo'd fields fail loudly
+    assert "gray" not in plan_to_json(ChaosPlan(seed=7))
+    with pytest.raises(ValueError, match="unknown GrayRule fields"):
+        plan_from_json({"gray": [{"knid": "partition"}]})
+    with pytest.raises(ValueError, match="unknown gray kind"):
+        GrayRule(kind="flaky")
+
+
+def test_gray_partition_rule_is_windowed_and_one_way():
+    """A scheduled one-way partition: matching sends in the index window
+    vanish (logged ``gray-partition``); other codes, other indices and
+    the REVERSE direction are untouched."""
+    plan = ChaosPlan(seed=0, gray=(
+        GrayRule(kind="partition", src=1, dst=0,
+                 code=int(MessageCode.GradientUpdate), after=1, until=3),))
+    world = InProcessTransport.create_world(2)
+    fw, log = FaultyTransport.wrap_world(world, plan)
+    for i in range(5):
+        fw[1].send(MessageCode.GradientUpdate, np.full(1, i, np.float32))
+        fw[1].send(MessageCode.Heartbeat, np.full(1, i, np.float32))
+        fw[0].send(MessageCode.GradientUpdate, np.full(1, i, np.float32),
+                   dst=1)
+    inbound = _pump(fw[0])
+    grads = [int(m[2][0]) for m in inbound
+             if m[1] == MessageCode.GradientUpdate]
+    beats = [int(m[2][0]) for m in inbound
+             if m[1] == MessageCode.Heartbeat]
+    reverse = [int(m[2][0]) for m in _pump(fw[1])]
+    assert grads == [0, 3, 4]          # sends #1, #2 vanished
+    assert beats == list(range(5))     # other code untouched
+    assert reverse == list(range(5))   # reverse direction untouched
+    assert log.counts() == {"gray-partition": 2}
+
+
+def test_gray_lossy_rule_is_seeded_and_deterministic():
+    """kind="lossy" drops each matching frame with probability p on the
+    gray stream — run-to-run byte-identical log AND deliveries."""
+    plan = ChaosPlan(seed=5, gray=(
+        GrayRule(kind="lossy", src=1, dst=0, p=0.5),))
+
+    def run():
+        world = InProcessTransport.create_world(2)
+        fw, log = FaultyTransport.wrap_world(world, plan)
+        for i in range(40):
+            fw[1].send(MessageCode.GradientUpdate, np.full(1, i, np.float32))
+        return [int(m[2][0]) for m in _pump(fw[0])], log.lines()
+
+    got_a, log_a = run()
+    got_b, log_b = run()
+    assert got_a == got_b and log_a == log_b
+    assert 0 < len(got_a) < 40          # flaky, not dead, not clean
+    assert set(log_a.split()) >= {"gray-drop"} or "gray-drop" in log_a
+
+
+def test_gray_rules_do_not_perturb_existing_streams():
+    """The back-compat contract baked into ``_Channel``: gray draws ride
+    their own namespaced stream, so ADDING gray rules to a plan leaves
+    every fault/SDC decision of the original seed byte-identical."""
+    base = ChaosPlan([FaultRule(drop=0.3, dup=0.2)], seed=11)
+    grayed = ChaosPlan(
+        [FaultRule(drop=0.3, dup=0.2)], seed=11,
+        gray=(GrayRule(kind="lossy", src=1, dst=0, p=1.0,
+                       after=10**6),))  # present but out of window
+
+    def run(plan):
+        world = InProcessTransport.create_world(2)
+        fw, log = FaultyTransport.wrap_world(world, plan)
+        for i in range(50):
+            fw[1].send(MessageCode.GradientUpdate, np.full(1, i, np.float32))
+        return [int(m[2][0]) for m in _pump(fw[0])], log.lines()
+
+    got_base, log_base = run(base)
+    got_gray, log_gray = run(grayed)
+    assert got_base == got_gray
+    assert log_base == log_gray and "drop" in log_base
+
+
+def test_gray_stall_is_deterministic_and_windowed():
+    """kind="stall" matches per-(rank, site) op counters via
+    ``gray_stall``: inside the window each op sleeps the scripted
+    quantum, other sites and out-of-window ops return 0.0, and the log
+    records ``gray-stall-<site>`` — replayed exactly run-to-run."""
+    plan = ChaosPlan(seed=3, gray=(
+        GrayRule(kind="stall", src=0, site="fsync", p=1.0, stall_ms=2.0,
+                 after=1, until=3),))
+
+    def run():
+        world = InProcessTransport.create_world(2)
+        ft = FaultyTransport(world[0], plan, log=ChaosLog())
+        sleeps = [ft.gray_stall("fsync") for _ in range(5)]
+        other = [ft.gray_stall("serve") for _ in range(3)]
+        for t in world.values():
+            t.close()
+        return sleeps, other, ft.log.lines()
+
+    sleeps_a, other_a, log_a = run()
+    sleeps_b, other_b, log_b = run()
+    assert sleeps_a == sleeps_b == [0.0, 0.002, 0.002, 0.0, 0.0]
+    assert other_a == other_b == [0.0, 0.0, 0.0]
+    assert log_a == log_b
+    assert log_a.count("gray-stall-fsync") == 2
+
+
+def test_probabilistic_stall_draws_are_seeded_per_site():
+    """p < 1 stalls draw from a per-(rank, site) seeded stream: the fire
+    pattern is a pure function of the seed, and distinct sites get
+    independent streams off the same seed."""
+    plan = ChaosPlan(seed=9, gray=(
+        GrayRule(kind="stall", site="fsync", p=0.5, stall_ms=1.0),
+        GrayRule(kind="stall", site="serve", p=0.5, stall_ms=1.0),))
+
+    def run(site):
+        world = InProcessTransport.create_world(2)
+        ft = FaultyTransport(world[0], plan, log=ChaosLog())
+        fired = [ft.gray_stall(site) > 0 for _ in range(64)]
+        for t in world.values():
+            t.close()
+        return fired
+
+    fsync_a, fsync_b = run("fsync"), run("fsync")
+    assert fsync_a == fsync_b
+    assert 0 < sum(fsync_a) < 64        # probabilistic, not all-or-nothing
+    assert run("serve") != fsync_a      # independent per-site streams
+
+
+def test_imperative_partition_heal_mid_plan_preserves_rng_streams():
+    """Flipping ``partition``/``heal`` mid-plan must not shift any seeded
+    decision: draws are consumed BEFORE the partition check, so outside
+    the partitioned window the fault log and deliveries are identical to
+    the never-partitioned run, and inside it every send is logged
+    ``partition-drop`` at its true channel index."""
+    plan = ChaosPlan([FaultRule(drop=0.3, dup=0.2)], seed=11)
+
+    def run(window=None):
+        world = InProcessTransport.create_world(2)
+        fw, log = FaultyTransport.wrap_world(world, plan)
+        for i in range(40):
+            if window and i == window[0]:
+                fw[1].partition(0)
+            if window and i == window[1]:
+                fw[1].heal(0)
+            fw[1].send(MessageCode.GradientUpdate, np.full(1, i, np.float32))
+        return [int(m[2][0]) for m in _pump(fw[0])], log.events()
+
+    got_base, ev_base = run()
+    got_part, ev_part = run((10, 20))
+    part_drops = sorted(e[3] for e in ev_part if e[4] == "partition-drop")
+    assert part_drops == list(range(10, 20))
+    # outside the window: identical decisions, identical deliveries
+    assert [e for e in ev_part if e[4] != "partition-drop"] \
+        == [e for e in ev_base if not 10 <= e[3] < 20]
+    assert got_part == [v for v in got_base if not 10 <= v < 20]
+
+
+# ---------------------------------------------------------------------------
+# wire: the LeaseRenew gray tail is back-compatible both ways
+# ---------------------------------------------------------------------------
+
+def _coord_rig():
+    from distributed_ml_pytorch_tpu.coord.coordinator import (
+        KIND_SHARD,
+        Coordinator,
+        encode_join,
+    )
+    from distributed_ml_pytorch_tpu.coord.grayhealth import GrayHealth
+
+    world = InProcessTransport.create_world(3)
+    fake_now = [100.0]
+    coord = Coordinator(world[0], 8, lease=8.0, speculation=False,
+                        clock=lambda: fake_now[0])
+    gray = GrayHealth(coord, raise_threshold=2.5)
+    for r in (1, 2):
+        coord.handle(r, MessageCode.CoordJoin, encode_join(KIND_SHARD, 0))
+    return world, coord, gray, fake_now
+
+
+def _close(world):
+    for t in world.values():
+        t.close()
+
+
+def test_renew_frame_is_a_pure_extension_of_the_old_layout():
+    """Forward direction: a new sender's frame read by a pre-ISSUE-20
+    receiver (which consumes only the first 10 floats) sees EXACTLY the
+    frame the old encoder would have produced — the gray tail is
+    appended, never interleaved."""
+    from distributed_ml_pytorch_tpu.coord.coordinator import encode_renew
+
+    old = encode_renew(3, push_count=2, step=7, ewma_ms=1.5, wire_open=1,
+                       nacks=4, bad_loss=1, loss_ewma=0.9, gnorm_ewma=2.0)
+    new = encode_renew(3, push_count=2, step=7, ewma_ms=1.5, wire_open=1,
+                       nacks=4, bad_loss=1, loss_ewma=0.9, gnorm_ewma=2.0,
+                       retrans_rate=0.25, blocked_s=0.5,
+                       links=((2, 0.5, 0.1), (4, 0.0, 0.0)))
+    assert np.array_equal(new[:10], old[:10])
+    assert new.size == 15 + 3 * 2
+
+
+def test_old_renew_frames_decode_with_neutral_gray_defaults():
+    """Reverse direction: 5-field (pre-ISSUE-7) and 10-field
+    (pre-ISSUE-20) renewals stay FULL renewals — accepted, liveness
+    refreshed, gray evidence left neutral ("didn't say" is not
+    "gray")."""
+    from distributed_ml_pytorch_tpu.coord.coordinator import encode_renew
+
+    world, coord, gray, fake_now = _coord_rig()
+    try:
+        full = encode_renew(0, push_count=6, step=9, ewma_ms=2.0,
+                            retrans_rate=0.8, blocked_s=1.0)
+        fake_now[0] += 1.0
+        coord.handle(1, MessageCode.LeaseRenew, full[:5])
+        m = coord.members[1]
+        assert m.push_count == 6 and m.step == 9
+        assert m.last_seen == fake_now[0]
+        assert m.retrans_rate == 0.0 and m.blocked_s == 0.0
+        fake_now[0] += 1.0
+        coord.handle(1, MessageCode.LeaseRenew, full[:10])
+        assert coord.members[1].retrans_rate == 0.0
+        # the full frame finally lands the gray tail
+        fake_now[0] += 1.0
+        coord.handle(1, MessageCode.LeaseRenew, full)
+        assert coord.members[1].retrans_rate == pytest.approx(0.8)
+        # every form fed the suspicion plane's arrival history
+        assert len(gray._tracks[1].gaps) == 2
+    finally:
+        _close(world)
+
+
+def test_renew_link_triples_decode_and_malformed_tails_are_gated():
+    """The per-directed-link evidence triples reach GrayHealth keyed
+    (suspect, reporter); nonfinite renewals are dropped whole, and a
+    truncated trailing triple is length-gated away instead of shifting
+    the decode."""
+    from distributed_ml_pytorch_tpu.coord.coordinator import encode_renew
+
+    world, coord, gray, fake_now = _coord_rig()
+    try:
+        fake_now[0] += 1.0
+        coord.handle(2, MessageCode.LeaseRenew,
+                     encode_renew(0, links=((1, 0.5, 0.25),)))
+        assert (1, 2) in gray._links
+        assert gray._links[(1, 2)].latest > 0
+        # nonfinite fixed fields: the whole renewal is dropped
+        seen = coord.members[2].last_seen
+        bad = np.full(15, np.nan, np.float32)
+        fake_now[0] += 1.0
+        coord.handle(2, MessageCode.LeaseRenew, bad)
+        assert coord.members[2].last_seen == seen
+        # a truncated trailing triple decodes as "no link evidence"
+        frame = np.concatenate([encode_renew(0),
+                                np.asarray([1.0, 0.5], np.float32)])
+        before = len(gray._links)
+        coord.handle(2, MessageCode.LeaseRenew, frame)
+        assert len(gray._links) == before
+        # a self-report (peer == sender) is ignored, not an indictment
+        coord.handle(2, MessageCode.LeaseRenew,
+                     encode_renew(0, links=((2, 9.0, 9.0),)))
+        assert (2, 2) not in gray._links
+    finally:
+        _close(world)
+
+
+# ---------------------------------------------------------------------------
+# ladder: GrayHealth probation -> quarantine -> evict, and the way back
+# ---------------------------------------------------------------------------
+
+class _FakeMember:
+    def __init__(self, rank):
+        self.rank = rank
+        self.kind = 99            # not KIND_WORKER: no speculation paths
+        self.kind_name = "shard"
+        self.incarnation = 0
+        self.retrans_rate = 0.0
+        self.nack_rate = 0.0
+        self.blocked_s = 0.0
+        self.fsync_p95_ms = 0.0
+        self.busy_ratio = 0.0
+
+
+class _FakeCoord:
+    """The duck-typed coordinator surface GrayHealth actuates against —
+    a ledger of what the plane DID (logs, frames, park tickets,
+    revocations) without a serve thread in the way."""
+
+    def __init__(self, ranks=(1,)):
+        self.members = {r: _FakeMember(r) for r in ranks}
+        self.speculation = False
+        self.manifests_written = 0
+        self.last_manifest = None
+        self.logs = []
+        self.sent = []
+        self.parked = {}
+        self.revoked = []
+        self.gray = None
+
+    def _log(self, msg):
+        self.logs.append(msg)
+
+    def _send(self, rank, code, payload):
+        self.sent.append((rank, MessageCode(code), np.asarray(payload)))
+
+    def trigger_snapshot(self):
+        import types
+
+        self.manifests_written += 1
+        self.last_manifest = types.SimpleNamespace(snapshot_id=7)
+
+    def note_parked(self, rank, ticket):
+        self.parked[rank] = ticket
+
+    def note_unparked(self, rank):
+        self.parked.pop(rank, None)
+
+    def revoke_member(self, rank, why, cooldown=0.0):
+        self.revoked.append((rank, why))
+        self.members.pop(rank, None)
+
+
+def _ladder(**knobs):
+    from distributed_ml_pytorch_tpu.coord import grayhealth
+
+    coord = _FakeCoord()
+    kw = dict(raise_threshold=2.5, confirm_ticks=2, clear_ticks=2,
+              quarantine_after=3, quarantine_cooldown=0.5,
+              actuator_rank=9)
+    kw.update(knobs)
+    gray = grayhealth.GrayHealth(coord, **kw)
+    return coord, gray
+
+
+def _round(coord, gray, now, rank=1, x=0.01):
+    """One renew-then-tick round at a 0.25s cadence (the drills' lease/4).
+    ``x`` is the member's own retransmit-rate evidence."""
+    now[0] += 0.25
+    coord.members[rank].retrans_rate = x
+    gray.on_renew(coord.members[rank], now[0])
+    gray.tick(now[0])
+
+
+def test_ladder_probation_entry_and_hysteresis_clear():
+    from distributed_ml_pytorch_tpu.coord import grayhealth
+
+    coord, gray = _ladder()
+    now = [100.0]
+    for _ in range(10):
+        _round(coord, gray, now)                   # warm the baseline
+    assert gray.state_of(1) == grayhealth.OK
+    _round(coord, gray, now, x=2.0)                # suspicious tick 1
+    assert gray.state_of(1) == grayhealth.OK       # not confirmed yet
+    _round(coord, gray, now, x=2.0)                # tick 2: confirmed
+    assert gray.state_of(1) == grayhealth.PROBATION
+    assert gray.probations == 1 and gray.flaps_of(1) == 1
+    assert gray.detection_latencies and gray.detection_latencies[0] >= 0
+    assert 1 in coord.members                      # nobody dies
+    # hysteresis on the way down: one calm tick is not enough
+    _round(coord, gray, now)
+    assert gray.state_of(1) == grayhealth.PROBATION
+    _round(coord, gray, now)
+    assert gray.state_of(1) == grayhealth.OK       # clear_ticks=2 reached
+    assert gray.suspect_count() == 0
+    assert not coord.revoked and not coord.parked
+
+
+def test_evict_on_first_suspicion_knob_kills_the_flap_victim():
+    """The distmodel mutation's real-stack surface: with the ladder
+    disabled, the first confirmed suspicion revokes a member a blip
+    would have cleared."""
+    from distributed_ml_pytorch_tpu.coord import grayhealth
+
+    coord, gray = _ladder(evict_on_first_suspicion=True)
+    now = [100.0]
+    for _ in range(10):
+        _round(coord, gray, now)
+    _round(coord, gray, now, x=2.0)
+    _round(coord, gray, now, x=2.0)
+    assert gray.state_of(1) == grayhealth.EVICTED
+    assert gray.evictions == 1
+    assert coord.revoked and coord.revoked[0][0] == 1
+
+
+def test_quarantine_parks_resumes_and_reenters_probation():
+    """The full degrade-don't-kill arc: sustained suspicion drives a
+    snapshot barrier then a gray-granted PreemptRequest; PreemptDone
+    parks the member (ticket tagged gray); the cooldown sends a
+    ResumeRequest to the node agent; the resumed life's first renewal
+    unparks it INTO probation, and clean windows clear it to OK."""
+    from distributed_ml_pytorch_tpu.coord import grayhealth
+
+    coord, gray = _ladder()
+    now = [100.0]
+    for _ in range(10):
+        _round(coord, gray, now)
+    for _ in range(2):
+        _round(coord, gray, now, x=2.0)            # -> PROBATION
+    assert gray.state_of(1) == grayhealth.PROBATION
+    # still suspect: probation_ticks accumulate to quarantine_after=3,
+    # then one tick arms the barrier and the next sends the park
+    for _ in range(6):
+        _round(coord, gray, now, x=2.0)
+    preempts = [s for s in coord.sent
+                if s[1] == MessageCode.PreemptRequest]
+    assert preempts and preempts[0][0] == 1
+    assert coord.manifests_written == 1            # barrier came first
+    gid = gray._tracks[1].grant_id
+    assert gray.owns_grant(gid) and gid >= grayhealth.GRAY_GRANT_BASE
+    gray.on_preempt_done(1, grant_id=gid, snap_id=7, lo=0, hi=8,
+                         apply_seq=5, now=now[0])
+    assert gray.state_of(1) == grayhealth.QUARANTINED
+    assert gray.quarantines == 1
+    assert coord.parked[1]["gray"] is True
+    assert gray.containment_mttrs
+    # cooldown expires -> resume goes to the actuator rank
+    now[0] += 1.0
+    gray.tick(now[0])
+    resumes = [s for s in coord.sent if s[1] == MessageCode.ResumeRequest]
+    assert resumes and resumes[0][0] == 9
+    # the resumed life renews: unparked, back on the ladder at PROBATION
+    _round(coord, gray, now)
+    assert gray.state_of(1) == grayhealth.PROBATION
+    assert gray.recoveries == 1 and 1 not in coord.parked
+    for _ in range(2):
+        _round(coord, gray, now)
+    assert gray.state_of(1) == grayhealth.OK
+    assert not coord.revoked                       # contained, never killed
+    s = gray.stats()
+    assert s["probations"] >= 1 and s["quarantines"] == 1 \
+        and s["evictions"] == 0 and s["recoveries"] == 1
+
+
+def test_asymmetric_link_evidence_convicts_a_clean_tailed_suspect():
+    """The one-way-partition witness: the suspect's own tail stays calm,
+    but distinct reporters' link triples (suspect -> reporter) spike —
+    with ``asymmetric=True`` that alone confirms suspicion; with the
+    mutation knob off the plane is blind to it."""
+    from distributed_ml_pytorch_tpu.coord import grayhealth
+
+    def play(asymmetric):
+        coord = _FakeCoord(ranks=(1, 2, 3))
+        gray = grayhealth.GrayHealth(
+            coord, raise_threshold=2.5, confirm_ticks=2,
+            asymmetric=asymmetric)
+        now = [100.0]
+
+        def round_(link_rate):
+            now[0] += 0.25
+            gray.on_renew(coord.members[1], now[0])       # suspect: calm
+            for rep in (2, 3):
+                gray.on_renew(coord.members[rep], now[0],
+                              links=((1, link_rate, 0.0),))
+            gray.tick(now[0])
+
+        for _ in range(10):
+            round_(0.01)
+        for _ in range(4):
+            round_(1.0)
+        return gray.state_of(1)
+
+    assert play(True) == grayhealth.PROBATION
+    assert play(False) == grayhealth.OK
+
+
+# ---------------------------------------------------------------------------
+# fleet: probation bends routing without marking the engine down
+# ---------------------------------------------------------------------------
+
+def test_fleet_gray_penalty_routes_around_without_removal():
+    from distributed_ml_pytorch_tpu.serving.fleet import FleetRouter
+    from distributed_ml_pytorch_tpu.serving.frontend import _Route
+
+    class _M:
+        def __init__(self, eid, slots):
+            self.engine_id = eid
+            self._slots = slots
+
+        def pressure(self):
+            return (0, self._slots, 0)
+
+    a, b = _M(0, 4), _M(1, 4)
+    router = FleetRouter.__new__(FleetRouter)
+    router.members = {0: a, 1: b}
+    router._member_up = {0: True, 1: True}
+    router._gray_penalized = set()
+    router.session_affinity = False
+    route = _Route(rank=1, rid=1)
+    assert router._pick_engine(route) is a     # tie -> lowest engine id
+    router.note_gray(0)
+    assert router._pick_engine(route) is b     # penalty bends the tie
+    router._member_up[1] = False
+    assert router._pick_engine(route) is a     # degraded, NOT removed
+    router._member_up[1] = True
+    router.clear_gray(0)
+    assert router._pick_engine(route) is a     # penalty is reversible
+
+
+# ---------------------------------------------------------------------------
+# acceptance (slow): the mid-training drill, byte-identical 3x
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.drill
+def test_gray_drill_contains_without_killing_three_runs_byte_identical(
+        tmp_path):
+    """ISSUE 20 tentpole acceptance: a windowed one-way partition grays
+    shard server 0 mid-training; the plane detects on renew-tail link
+    evidence, checkpoint-parks, resumes bit-identically, and the ladder
+    clears — zero evictions, zero lease expiries, and the chaos log is
+    byte-identical across three runs."""
+    from distributed_ml_pytorch_tpu.coord.drill import gray_drill
+
+    outs = []
+    for k in range(3):
+        d = tmp_path / f"run{k}"
+        d.mkdir()
+        out = gray_drill(base_dir=str(d), seed=0)
+        assert out["ok"], (out["violations"], out["errors"],
+                           out["events"][-8:])
+        outs.append(out)
+    first = outs[0]
+    assert first["detect_latency_s"] is not None
+    assert first["containment_mttr_s"] is not None
+    assert first["bit_identical"] is True
+    assert first["gray"]["evictions"] == 0
+    assert first["gray"]["quarantines"] >= 1
+    assert first["gray"]["recoveries"] >= 1
+    assert first["chaos_counts"].get("gray-partition", 0) > 0
+    assert len({o["chaos_lines"] for o in outs}) == 1
